@@ -1,0 +1,49 @@
+"""Benchmark: FedAvg CIFAR-10 ResNet-56 rounds/sec (BASELINE.json north star).
+
+Setup mirrors the reference MPI benchmark config (BENCHMARK_MPI.md: 100-client
+pool, 10 clients/round, batch 64) with 1 local epoch per round. The reference
+publishes no wall-clock numbers (BASELINE.md), so ``vs_baseline`` is reported
+against a fixed denominator of 1.0 round/sec — a conservative stand-in for the
+reference NCCL simulator per-round wall-clock at this workload — until a
+reproduced reference run provides a real one.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+
+
+def main() -> None:
+    import jax
+
+    import fedml_tpu
+    from fedml_tpu.simulation import build_simulator
+
+    rounds_timed = 5
+    args = fedml_tpu.init(config=dict(
+        dataset="cifar10", model="resnet56", partition_method="hetero",
+        partition_alpha=0.5, client_num_in_total=100, client_num_per_round=10,
+        comm_round=1 + rounds_timed, learning_rate=0.01, epochs=1,
+        batch_size=64, frequency_of_the_test=10_000, random_seed=0,
+    ))
+    sim, apply_fn = build_simulator(args)
+
+    # run all rounds; per-round wall-clock is recorded in history
+    hist = sim.run(apply_fn=None, log_fn=None)
+    # drop round 0 (compile) and average steady-state
+    steady = [h["round_time"] for h in hist[1:]]
+    rounds_per_sec = len(steady) / sum(steady)
+
+    baseline_rounds_per_sec = 1.0  # see module docstring
+    print(json.dumps({
+        "metric": "fedavg_cifar10_resnet56_rounds_per_sec",
+        "value": round(rounds_per_sec, 4),
+        "unit": "rounds/sec (10 clients x 1 epoch x bs64 per round)",
+        "vs_baseline": round(rounds_per_sec / baseline_rounds_per_sec, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,153 @@
+"""Federated optimizer registry.
+
+Parity: reference per-algorithm trees under ``simulation/{sp,mpi}/`` (SURVEY.md
+§2.3). Each optimizer here is a ``FedAlgorithm`` bundle of pure functions; the
+simulators are generic over the bundle, so one simulator runs every optimizer
+(the reference re-implements the round loop per algorithm per backend).
+
+Notable fix over the reference: FedProx's proximal term is actually applied
+(the reference MPI FedProx trainer is a verbatim FedAvg copy — SURVEY.md §2.3).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..core.algframe import ClientOutput, FedAlgorithm
+from ..constants import (
+    FEDML_FEDERATED_OPTIMIZER_FEDAVG,
+    FEDML_FEDERATED_OPTIMIZER_FEDNOVA,
+    FEDML_FEDERATED_OPTIMIZER_FEDOPT,
+    FEDML_FEDERATED_OPTIMIZER_FEDPROX,
+    FEDML_FEDERATED_OPTIMIZER_SCAFFOLD,
+)
+from .local_sgd import (
+    LocalTrainConfig,
+    make_eval_fn,
+    make_local_update,
+    tree_add,
+    tree_scale,
+    tree_sub,
+    tree_zeros_like,
+)
+
+__all__ = [
+    "LocalTrainConfig",
+    "make_local_update",
+    "make_eval_fn",
+    "get_algorithm",
+    "tree_add", "tree_sub", "tree_scale", "tree_zeros_like",
+]
+
+
+def _no_state(params):
+    return ()
+
+
+def get_algorithm(
+    name: str,
+    apply_fn: Callable,
+    cfg: LocalTrainConfig,
+    needs_dropout: bool = False,
+    server_lr: float = 1.0,
+    server_optimizer: str = "sgd",
+    server_momentum: float = 0.9,
+    client_fraction: float = 1.0,
+) -> FedAlgorithm:
+    """Build the named optimizer's FedAlgorithm bundle."""
+    name_l = name.lower()
+
+    if name_l == FEDML_FEDERATED_OPTIMIZER_FEDPROX.lower():
+        # default mu=0.1 only when unset; an explicit 0.0 (mu-ablation) is honored
+        mu = 0.1 if cfg.prox_mu is None else cfg.prox_mu
+        cfg = LocalTrainConfig(**{**cfg.__dict__, "prox_mu": mu})
+        name_l = "fedavg_core"
+    if name_l == FEDML_FEDERATED_OPTIMIZER_SCAFFOLD.lower():
+        cfg = LocalTrainConfig(**{**cfg.__dict__, "use_scaffold": True})
+
+    local_update = make_local_update(apply_fn, cfg, needs_dropout)
+
+    if name_l in (FEDML_FEDERATED_OPTIMIZER_FEDAVG.lower(), "fedavg_core", "fedavg"):
+        # aggregated update = weighted-mean delta; w_{t+1} = w_t + delta_mean —
+        # algebraically the reference's weighted param mean (fedavg_api.py:156)
+        def server_update(params, agg_delta, state):
+            return tree_add(params, agg_delta), state
+
+        return FedAlgorithm(
+            name=name, init_server_state=_no_state, init_client_state=_no_state,
+            local_update=local_update, server_update=server_update,
+        )
+
+    if name_l == FEDML_FEDERATED_OPTIMIZER_FEDOPT.lower():
+        # Reference: simulation/sp/fedopt (server optimizer on pseudo-gradient,
+        # _set_model_global_grads:185). Pseudo-grad = -mean_delta.
+        if server_optimizer == "adam":
+            sopt = optax.adam(server_lr)
+        else:
+            sopt = optax.sgd(server_lr, momentum=server_momentum or None)
+
+        def init_server_state(params):
+            return sopt.init(params)
+
+        def server_update(params, agg_delta, opt_state):
+            pseudo_grad = tree_scale(agg_delta, -1.0)
+            updates, opt_state = sopt.update(pseudo_grad, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        return FedAlgorithm(
+            name=name, init_server_state=init_server_state,
+            init_client_state=_no_state,
+            local_update=local_update, server_update=server_update,
+        )
+
+    if name_l == FEDML_FEDERATED_OPTIMIZER_FEDNOVA.lower():
+        # Reference: simulation/sp/fednova (tau-normalized averaging,
+        # FedNova.average():171). Clients ship tau-normalized deltas + tau;
+        # server scales the mean normalized delta by tau_eff.
+        def nova_local_update(params, client_state, data, rng):
+            out = local_update(params, client_state, data, rng)
+            tau = jnp.maximum(out.metrics["local_steps"], 1.0)
+            upd = {
+                "norm_delta": tree_scale(out.update, 1.0 / tau),
+                "tau": tau,
+            }
+            return ClientOutput(upd, out.weight, out.metrics, out.state)
+
+        def server_update(params, agg, state):
+            new = tree_add(params, tree_scale(agg["norm_delta"], agg["tau"]))
+            return new, state
+
+        return FedAlgorithm(
+            name=name, init_server_state=_no_state, init_client_state=_no_state,
+            local_update=nova_local_update, server_update=server_update,
+        )
+
+    if name_l == FEDML_FEDERATED_OPTIMIZER_SCAFFOLD.lower():
+        # Karimireddy et al.; client math in local_sgd.py (use_scaffold).
+        def init_server_state(params):
+            return {"c": tree_zeros_like(params)}
+
+        def init_client_state(params):
+            return (tree_zeros_like(params), tree_zeros_like(params))  # (c, c_i)
+
+        def server_update(params, agg, state):
+            params = tree_add(params, tree_scale(agg["delta"], server_lr))
+            c = tree_add(state["c"], tree_scale(agg["delta_c"], client_fraction))
+            return params, {"c": c}
+
+        def prepare_client_state(server_state, client_state):
+            _, c_local = client_state
+            return (server_state["c"], c_local)
+
+        return FedAlgorithm(
+            name=name, init_server_state=init_server_state,
+            init_client_state=init_client_state,
+            local_update=local_update, server_update=server_update,
+            prepare_client_state=prepare_client_state,
+        )
+
+    raise ValueError(f"unknown federated optimizer '{name}'")

@@ -1,0 +1,185 @@
+"""The local-training hot loop, compiled.
+
+Reference equivalent: ``simulation/sp/fedavg/my_model_trainer_classification.py:15``
+(the per-client epoch/batch SGD loop — "the hot loop" per SURVEY.md §3.1). There
+it is eager torch; here it is a pure function
+``local_update(params, client_state, data, rng) -> ClientOutput`` built once per
+(model, hyperparams) and jitted/vmapped by the simulators:
+
+- epochs and batches are ``lax.scan``s (no Python control flow in the trace),
+- padded rows are masked out of loss and gradient (data/federated.py packing),
+- an optional proximal term (FedProx mu) and control variates (SCAFFOLD) hook
+  into the gradient transform,
+- the returned ``update`` is the model **delta** (new - global) pre-scaled by
+  nothing; weighting happens at aggregation in f32
+  (``parallel.collectives.weighted_psum_tree``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..core.algframe import ClientOutput
+from ..ops.losses import masked_accuracy, masked_softmax_cross_entropy
+
+PyTree = Any
+
+
+def tree_sub(a: PyTree, b: PyTree) -> PyTree:
+    return jax.tree.map(lambda x, y: x - y, a, b)
+
+
+def tree_add(a: PyTree, b: PyTree) -> PyTree:
+    return jax.tree.map(lambda x, y: x + y, a, b)
+
+
+def tree_scale(a: PyTree, s) -> PyTree:
+    return jax.tree.map(lambda x: x * s, a)
+
+
+def tree_zeros_like(a: PyTree) -> PyTree:
+    return jax.tree.map(jnp.zeros_like, a)
+
+
+@dataclasses.dataclass(frozen=True)
+class LocalTrainConfig:
+    lr: float = 0.03
+    epochs: int = 1
+    client_optimizer: str = "sgd"  # sgd | adam
+    momentum: float = 0.0
+    weight_decay: float = 0.0
+    prox_mu: Optional[float] = None  # FedProx proximal term; None = unset (the
+                                     # FedProx bundle defaults it to 0.1, and an
+                                     # explicit 0.0 is honored). Reference MPI
+                                     # FedProx omits the term — SURVEY.md §2.3;
+                                     # we implement it.
+    use_scaffold: bool = False
+    max_grad_norm: Optional[float] = None
+
+    def make_optimizer(self) -> optax.GradientTransformation:
+        chain = []
+        if self.max_grad_norm:
+            chain.append(optax.clip_by_global_norm(self.max_grad_norm))
+        if self.weight_decay:
+            chain.append(optax.add_decayed_weights(self.weight_decay))
+        if self.client_optimizer == "adam":
+            chain.append(optax.adam(self.lr))
+        else:
+            chain.append(optax.sgd(self.lr, momentum=self.momentum or None))
+        return optax.chain(*chain)
+
+
+def make_loss_fn(apply_fn: Callable, needs_dropout: bool = False) -> Callable:
+    """(params, x, y, mask, rng) -> (loss, (correct, valid)) with masking."""
+
+    def loss_fn(params, x, y, mask, rng):
+        kwargs = {"rngs": {"dropout": rng}} if needs_dropout else {}
+        logits = apply_fn(params, x, train=True, **kwargs)
+        loss = masked_softmax_cross_entropy(logits, y, mask)
+        correct, valid = masked_accuracy(logits, y, mask)
+        return loss, (correct, valid)
+
+    return loss_fn
+
+
+def make_local_update(
+    apply_fn: Callable,
+    cfg: LocalTrainConfig,
+    needs_dropout: bool = False,
+) -> Callable:
+    """Build the jittable per-client local update.
+
+    ``data`` is one client's rectangle: dict with x (NB,BS,*feat), y (NB,BS),
+    mask (NB,BS), num_samples scalar. ``client_state`` is algorithm state
+    (SCAFFOLD carries (c_global, c_local); others None/empty).
+    """
+    opt = cfg.make_optimizer()
+    loss_fn = make_loss_fn(apply_fn, needs_dropout)
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    prox_mu = 0.0 if cfg.prox_mu is None else cfg.prox_mu
+
+    def local_update(global_params, client_state, data, rng) -> ClientOutput:
+        x, y, mask = data["x"], data["y"], data["mask"]
+        num_samples = data["num_samples"]
+        n_batches = x.shape[0]
+
+        if cfg.use_scaffold:
+            c_global, c_local = client_state
+
+        def batch_step(carry, inputs):
+            params, opt_state, step = carry
+            bx, by, bm = inputs
+            step_rng = jax.random.fold_in(rng, step)
+            (loss, (correct, valid)), grads = grad_fn(params, bx, by, bm, step_rng)
+            if prox_mu > 0.0:
+                grads = tree_add(grads, tree_scale(tree_sub(params, global_params), prox_mu))
+            if cfg.use_scaffold:
+                grads = tree_add(grads, tree_sub(c_global, c_local))
+            # zero the update entirely for fully-padded batches
+            bweight = (bm.sum() > 0).astype(jnp.float32)
+            grads = tree_scale(grads, bweight)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state, step + 1), (loss, correct, valid, bweight)
+
+        def epoch_step(carry, _):
+            carry, outs = jax.lax.scan(batch_step, carry, (x, y, mask))
+            return carry, outs
+
+        init = (global_params, opt.init(global_params), jnp.int32(0))
+        (params, _, n_steps), (losses, corrects, valids, bweights) = jax.lax.scan(
+            epoch_step, init, None, length=cfg.epochs
+        )
+
+        delta = tree_sub(params, global_params)
+        real_steps = bweights.sum()
+        metrics = {
+            "train_loss": (losses * bweights).sum() / jnp.maximum(bweights.sum(), 1.0),
+            "train_correct": corrects.sum(),
+            "train_valid": valids.sum(),
+            "local_steps": real_steps,
+        }
+        new_state = client_state
+        if cfg.use_scaffold:
+            # c_i+ = c_i - c + (w_global - w_local) / (K * lr)
+            K = jnp.maximum(real_steps, 1.0)
+            new_c_local = tree_add(
+                tree_sub(c_local, c_global),
+                tree_scale(tree_sub(global_params, params), 1.0 / (K * cfg.lr)),
+            )
+            # ship (delta_w, delta_c) — server averages both
+            delta_c = tree_sub(new_c_local, c_local)
+            new_state = (c_global, new_c_local)
+            metrics = dict(metrics)
+            return ClientOutput(
+                update={"delta": delta, "delta_c": delta_c},
+                weight=num_samples.astype(jnp.float32),
+                metrics=metrics,
+                state=new_state,
+            )
+        return ClientOutput(
+            update=delta,
+            weight=num_samples.astype(jnp.float32),
+            metrics=metrics,
+            state=new_state,
+        )
+
+    return local_update
+
+
+def make_eval_fn(apply_fn: Callable) -> Callable:
+    """Batched global eval: (params, x, y) -> (loss_sum, correct, count)."""
+
+    def eval_fn(params, x, y):
+        logits = apply_fn(params, x, train=False)
+        mask = jnp.ones_like(y, jnp.float32)
+        loss = masked_softmax_cross_entropy(logits, y, mask)
+        correct, valid = masked_accuracy(logits, y, mask)
+        return loss * y.shape[0], correct, valid
+
+    return eval_fn

@@ -1,0 +1,33 @@
+"""Core FL runtime: algorithm frame, partitioning, scheduling, robustness, MPC.
+
+Parity surface: reference ``python/fedml/core/__init__.py:1-9`` exports
+``ClientTrainer``, ``ServerAggregator``,
+``partition_class_samples_with_dirichlet_distribution`` — same here, plus the
+pure-functional equivalents that the TPU simulators compile.
+"""
+
+from .algframe import (
+    ClientTrainer,
+    ServerAggregator,
+    Params,
+    Context,
+    FedAlgorithm,
+    ClientOutput,
+)
+from .partition import (
+    non_iid_partition_with_dirichlet_distribution,
+    partition_class_samples_with_dirichlet_distribution,
+    homo_partition,
+)
+
+__all__ = [
+    "ClientTrainer",
+    "ServerAggregator",
+    "Params",
+    "Context",
+    "FedAlgorithm",
+    "ClientOutput",
+    "non_iid_partition_with_dirichlet_distribution",
+    "partition_class_samples_with_dirichlet_distribution",
+    "homo_partition",
+]

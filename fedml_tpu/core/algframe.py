@@ -1,0 +1,168 @@
+"""Algorithm frame: the FL operator interfaces.
+
+Two layers:
+
+1. **Functional core** (`FedAlgorithm`): an FL optimizer is a bundle of pure,
+   jittable pytree functions. This is what the TPU simulators compile — the
+   reference's mutable ``get/set_model_params`` dict-of-tensors contract
+   (``core/alg_frame/client_trainer.py:4``) becomes immutable pytrees flowing
+   through ``local_update`` / ``aggregate`` / ``server_update``.
+
+2. **Object shell** (`ClientTrainer` / `ServerAggregator`): abstract classes
+   with the reference's exact method names, for cross-silo user code and API
+   parity (reference ``core/alg_frame/client_trainer.py:4``,
+   ``core/alg_frame/server_aggregator.py:4``). The shells are thin: the default
+   implementations delegate to a FedAlgorithm.
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Any, Callable, Dict, NamedTuple, Optional
+
+import jax
+
+PyTree = Any
+
+
+class Params(dict):
+    """Typed key-value parameter bag.
+
+    Parity: reference ``core/alg_frame/params.py:1`` — attribute + item access.
+    """
+
+    def __getattr__(self, key):
+        try:
+            return self[key]
+        except KeyError as e:
+            raise AttributeError(key) from e
+
+    def __setattr__(self, key, value):
+        self[key] = value
+
+    def add(self, key: str, value: Any) -> None:
+        self[key] = value
+
+
+class Context(Params):
+    """Global context singleton (reference ``core/alg_frame/context.py``)."""
+
+    _instance: Optional["Context"] = None
+
+    @classmethod
+    def get_instance(cls) -> "Context":
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+
+class ClientOutput(NamedTuple):
+    """Result of one client's local work in a round.
+
+    ``update`` is a pytree matching the model params (a delta or full params,
+    algorithm-dependent); ``weight`` is the aggregation weight (usually the
+    local sample count); ``metrics`` is a small dict of scalars; ``state`` is
+    persistent per-client state (e.g. SCAFFOLD control variates) or None.
+    """
+
+    update: PyTree
+    weight: jax.Array
+    metrics: Dict[str, jax.Array]
+    state: PyTree = None
+
+
+# --- functional algorithm bundle -------------------------------------------
+
+LocalUpdateFn = Callable[..., ClientOutput]
+# (global_params, client_state, data, rng, *static) -> ClientOutput
+
+AggregateFn = Callable[[PyTree, jax.Array], PyTree]
+# (stacked_or_summed_updates, weights) -> aggregated update
+
+ServerUpdateFn = Callable[[PyTree, PyTree, PyTree], tuple]
+# (global_params, aggregated_update, server_state) -> (new_params, server_state)
+
+
+@dataclasses.dataclass(frozen=True)
+class FedAlgorithm:
+    """A federated optimizer as pure functions (all jittable).
+
+    The reference implements each optimizer as a (API, Aggregator,
+    ServerManager, ClientManager) quartet (SURVEY.md §2.3); here the quartet
+    collapses to this bundle — managers only reappear at real network
+    boundaries (cross-silo).
+    """
+
+    name: str
+    init_server_state: Callable[[PyTree], PyTree]
+    init_client_state: Callable[[PyTree], PyTree]
+    local_update: LocalUpdateFn
+    server_update: ServerUpdateFn
+    # Most algorithms aggregate by weighted mean of updates; override for
+    # robust/median aggregation.
+    aggregate: Optional[AggregateFn] = None
+    # Optional per-round injection of server state into client state before
+    # local_update (e.g. SCAFFOLD broadcasting the server control variate).
+    prepare_client_state: Optional[Callable[[PyTree, PyTree], PyTree]] = None
+
+
+# --- object shells (reference API parity) -----------------------------------
+
+
+class ClientTrainer(abc.ABC):
+    """Abstract local trainer — reference ``core/alg_frame/client_trainer.py:4``.
+
+    Subclass for custom cross-silo training logic. ``model`` here is a pytree
+    of params (not a torch module); ``set_model_params`` replaces the tree.
+    """
+
+    def __init__(self, model: PyTree, args=None):
+        self.model = model
+        self.id = 0
+        self.args = args
+        self.local_sample_number = 0
+
+    def set_id(self, trainer_id: int) -> None:
+        self.id = trainer_id
+
+    def get_model_params(self) -> PyTree:
+        return self.model
+
+    def set_model_params(self, model_parameters: PyTree) -> None:
+        self.model = model_parameters
+
+    @abc.abstractmethod
+    def train(self, train_data, device, args) -> None:
+        ...
+
+    def test(self, test_data, device, args):
+        return None
+
+
+class ServerAggregator(abc.ABC):
+    """Abstract aggregator — reference ``core/alg_frame/server_aggregator.py:4``."""
+
+    def __init__(self, model: PyTree, args=None):
+        self.model = model
+        self.id = 0
+        self.args = args
+
+    def set_id(self, aggregator_id: int) -> None:
+        self.id = aggregator_id
+
+    def get_model_params(self) -> PyTree:
+        return self.model
+
+    def set_model_params(self, model_parameters: PyTree) -> None:
+        self.model = model_parameters
+
+    @abc.abstractmethod
+    def aggregate(self, raw_client_model_list) -> PyTree:
+        ...
+
+    def test(self, test_data, device, args):
+        return None
+
+    def test_on_the_server(self, train_data_local_dict, test_data_local_dict, device, args=None):
+        return None

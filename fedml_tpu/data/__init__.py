@@ -1,0 +1,39 @@
+"""Data layer: ``fedml_tpu.data.load(args)`` single dispatch entry.
+
+Parity: reference ``python/fedml/data/data_loader.py:29`` ``load(args)`` —
+dispatches on ``args.dataset``, honors ``partition_method`` ("hetero" =
+Dirichlet LDA with ``partition_alpha``, else IID) and ``client_num_in_total``.
+Returns ``(FederatedData, class_num)`` — the FederatedData also exposes the
+reference's positional tuple via ``.to_tuple()``.
+"""
+
+from __future__ import annotations
+
+from .federated import ArrayPair, ClientBatches, FederatedData, build_federated_data
+from .loaders import load_partition_data
+from .synthetic import make_classification_like, synthetic_alpha_beta
+
+__all__ = [
+    "load",
+    "ArrayPair",
+    "ClientBatches",
+    "FederatedData",
+    "build_federated_data",
+    "load_partition_data",
+    "synthetic_alpha_beta",
+    "make_classification_like",
+]
+
+
+def load(args):
+    """Load + federate the dataset named by args (reference data_loader.py:29)."""
+    dataset = getattr(args, "dataset", "mnist")
+    fed = load_partition_data(
+        dataset=dataset,
+        data_cache_dir=getattr(args, "data_cache_dir", None),
+        partition_method=getattr(args, "partition_method", "hetero"),
+        partition_alpha=float(getattr(args, "partition_alpha", 0.5)),
+        client_num=int(getattr(args, "client_num_in_total", 10)),
+        small=bool(getattr(args, "debug_small_data", False)),
+    )
+    return fed, fed.class_num

@@ -1,0 +1,151 @@
+"""Federated dataset container + TPU rectangular packing.
+
+The reference's dataset tuple contract (consumed positionally everywhere,
+e.g. ``simulation/sp/fedavg/fedavg_api.py:20-29``) is::
+
+    [train_data_num, test_data_num, train_data_global, test_data_global,
+     train_data_local_num_dict, train_data_local_dict, test_data_local_dict,
+     class_num]
+
+``FederatedData`` keeps that contract (``to_tuple``) but stores arrays, and
+adds the piece the reference never needed: ``pack_clients`` turns ragged
+per-client datasets into rectangular (clients, batches, batch, ...) arrays
+with validity masks, so a whole cohort's local training compiles to one XLA
+program (vmap over the client axis). Reference sidesteps raggedness with
+Python loops (SURVEY.md §7 hard parts); on TPU we pad + mask instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, NamedTuple, Sequence, Tuple
+
+import numpy as np
+
+
+class ArrayPair(NamedTuple):
+    x: np.ndarray
+    y: np.ndarray
+
+    def __len__(self) -> int:
+        return len(self.x)
+
+
+class ClientBatches(NamedTuple):
+    """Rectangular padded batches for a cohort of clients.
+
+    Shapes: x (C, NB, BS, *feat), y (C, NB, BS, *label) — *label is () for
+    classification, (T,) for per-token LM targets — mask (C, NB, BS) float32
+    {0,1}, num_samples (C,) int32 true sample counts (aggregation weights).
+    """
+
+    x: np.ndarray
+    y: np.ndarray
+    mask: np.ndarray
+    num_samples: np.ndarray
+
+
+@dataclasses.dataclass
+class FederatedData:
+    train_data_num: int
+    test_data_num: int
+    train_data_global: ArrayPair
+    test_data_global: ArrayPair
+    train_data_local_num_dict: Dict[int, int]
+    train_data_local_dict: Dict[int, ArrayPair]
+    test_data_local_dict: Dict[int, ArrayPair]
+    class_num: int
+
+    @property
+    def client_num(self) -> int:
+        return len(self.train_data_local_dict)
+
+    def to_tuple(self) -> Tuple:
+        """Positional contract parity with the reference loaders."""
+        return (
+            self.train_data_num,
+            self.test_data_num,
+            self.train_data_global,
+            self.test_data_global,
+            self.train_data_local_num_dict,
+            self.train_data_local_dict,
+            self.test_data_local_dict,
+            self.class_num,
+        )
+
+    def pack_clients(
+        self,
+        client_ids: Sequence[int],
+        batch_size: int,
+        num_batches: int | None = None,
+        drop_remainder: bool = False,
+        rng: np.random.Generator | None = None,
+    ) -> ClientBatches:
+        """Pad/stack the given clients' train data into a rectangle.
+
+        ``num_batches`` defaults to ceil(max_client_samples / batch_size);
+        smaller clients are padded with zero rows and mask 0. If ``rng`` is
+        given each client's samples are shuffled first (local-epoch shuffle).
+        """
+        pairs = [self.train_data_local_dict[c] for c in client_ids]
+        sizes = np.asarray([len(p) for p in pairs], dtype=np.int32)
+        if num_batches is None:
+            if drop_remainder:
+                num_batches = max(1, int(sizes.max()) // batch_size)
+            else:
+                num_batches = max(1, -(-int(sizes.max()) // batch_size))
+        cap = num_batches * batch_size
+
+        feat_shape = pairs[0].x.shape[1:]
+        label_shape = pairs[0].y.shape[1:]  # () scalar labels, (T,) per-token
+        x_dtype = pairs[0].x.dtype
+        y_dtype = pairs[0].y.dtype
+        C = len(pairs)
+        xs = np.zeros((C, cap) + feat_shape, dtype=x_dtype)
+        ys = np.zeros((C, cap) + label_shape, dtype=y_dtype)
+        mask = np.zeros((C, cap), dtype=np.float32)
+        for i, p in enumerate(pairs):
+            n = min(len(p), cap)
+            order = np.arange(len(p))
+            if rng is not None:
+                order = rng.permutation(len(p))
+            take = order[:n]
+            xs[i, :n] = p.x[take]
+            ys[i, :n] = p.y[take]
+            mask[i, :n] = 1.0
+        new_shape = (C, num_batches, batch_size)
+        return ClientBatches(
+            x=xs.reshape(new_shape + feat_shape),
+            y=ys.reshape(new_shape + label_shape),
+            mask=mask.reshape(new_shape),
+            num_samples=np.minimum(sizes, cap).astype(np.int32),
+        )
+
+
+def build_federated_data(
+    train: ArrayPair,
+    test: ArrayPair,
+    net_dataidx_map: Dict[int, List[int]],
+    class_num: int,
+    test_idx_map: Dict[int, List[int]] | None = None,
+) -> FederatedData:
+    """Assemble the container from global arrays + a client->indices map."""
+    train_local = {
+        c: ArrayPair(train.x[idx], train.y[idx]) for c, idx in net_dataidx_map.items()
+    }
+    if test_idx_map is None:
+        test_local = {c: test for c in net_dataidx_map}
+    else:
+        test_local = {
+            c: ArrayPair(test.x[idx], test.y[idx]) for c, idx in test_idx_map.items()
+        }
+    return FederatedData(
+        train_data_num=len(train),
+        test_data_num=len(test),
+        train_data_global=train,
+        test_data_global=test,
+        train_data_local_num_dict={c: len(v) for c, v in train_local.items()},
+        train_data_local_dict=train_local,
+        test_data_local_dict=test_local,
+        class_num=class_num,
+    )

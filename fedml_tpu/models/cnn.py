@@ -1,0 +1,55 @@
+"""CNNs. Parity: reference ``python/fedml/model/cv/cnn.py:142`` —
+``CNN_DropOut`` (the FedAvg-paper MNIST/FEMNIST CNN: 2x conv3x3 + maxpool +
+dropout + 128-dense head) and ``CNN_OriginalFedAvg`` (conv5x5 pair, 512-dense,
+used for MNIST/fed-EMNIST in the reference benchmark table)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class CNNDropOut(nn.Module):
+    """FedAvg-paper CNN with dropout (reference ``CNN_DropOut``)."""
+
+    num_classes: int = 62
+    only_digits: bool = False
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False, rngs=None):
+        # x: (B, 28, 28, 1)
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), padding="VALID", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Conv(64, (3, 3), padding="VALID", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(10 if self.only_digits else self.num_classes, dtype=self.dtype)(x)
+
+
+class CNNOriginalFedAvg(nn.Module):
+    """McMahan et al. CNN (reference ``CNN_OriginalFedAvg``): two 5x5 convs
+    (32, 64) each followed by 2x2 maxpool, then 512-dense."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME", dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(512, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)

@@ -1,0 +1,22 @@
+"""Linear models. Parity: reference ``python/fedml/model/linear/lr.py``."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LogisticRegression(nn.Module):
+    """LR over flattened input (reference ``LogisticRegression`` lr.py).
+
+    The reference applies no final activation (CrossEntropyLoss takes logits);
+    same here — callers use softmax-CE on the output.
+    """
+
+    num_classes: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        return nn.Dense(self.num_classes, dtype=self.dtype, name="linear")(x)

@@ -1,0 +1,51 @@
+"""MobileNet v1. Parity: reference ``model/cv/mobilenet.py`` (the
+BENCHMARK_MPI.md MobileNet rows). GroupNorm default for FL (see resnet.py)."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class DepthwiseSeparable(nn.Module):
+    filters: int
+    strides: int
+    norm: object
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        in_ch = x.shape[-1]
+        x = nn.Conv(in_ch, (3, 3), (self.strides, self.strides), padding="SAME",
+                    feature_group_count=in_ch, use_bias=False, dtype=self.dtype)(x)
+        x = self.norm()(x)
+        x = nn.relu(x)
+        x = nn.Conv(self.filters, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        x = self.norm()(x)
+        return nn.relu(x)
+
+
+class MobileNetV1(nn.Module):
+    num_classes: int = 10
+    width: float = 1.0
+    small_input: bool = True  # CIFAR-style 32x32
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = partial(nn.GroupNorm, num_groups=None, group_size=8, dtype=self.dtype)
+        c = lambda ch: max(8, int(ch * self.width))  # noqa: E731
+        x = x.astype(self.dtype)
+        stem_stride = 1 if self.small_input else 2
+        x = nn.Conv(c(32), (3, 3), (stem_stride, stem_stride), padding="SAME",
+                    use_bias=False, dtype=self.dtype)(x)
+        x = norm()(x)
+        x = nn.relu(x)
+        cfg = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+               (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2), (1024, 1)]
+        for filters, strides in cfg:
+            x = DepthwiseSeparable(c(filters), strides, norm, self.dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)

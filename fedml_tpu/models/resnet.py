@@ -1,0 +1,114 @@
+"""ResNets for CIFAR-class federated benchmarks.
+
+Parity targets: reference ``model/cv/resnet.py:303`` (CIFAR ResNet-56, the
+BENCHMARK_MPI.md flagship) and ``model/cv/resnet_gn.py:239`` (ResNet-18 with
+GroupNorm, the fed_CIFAR100 baseline).
+
+Normalization: GroupNorm everywhere by default. The reference's ResNet-56
+uses BatchNorm and FedAvg then averages running stats across clients
+(``fedavg_api.py:163-170`` iterates *all* state_dict keys); BN's
+batch-statistics dependence is exactly what breaks under client vmap, and GN
+is the standard FL fix (Hsieh et al.; the reference itself ships resnet18_gn
+for this reason). The modules accept ``norm='batch'`` structurally, but the
+training path doesn't yet thread the mutable ``batch_stats`` collection, so
+the model factory rejects it with NotImplementedError until that lands.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+def _norm(norm: str, dtype) -> Callable:
+    if norm == "group":
+        return partial(nn.GroupNorm, num_groups=None, group_size=16, dtype=dtype)
+    if norm == "batch":
+        return partial(nn.BatchNorm, use_running_average=None, momentum=0.9, dtype=dtype)
+    raise ValueError(norm)
+
+
+class BasicBlock(nn.Module):
+    filters: int
+    norm: ModuleDef
+    strides: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = nn.Conv(self.filters, (3, 3), (self.strides, self.strides), padding="SAME",
+                    use_bias=False, dtype=self.dtype)(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(y)
+        y = self.norm()(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.filters, (1, 1), (self.strides, self.strides),
+                               use_bias=False, dtype=self.dtype, name="proj")(residual)
+            residual = self.norm(name="proj_norm")(residual)
+        return nn.relu(y + residual)
+
+
+class CifarResNet(nn.Module):
+    """CIFAR-style 6n+2 ResNet: stages (16, 32, 64) x n blocks.
+
+    depth 56 -> n=9 (reference resnet56); depth 20 -> n=3.
+    """
+
+    depth: int = 56
+    num_classes: int = 10
+    norm_kind: str = "group"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        n = (self.depth - 2) // 6
+        norm = _norm(self.norm_kind, self.dtype)
+        if self.norm_kind == "batch":
+            norm = partial(norm, use_running_average=not train)
+        x = x.astype(self.dtype)
+        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        x = norm()(x)
+        x = nn.relu(x)
+        for i, filters in enumerate((16, 32, 64)):
+            for j in range(n):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = BasicBlock(filters, norm, strides, self.dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+class ResNet18(nn.Module):
+    """ImageNet-style ResNet-18 with GN (reference resnet18_gn for fed_CIFAR100;
+    small-input mode uses a 3x3 stem as is standard for 32x32 data)."""
+
+    num_classes: int = 100
+    norm_kind: str = "group"
+    small_input: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        norm = _norm(self.norm_kind, self.dtype)
+        if self.norm_kind == "batch":
+            norm = partial(norm, use_running_average=not train)
+        x = x.astype(self.dtype)
+        if self.small_input:
+            x = nn.Conv(64, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        else:
+            x = nn.Conv(64, (7, 7), (2, 2), padding="SAME", use_bias=False, dtype=self.dtype)(x)
+            x = norm()(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, filters in enumerate((64, 128, 256, 512)):
+            for j in range(2):
+                strides = 2 if i > 0 and j == 0 else 1
+                x = BasicBlock(filters, norm, strides, self.dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)

@@ -1,0 +1,50 @@
+"""Character/word LSTM language models.
+
+Parity: reference ``python/fedml/model/nlp/rnn.py:86`` —
+``RNN_OriginalFedAvg`` (shakespeare: embed-8, 2xLSTM-256, vocab 90) and
+``RNN_StackOverFlow`` (next-word-prediction: vocab 10k+special, embed-96,
+LSTM-670, double dense head).
+
+Implemented with ``nn.RNN`` over ``nn.OptimizedLSTMCell`` — the scan is
+compiler-friendly (``lax.scan`` under the hood), static sequence length.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class RNNOriginalFedAvg(nn.Module):
+    """2-layer LSTM char LM (reference ``RNN_OriginalFedAvg``)."""
+
+    vocab_size: int = 90
+    embedding_dim: int = 8
+    hidden_size: int = 256
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # x: (B, T) int tokens -> logits (B, T, vocab)
+        h = nn.Embed(self.vocab_size, self.embedding_dim, dtype=self.dtype)(x)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size, dtype=self.dtype))(h)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.hidden_size, dtype=self.dtype))(h)
+        return nn.Dense(self.vocab_size, dtype=self.dtype)(h)
+
+
+class RNNStackOverFlow(nn.Module):
+    """Next-word LSTM (reference ``RNN_StackOverFlow``)."""
+
+    vocab_size: int = 10000
+    num_oov_buckets: int = 1
+    embedding_size: int = 96
+    latent_size: int = 670
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        extended_vocab = self.vocab_size + 3 + self.num_oov_buckets  # pad/bos/eos + oov
+        h = nn.Embed(extended_vocab, self.embedding_size, dtype=self.dtype)(x)
+        h = nn.RNN(nn.OptimizedLSTMCell(self.latent_size, dtype=self.dtype))(h)
+        h = nn.Dense(self.embedding_size, dtype=self.dtype)(h)
+        return nn.Dense(extended_vocab, dtype=self.dtype)(h)

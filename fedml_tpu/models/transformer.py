@@ -1,0 +1,119 @@
+"""Transformer LM + ViT.
+
+Parity: the reference's transformer workloads live in ``app/fednlp`` (BERT
+fine-tuning via HuggingFace) and FedCV; here transformers are first-class
+in-tree models so the long-context / parallelism stack (ring attention over
+the ``seq`` mesh axis, tensor parallel over ``model``) has a flagship to
+drive. Attention routes through ``fedml_tpu.ops.attention`` so the same
+module runs single-chip (fused softmax path) or sequence-sharded.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def causal_mask(T: int, dtype=jnp.float32) -> jax.Array:
+    return jnp.tril(jnp.ones((T, T), dtype=bool))
+
+
+class MLPBlock(nn.Module):
+    dim: int
+    hidden_mult: int = 4
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h = nn.Dense(self.dim * self.hidden_mult, dtype=self.dtype)(x)
+        h = nn.gelu(h)
+        return nn.Dense(self.dim, dtype=self.dtype)(h)
+
+
+class SelfAttention(nn.Module):
+    dim: int
+    num_heads: int
+    causal: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        from ..ops.attention import multihead_attention
+
+        B, T, D = x.shape
+        H = self.num_heads
+        qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=self.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        reshape = lambda t: t.reshape(B, T, H, D // H)  # noqa: E731
+        out = multihead_attention(reshape(q), reshape(k), reshape(v), causal=self.causal)
+        out = out.reshape(B, T, D)
+        return nn.Dense(self.dim, use_bias=False, dtype=self.dtype, name="proj")(out)
+
+
+class Block(nn.Module):
+    dim: int
+    num_heads: int
+    causal: bool = True
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x + SelfAttention(self.dim, self.num_heads, self.causal, self.dtype)(
+            nn.LayerNorm(dtype=self.dtype)(x)
+        )
+        x = x + MLPBlock(self.dim, dtype=self.dtype)(nn.LayerNorm(dtype=self.dtype)(x))
+        return x
+
+
+class TransformerLM(nn.Module):
+    """Decoder-only causal LM."""
+
+    vocab_size: int = 32000
+    dim: int = 256
+    num_heads: int = 8
+    num_layers: int = 4
+    max_len: int = 2048
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        B, T = tokens.shape
+        h = nn.Embed(self.vocab_size, self.dim, dtype=self.dtype, name="wte")(tokens)
+        pos = nn.Embed(self.max_len, self.dim, dtype=self.dtype, name="wpe")(
+            jnp.arange(T)[None, :]
+        )
+        h = h + pos
+        for i in range(self.num_layers):
+            h = Block(self.dim, self.num_heads, causal=True, dtype=self.dtype, name=f"block_{i}")(h)
+        h = nn.LayerNorm(dtype=self.dtype, name="ln_f")(h)
+        return nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype, name="head")(h)
+
+
+class ViT(nn.Module):
+    """Small vision transformer (FedCV-parity family)."""
+
+    num_classes: int = 10
+    patch: int = 4
+    dim: int = 192
+    num_heads: int = 3
+    num_layers: int = 6
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B = x.shape[0]
+        x = nn.Conv(self.dim, (self.patch, self.patch), (self.patch, self.patch),
+                    dtype=self.dtype, name="patchify")(x.astype(self.dtype))
+        x = x.reshape(B, -1, self.dim)
+        cls = self.param("cls", nn.initializers.zeros, (1, 1, self.dim), self.dtype)
+        x = jnp.concatenate([jnp.broadcast_to(cls, (B, 1, self.dim)), x], axis=1)
+        pos = self.param("pos", nn.initializers.normal(0.02), (1, x.shape[1], self.dim), self.dtype)
+        x = x + pos
+        for i in range(self.num_layers):
+            x = Block(self.dim, self.num_heads, causal=False, dtype=self.dtype, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=self.dtype)(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype, name="head")(x[:, 0])

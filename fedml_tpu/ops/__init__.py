@@ -1,0 +1,16 @@
+"""Compute ops: attention (fused / ring), losses, pallas kernels.
+
+This layer is where hot ops get TPU-specific implementations; everything else
+relies on XLA fusion. Reference has no equivalent (its compute is torch ops);
+SURVEY.md §2.7 maps PyTorch ATen/CUDA -> XLA:TPU here.
+"""
+
+from .losses import softmax_cross_entropy, masked_softmax_cross_entropy, masked_accuracy
+from .attention import multihead_attention
+
+__all__ = [
+    "softmax_cross_entropy",
+    "masked_softmax_cross_entropy",
+    "masked_accuracy",
+    "multihead_attention",
+]

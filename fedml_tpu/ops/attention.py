@@ -1,0 +1,94 @@
+"""Attention ops: dense multihead attention + ring attention over a seq axis.
+
+Single-chip path is plain XLA (it fuses QK^T -> softmax -> V well on the MXU
+for moderate T; a pallas flash kernel is the planned upgrade — see
+ops/pallas/). The ring path implements blockwise ring attention
+(Liu et al.) with ``lax.ppermute`` over the ``seq`` mesh axis: each shard
+holds a query block, K/V blocks rotate around the ring, and softmax is
+accumulated online (running max + normalizer), so memory stays O(T/n per
+device) and comms ride ICI. This is the long-context capability the task
+brief requires (SURVEY.md §5.7: absent in reference, first-class here).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def multihead_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False
+) -> jax.Array:
+    """Dense attention. q/k/v: (B, T, H, Dh) -> (B, T, H, Dh)."""
+    Dh = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(Dh).astype(q.dtype)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        T, S = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((T, S), dtype=bool))
+        logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str,
+    causal: bool = False,
+) -> jax.Array:
+    """Blockwise ring attention. Must run inside shard_map with ``axis_name``
+    bound; q/k/v are the local sequence shards (B, T_local, H, Dh).
+
+    Online-softmax accumulation: for each incoming K/V block keep running
+    (max, normalizer, weighted-sum) in f32 and rotate K/V with ppermute.
+    For ``causal=True`` blocks are masked by global block position (query
+    shard i attends to key shard j fully if j < i, diagonally if j == i).
+    """
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    B, T, H, Dh = q.shape
+    scale = 1.0 / jnp.sqrt(Dh).astype(jnp.float32)
+
+    qf = q.astype(jnp.float32)
+    neg = jnp.finfo(jnp.float32).min
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    tri = jnp.tril(jnp.ones((T, T), dtype=bool))
+
+    def block_logits(kblk, src_idx):
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kblk.astype(jnp.float32)) * scale
+        if causal:
+            keep_all = src_idx < my_idx
+            keep_diag = src_idx == my_idx
+            mask = jnp.where(keep_all, True, jnp.where(keep_diag, tri, False))
+            logits = jnp.where(mask[None, None], logits, neg)
+        return logits
+
+    def step(carry, _):
+        kblk, vblk, src_idx, m, l, acc = carry
+        logits = block_logits(kblk, src_idx)
+        blk_max = jnp.max(logits, axis=-1)            # (B,H,T)
+        new_m = jnp.maximum(m, blk_max)
+        correction = jnp.exp(m - new_m)
+        p = jnp.exp(logits - new_m[..., None])        # (B,H,T,K)
+        new_l = l * correction + p.sum(axis=-1)
+        new_acc = acc * correction[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vblk.astype(jnp.float32)
+        )
+        kblk = lax.ppermute(kblk, axis_name, perm)
+        vblk = lax.ppermute(vblk, axis_name, perm)
+        src_idx = lax.ppermute(src_idx, axis_name, perm)
+        return (kblk, vblk, src_idx, new_m, new_l, new_acc), None
+
+    m0 = jnp.full((B, H, T), neg, jnp.float32)
+    l0 = jnp.zeros((B, H, T), jnp.float32)
+    acc0 = jnp.zeros((B, H, T, Dh), jnp.float32)
+    (k_, v_, _, m, l, acc), _ = lax.scan(
+        step, (k, v, my_idx, m0, l0, acc0), None, length=n
+    )
+    out = acc / jnp.maximum(l[..., None], 1e-30)
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # (B,T,H,Dh)

@@ -1,0 +1,47 @@
+"""Losses with padding masks.
+
+Masked variants are load-bearing: the rectangular client packing
+(``data/federated.py``) pads small clients with zero rows, and the mask keeps
+padding out of both the loss and the gradient — the TPU answer to the
+reference's ragged Python loops (SURVEY.md §7 hard parts).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean CE over the batch. logits (..., C), labels (...) int."""
+    logz = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logz, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def _broadcast_mask(mask: jax.Array, target_ndim: int) -> jax.Array:
+    """Per-example mask -> per-target mask (LM labels add a token dim)."""
+    while mask.ndim < target_ndim:
+        mask = mask[..., None]
+    return mask
+
+
+def masked_softmax_cross_entropy(
+    logits: jax.Array, labels: jax.Array, mask: jax.Array
+) -> jax.Array:
+    """Sum(CE * mask) / max(sum(mask), 1). Shapes: logits (..., C), labels (...)
+    and mask broadcastable to labels (a per-example mask covers per-token
+    labels: every token of a padded example is masked)."""
+    logz = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logz, labels[..., None], axis=-1)[..., 0]
+    m = jnp.broadcast_to(_broadcast_mask(mask, ll.ndim), ll.shape)
+    denom = jnp.maximum(m.sum(), 1.0)
+    return -(ll * m).sum() / denom
+
+
+def masked_accuracy(logits: jax.Array, labels: jax.Array, mask: jax.Array):
+    """Returns (num_correct, num_valid) so callers can aggregate exactly."""
+    pred = jnp.argmax(logits, axis=-1)
+    m = jnp.broadcast_to(_broadcast_mask(mask, labels.ndim), labels.shape)
+    correct = ((pred == labels) * m).sum()
+    return correct, m.sum()

@@ -1,0 +1,42 @@
+"""TPU parallelism layer: device mesh, shardings, typed collectives.
+
+This replaces the reference's native communication stack
+(``fedml/core/distributed/communication/{mpi,nccl}`` + torch.distributed, see
+SURVEY.md §2.7/§5.8): inside a pod, point-to-point weight shipping dissolves
+into XLA collectives over ICI, expressed with ``jax.sharding`` + ``shard_map``.
+"""
+
+from .mesh import (
+    AXIS_CLIENT,
+    AXIS_DATA,
+    AXIS_MODEL,
+    AXIS_PIPE,
+    AXIS_SEQ,
+    AXIS_EXPERT,
+    MeshConfig,
+    create_mesh,
+    get_default_mesh,
+    set_default_mesh,
+)
+from .sharding import (
+    replicated,
+    shard_along,
+    shard_leading_axis,
+    replicate_tree,
+)
+from .collectives import (
+    psum_tree,
+    pmean_tree,
+    weighted_psum_tree,
+    all_gather_tree,
+    ppermute_tree,
+    ring_neighbors,
+)
+
+__all__ = [
+    "AXIS_CLIENT", "AXIS_DATA", "AXIS_MODEL", "AXIS_PIPE", "AXIS_SEQ", "AXIS_EXPERT",
+    "MeshConfig", "create_mesh", "get_default_mesh", "set_default_mesh",
+    "replicated", "shard_along", "shard_leading_axis", "replicate_tree",
+    "psum_tree", "pmean_tree", "weighted_psum_tree", "all_gather_tree",
+    "ppermute_tree", "ring_neighbors",
+]

@@ -1,0 +1,65 @@
+"""Typed pytree collectives over named mesh axes.
+
+TPU-native replacement for the reference's collective wrappers
+(``simulation/nccl/base_framework/common.py:184-233``: ``fedml_nccl_broadcast``,
+``fedml_nccl_reduce``, ``broadcast_model_state``) and its declarative
+collective-params layer (``nccl/base_framework/params.py``). Where the
+reference loops per-tensor ``dist.broadcast``/``dist.reduce`` calls, these
+operate on whole pytrees inside a single traced program, so XLA fuses and
+schedules them onto ICI.
+
+All functions here must be called inside ``shard_map``/``pjit`` tracing with
+the named axis bound.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def psum_tree(tree: Any, axis_name: str) -> Any:
+    """SUM-reduce every leaf across the axis. FedAvg aggregation core:
+    the reference's ``fedml_nccl_reduce`` (common.py:193) becomes one psum."""
+    return jax.tree.map(lambda x: lax.psum(x, axis_name), tree)
+
+
+def pmean_tree(tree: Any, axis_name: str) -> Any:
+    return jax.tree.map(lambda x: lax.pmean(x, axis_name), tree)
+
+
+def weighted_psum_tree(tree: Any, weight: jax.Array, axis_name: str) -> Any:
+    """Pre-scale by ``weight`` then SUM — the exact weighted-FedAvg trick the
+    reference uses (``nccl/base_framework/LocalAggregator.py:84`` scales params
+    by average_weight before the reduce). Weights are applied in f32 for
+    accuracy parity (SURVEY.md §7 hard parts)."""
+    def scale_sum(x):
+        w = weight.astype(jnp.float32)
+        return lax.psum((x.astype(jnp.float32) * w), axis_name).astype(x.dtype)
+
+    return jax.tree.map(scale_sum, tree)
+
+
+def all_gather_tree(tree: Any, axis_name: str, axis: int = 0, tiled: bool = False) -> Any:
+    return jax.tree.map(lambda x: lax.all_gather(x, axis_name, axis=axis, tiled=tiled), tree)
+
+
+def ppermute_tree(tree: Any, axis_name: str, perm: List[Tuple[int, int]]) -> Any:
+    """Point-to-point neighbor exchange — replaces decentralized-FL gossip
+    sends (``simulation/sp/decentralized``) and ring-attention block rotation."""
+    return jax.tree.map(lambda x: lax.ppermute(x, axis_name, perm), tree)
+
+
+def ring_neighbors(n: int, offset: int = 1) -> List[Tuple[int, int]]:
+    """Ring permutation [(src, dst)] used for gossip and ring attention."""
+    return [(i, (i + offset) % n) for i in range(n)]
+
+
+def reduce_scatter_tree(tree: Any, axis_name: str, scatter_dim: int = 0) -> Any:
+    return jax.tree.map(
+        lambda x: lax.psum_scatter(x, axis_name, scatter_dimension=scatter_dim, tiled=True),
+        tree,
+    )

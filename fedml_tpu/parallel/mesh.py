@@ -1,0 +1,121 @@
+"""Device-mesh construction and axis-name conventions.
+
+TPU-native replacement for the reference's process-group setup
+(``simulation/nccl/base_framework/common.py:114-133`` ``init_ddp`` and
+``cross_silo/hierarchical/process_group_manager.py``): instead of ranks in a
+process group, devices live in a named ``jax.sharding.Mesh`` and every
+collective is expressed against a named axis.
+
+Axis conventions (a mesh uses a subset):
+  - ``client``: FL client shards — the Parrot-TPU simulator axis. The
+    reference's "client parallelism" (workers each simulating a client subset,
+    ``mpi/fedavg/FedAvgAPI.py:126``) maps here.
+  - ``data``:   batch data parallelism (reference: DDP inside silos).
+  - ``model``:  tensor parallelism (not in reference; first-class here).
+  - ``pipe``:   pipeline stages (SplitNN's layer split maps here).
+  - ``seq``:    sequence/context parallelism (ring attention).
+  - ``expert``: expert parallelism (MoE).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_CLIENT = "client"
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_PIPE = "pipe"
+AXIS_SEQ = "seq"
+AXIS_EXPERT = "expert"
+
+_DEFAULT_MESH: Optional[Mesh] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    """Declarative mesh spec: axis name -> size; -1 means 'absorb the rest'."""
+
+    axes: Tuple[Tuple[str, int], ...] = ((AXIS_CLIENT, -1),)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, int]) -> "MeshConfig":
+        return cls(axes=tuple(d.items()))
+
+    def resolve(self, n_devices: int) -> Tuple[Tuple[str, int], ...]:
+        sizes = [s for _, s in self.axes]
+        n_wild = sum(1 for s in sizes if s == -1)
+        if n_wild > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        fixed = math.prod(s for s in sizes if s != -1)
+        if n_wild == 1:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes = [n_devices // fixed if s == -1 else s for s in sizes]
+        elif fixed != n_devices:
+            raise ValueError(f"mesh wants {fixed} devices, have {n_devices}")
+        return tuple((name, size) for (name, _), size in zip(self.axes, sizes))
+
+
+def create_mesh(
+    config: Optional[MeshConfig] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_names: Optional[Sequence[str]] = None,
+    axis_sizes: Optional[Sequence[int]] = None,
+) -> Mesh:
+    """Build a named Mesh over the given (default: all) devices.
+
+    Either pass a MeshConfig, or (axis_names, axis_sizes) directly. Device
+    order follows ``jax.devices()``, which on TPU enumerates chips so that
+    adjacent indices are ICI neighbors — keeping high-traffic axes innermost
+    (last) rides the fastest links.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if config is None:
+        if axis_names is None:
+            config = MeshConfig()
+        else:
+            config = MeshConfig(axes=tuple(zip(axis_names, axis_sizes or [-1] * len(axis_names))))
+    resolved = config.resolve(len(devices))
+    names = tuple(n for n, _ in resolved)
+    shape = tuple(s for _, s in resolved)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, names)
+
+
+def set_default_mesh(mesh: Optional[Mesh]) -> None:
+    global _DEFAULT_MESH
+    _DEFAULT_MESH = mesh
+
+
+def get_default_mesh() -> Mesh:
+    """Return the process-wide default mesh, creating a 1-axis client mesh lazily."""
+    global _DEFAULT_MESH
+    if _DEFAULT_MESH is None:
+        _DEFAULT_MESH = create_mesh()
+    return _DEFAULT_MESH
+
+
+def maybe_initialize_distributed(args=None) -> None:
+    """Multi-host init: TPU replacement for the reference's MPI/torchrun world
+    bootstrap (``fedml/__init__.py:90-99`` / ``dist_trainer_launcher.py``).
+
+    On a pod slice each host calls ``jax.distributed.initialize()``; on a
+    single host (or when env vars are absent) this is a no-op.
+    """
+    import os
+
+    if os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ.get("COORDINATOR_ADDRESS"):
+        coord = os.environ.get("JAX_COORDINATOR_ADDRESS") or os.environ["COORDINATOR_ADDRESS"]
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ.get("JAX_NUM_PROCESSES", os.environ.get("WORLD_SIZE", 1))),
+            process_id=int(os.environ.get("JAX_PROCESS_ID", os.environ.get("RANK", 0))),
+        )

@@ -1,0 +1,45 @@
+"""NamedSharding helpers for pytrees.
+
+Replaces the reference's explicit tensor shipping (state_dict pickles over
+MPI/gRPC, SURVEY.md §2.1) with sharding annotations: XLA inserts the
+collectives; we only declare layouts.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_along(mesh: Mesh, axis_name: str, dim: int = 0) -> NamedSharding:
+    """Sharding that splits array dimension ``dim`` across mesh axis ``axis_name``."""
+    spec = [None] * (dim + 1)
+    spec[dim] = axis_name
+    return NamedSharding(mesh, P(*spec))
+
+
+def shard_leading_axis(tree: Any, mesh: Mesh, axis_name: str) -> Any:
+    """Place every leaf with its leading dim split across ``axis_name``.
+
+    Used for stacked per-client state (leading client axis) — the TPU
+    equivalent of the reference scattering client subsets to MPI workers
+    (``nccl/base_framework/Server.py:109-122`` client_schedule + broadcast).
+    """
+    sharding = shard_along(mesh, axis_name, dim=0)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)
+
+
+def replicate_tree(tree: Any, mesh: Optional[Mesh] = None) -> Any:
+    """Replicate every leaf on all mesh devices (server/global state)."""
+    if mesh is None:
+        from .mesh import get_default_mesh
+
+        mesh = get_default_mesh()
+    sharding = replicated(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sharding), tree)

@@ -1,0 +1,230 @@
+"""The federated simulator: one engine, two placements.
+
+Replaces all three reference simulators (SURVEY.md §2.3):
+
+- **SP** (`mesh=None`): the whole cohort's local training is one XLA program —
+  ``vmap(local_update)`` over the client axis + weighted-mean aggregation +
+  server update, jitted together. Reference equivalent:
+  ``simulation/sp/fedavg/fedavg_api.py:81`` (a sequential Python loop there).
+- **Parrot-TPU** (`mesh=Mesh(..., 'client')`): the *same* jitted round step
+  with cohort arrays sharded over the ``client`` mesh axis and params
+  replicated; GSPMD turns the weighted mean into an ICI all-reduce. This is
+  the reference NCCL simulator (``nccl/base_framework/Server.py:153``:
+  broadcast -> schedule -> local train -> SUM reduce) collapsed into one
+  compiled program: the broadcast is sharding, the reduce is a psum.
+
+Client sampling reproduces the reference exactly (``fedavg_api.py:129-143``:
+``np.random.seed(round_idx)`` then ``np.random.choice`` without replacement)
+so accuracy curves are comparable round-for-round.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.algframe import ClientOutput, FedAlgorithm
+from ..data.federated import FederatedData
+from ..algorithms.local_sgd import make_eval_fn, tree_scale
+from ..parallel.mesh import AXIS_CLIENT
+from ..parallel.sharding import replicated, shard_along
+
+PyTree = Any
+
+
+def reference_client_sampling(
+    round_idx: int, client_num_in_total: int, client_num_per_round: int
+) -> np.ndarray:
+    """Bit-for-bit the reference ``_client_sampling`` (fedavg_api.py:129-143)."""
+    if client_num_in_total == client_num_per_round:
+        return np.arange(client_num_in_total)
+    num_clients = min(client_num_per_round, client_num_in_total)
+    np.random.seed(round_idx)
+    return np.random.choice(range(client_num_in_total), num_clients, replace=False)
+
+
+@dataclasses.dataclass
+class SimConfig:
+    comm_round: int = 10
+    client_num_in_total: int = 10
+    client_num_per_round: int = 10
+    batch_size: int = 32
+    frequency_of_the_test: int = 5
+    eval_batch_size: int = 256
+    seed: int = 0
+    # fix the per-client batch count for a stable compiled shape; None =
+    # derive from the largest client (padding+mask covers the rest)
+    num_local_batches: Optional[int] = None
+
+
+class FedSimulator:
+    """Generic over FedAlgorithm; placement decided by ``mesh``."""
+
+    def __init__(
+        self,
+        fed_data: FederatedData,
+        algorithm: FedAlgorithm,
+        init_variables: PyTree,
+        cfg: SimConfig,
+        mesh=None,
+    ):
+        self.fed = fed_data
+        self.alg = algorithm
+        self.cfg = cfg
+        self.mesh = mesh
+        self.params = init_variables
+        self.server_state = algorithm.init_server_state(init_variables)
+        # per-client persistent state lives on host, stacked per cohort on use
+        self.client_states: Dict[int, PyTree] = {}
+        if algorithm.init_client_state is not None:
+            proto = algorithm.init_client_state(init_variables)
+            self._client_state_proto = proto
+        else:
+            self._client_state_proto = ()
+        self.history: List[Dict[str, float]] = []
+        self._round_step = self._build_round_step()
+        self._eval_fn = None
+
+        sizes = [len(v) for v in fed_data.train_data_local_dict.values()]
+        if cfg.num_local_batches is None:
+            self.num_local_batches = max(1, -(-max(sizes) // cfg.batch_size))
+        else:
+            self.num_local_batches = cfg.num_local_batches
+
+    # --- compiled pieces ---------------------------------------------------
+
+    def _build_round_step(self) -> Callable:
+        alg = self.alg
+
+        def round_step(params, server_state, cohort, client_states, rng):
+            C = cohort["num_samples"].shape[0]
+            rngs = jax.random.split(rng, C)
+            outs = jax.vmap(alg.local_update, in_axes=(None, 0, 0, 0))(
+                params, client_states, cohort, rngs
+            )
+            # weighted mean in f32 (reference pre-scale trick, LocalAggregator.py:84)
+            w = outs.weight.astype(jnp.float32)
+            total = jnp.maximum(w.sum(), 1.0)
+            if alg.aggregate is not None:
+                agg = alg.aggregate(outs.update, w)
+            else:
+                agg = jax.tree.map(
+                    lambda u: jnp.tensordot(
+                        w / total, u.astype(jnp.float32), axes=(0, 0)
+                    ).astype(u.dtype),
+                    outs.update,
+                )
+            new_params, new_server_state = alg.server_update(params, agg, server_state)
+            metrics = {k: v for k, v in outs.metrics.items()}
+            return new_params, new_server_state, outs.state, metrics
+
+        if self.mesh is not None:
+            mesh = self.mesh
+            cohort_sh = shard_along(mesh, AXIS_CLIENT, 0)
+            rep = replicated(mesh)
+            return jax.jit(
+                round_step,
+                in_shardings=(rep, rep, cohort_sh, cohort_sh, rep),
+                out_shardings=(rep, rep, cohort_sh, rep),
+            )
+        return jax.jit(round_step)
+
+    def _build_eval(self, apply_fn):
+        eval_fn = make_eval_fn(apply_fn)
+
+        def eval_batches(params, xs, ys):
+            def body(carry, batch):
+                x, y = batch
+                loss_sum, correct, valid = eval_fn(params, x, y)
+                l, c, n = carry
+                return (l + loss_sum, c + correct, n + valid), None
+
+            (l, c, n), _ = jax.lax.scan(body, (0.0, 0.0, 0.0), (xs, ys))
+            return l, c, n
+
+        return jax.jit(eval_batches)
+
+    # --- host-side round loop ---------------------------------------------
+
+    def _cohort_states(self, client_ids: np.ndarray) -> PyTree:
+        states = []
+        for c in client_ids:
+            s = self.client_states.get(int(c))
+            if s is None:
+                s = self._client_state_proto
+            if self.alg.prepare_client_state is not None:
+                s = self.alg.prepare_client_state(self.server_state, s)
+            states.append(s)
+        if not states or states[0] == ():
+            return jax.tree.map(lambda *_: None, ())  # empty tuple states
+        return jax.tree.map(lambda *xs: jnp.stack(xs), *states)
+
+    def _store_states(self, client_ids: np.ndarray, stacked_states) -> None:
+        if stacked_states == ():
+            return
+        for i, c in enumerate(client_ids):
+            self.client_states[int(c)] = jax.tree.map(lambda x: x[i], stacked_states)
+
+    def run(self, apply_fn=None, log_fn=print) -> List[Dict[str, float]]:
+        cfg = self.cfg
+        rng = jax.random.PRNGKey(cfg.seed)
+        pack_rng = np.random.default_rng(cfg.seed)
+        for round_idx in range(cfg.comm_round):
+            t0 = time.perf_counter()
+            client_ids = reference_client_sampling(
+                round_idx, cfg.client_num_in_total, cfg.client_num_per_round
+            )
+            batches = self.fed.pack_clients(
+                client_ids, cfg.batch_size, self.num_local_batches, rng=pack_rng
+            )
+            cohort = {
+                "x": jnp.asarray(batches.x),
+                "y": jnp.asarray(batches.y),
+                "mask": jnp.asarray(batches.mask),
+                "num_samples": jnp.asarray(batches.num_samples),
+            }
+            states = self._cohort_states(client_ids)
+            rng, step_rng = jax.random.split(rng)
+            self.params, self.server_state, new_states, metrics = self._round_step(
+                self.params, self.server_state, cohort, states, step_rng
+            )
+            self._store_states(client_ids, new_states)
+            rec = {
+                "round": round_idx,
+                "round_time": time.perf_counter() - t0,
+                "train_loss": float(metrics["train_loss"].mean()),
+                "train_acc": float(
+                    metrics["train_correct"].sum() / max(float(metrics["train_valid"].sum()), 1.0)
+                ),
+            }
+            if apply_fn is not None and (
+                round_idx % cfg.frequency_of_the_test == 0 or round_idx == cfg.comm_round - 1
+            ):
+                rec.update(self.evaluate(apply_fn))
+            self.history.append(rec)
+            if log_fn:
+                log_fn(f"[round {round_idx}] " + " ".join(
+                    f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}"
+                    for k, v in rec.items() if k != "round"
+                ))
+        return self.history
+
+    def evaluate(self, apply_fn) -> Dict[str, float]:
+        if self._eval_fn is None:
+            self._eval_fn = self._build_eval(apply_fn)
+        test = self.fed.test_data_global
+        n = len(test.x)
+        bs = min(self.cfg.eval_batch_size, n)
+        n_keep = (n // bs) * bs  # truncate tail for a static shape
+        xs = jnp.asarray(test.x[:n_keep]).reshape((-1, bs) + test.x.shape[1:])
+        ys = jnp.asarray(test.y[:n_keep]).reshape((-1, bs))
+        l, c, cnt = self._eval_fn(self.params, xs, ys)
+        return {
+            "test_loss": float(l) / max(float(cnt), 1.0),
+            "test_acc": float(c) / max(float(cnt), 1.0),
+        }

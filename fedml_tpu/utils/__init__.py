@@ -1,0 +1,4 @@
+from .seed import set_seeds
+from .logging import get_logger
+
+__all__ = ["set_seeds", "get_logger"]

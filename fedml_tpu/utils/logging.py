@@ -1,0 +1,23 @@
+"""Run-prefixed logging (reference ``MLOpsRuntimeLog`` prefix format,
+``core/mlops/mlops_runtime_log.py:37-85``: ``[FedML-{role}({rank}) ...]``)."""
+
+from __future__ import annotations
+
+import logging
+import sys
+from typing import Optional
+
+def get_logger(role: str = "Server", rank: int = 0, level: int = logging.INFO) -> logging.Logger:
+    name = f"fedml_tpu.{role}.{rank}"
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stdout)
+        handler.setFormatter(
+            logging.Formatter(
+                f"[FedML-TPU-{role}({rank}) %(asctime)s %(levelname)s] %(message)s"
+            )
+        )
+        logger.addHandler(handler)
+        logger.setLevel(level)
+        logger.propagate = False
+    return logger

@@ -1,0 +1,15 @@
+"""Global seeding (reference ``fedml/__init__.py:40-45`` seeds random/np/torch)."""
+
+from __future__ import annotations
+
+import os
+import random
+
+import numpy as np
+
+
+def set_seeds(seed: int) -> None:
+    random.seed(seed)
+    np.random.seed(seed)
+    os.environ.setdefault("PYTHONHASHSEED", str(seed))
+    # JAX is functional: per-use PRNGKey(seed) is derived where needed.

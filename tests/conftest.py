@@ -1,0 +1,21 @@
+"""Test config: force an 8-device virtual CPU mesh before any JAX backend init.
+
+In this image, sitecustomize imports jax and registers the TPU plugin at
+interpreter start, so jax is already in sys.modules here — but no backend has
+been *initialized* yet. Overriding jax_platforms + XLA_FLAGS before the first
+device lookup keeps tests entirely on virtual CPU devices (the real TPU chip
+is reserved for bench runs; a killed test run would otherwise wedge the
+device-tunnel session claim).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert len(jax.devices()) == 8, "expected 8 virtual CPU devices for sharding tests"

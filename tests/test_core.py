@@ -1,0 +1,110 @@
+"""Unit tests: partitioner semantics, packing, collectives, config system."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from fedml_tpu.arguments import Arguments, load_arguments
+from fedml_tpu.core.partition import (
+    homo_partition,
+    non_iid_partition_with_dirichlet_distribution,
+)
+from fedml_tpu.data.federated import ArrayPair, build_federated_data
+from fedml_tpu.data.synthetic import make_classification_like
+from fedml_tpu.parallel import (
+    AXIS_CLIENT,
+    MeshConfig,
+    create_mesh,
+    psum_tree,
+    ring_neighbors,
+    weighted_psum_tree,
+)
+
+
+def test_dirichlet_partition_covers_all_samples():
+    np.random.seed(0)
+    labels = np.random.randint(0, 10, 1000)
+    m = non_iid_partition_with_dirichlet_distribution(labels, 13, 10, 0.5)
+    all_idx = sorted(i for v in m.values() for i in v)
+    assert all_idx == list(range(1000))
+    assert min(len(v) for v in m.values()) >= 10
+
+
+def test_dirichlet_partition_seeded_reproducible():
+    labels = np.tile(np.arange(10), 100)
+    np.random.seed(7)
+    m1 = non_iid_partition_with_dirichlet_distribution(labels, 5, 10, 0.3)
+    np.random.seed(7)
+    m2 = non_iid_partition_with_dirichlet_distribution(labels, 5, 10, 0.3)
+    assert all(m1[k] == m2[k] for k in m1)
+
+
+def test_homo_partition_even():
+    np.random.seed(0)
+    m = homo_partition(100, 7)
+    sizes = [len(v) for v in m.values()]
+    assert sum(sizes) == 100 and max(sizes) - min(sizes) <= 1
+
+
+def test_pack_clients_masks_padding():
+    tr, te = make_classification_like(100, 20, (4,), 3, seed=1)
+    np.random.seed(0)
+    fed = build_federated_data(tr, te, homo_partition(100, 4), 3)
+    pk = fed.pack_clients([0, 1, 2, 3], batch_size=8, num_batches=5)
+    assert pk.x.shape == (4, 5, 8, 4)
+    for i in range(4):
+        assert pk.mask[i].sum() == pk.num_samples[i]
+
+
+def test_weighted_psum_matches_numpy():
+    mesh = create_mesh(MeshConfig(axes=((AXIS_CLIENT, 8),)))
+    x = jnp.arange(8.0)
+    w = jnp.linspace(0.1, 0.8, 8)
+
+    def f(xs, ws):
+        return weighted_psum_tree(xs, ws[0], AXIS_CLIENT)
+
+    out = shard_map(
+        f, mesh=mesh, in_specs=(P(AXIS_CLIENT), P(AXIS_CLIENT)), out_specs=P(AXIS_CLIENT)
+    )(x, w)
+    expected = float((np.arange(8.0) * np.linspace(0.1, 0.8, 8)).sum())
+    assert np.allclose(np.asarray(out), expected)
+
+
+def test_ring_neighbors():
+    assert ring_neighbors(4) == [(0, 1), (1, 2), (2, 3), (3, 0)]
+
+
+def test_arguments_yaml_roundtrip(tmp_path):
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text(
+        """
+common_args:
+  training_type: simulation
+  random_seed: 3
+train_args:
+  learning_rate: 0.05
+  client_num_in_total: 7
+"""
+    )
+    args = load_arguments(args_list=["--cf", str(cfg)])
+    assert args.random_seed == 3
+    assert args.learning_rate == 0.05
+    assert args.client_num_in_total == 7
+
+
+def test_arguments_collision_raises(tmp_path):
+    cfg = tmp_path / "c.yaml"
+    cfg.write_text(
+        """
+train_args:
+  batch_size: 4
+data_args:
+  batch_size: 8
+"""
+    )
+    with pytest.raises(ValueError, match="batch_size"):
+        load_arguments(args_list=["--cf", str(cfg)])
