@@ -1,1 +1,28 @@
-"""placeholder — filled in later this round"""
+"""Message-driven communication plane (WAN / cross-silo).
+
+Inside a TPU pod, communication is XLA collectives (``fedml_tpu.parallel``);
+this package is the plane for *real* network boundaries — cross-silo DCN/WAN —
+replacing the reference's ``core/distributed/communication`` stack
+(SURVEY.md §5.8): Message + handler-registry managers + pluggable backends
+(loopback for tests, gRPC for deployment).
+"""
+
+from .base import BaseCommunicationManager, Observer
+from .message import Message, pack_payload, unpack_payload
+from .loopback import LoopbackCommManager, LoopbackHub, get_default_hub
+from .managers import ClientManager, FedMLCommManager, ServerManager, create_comm_backend
+from .topology import (
+    AsymmetricTopologyManager,
+    BaseTopologyManager,
+    SymmetricTopologyManager,
+    ring_mixing_matrix,
+)
+
+__all__ = [
+    "BaseCommunicationManager", "Observer",
+    "Message", "pack_payload", "unpack_payload",
+    "LoopbackCommManager", "LoopbackHub", "get_default_hub",
+    "ClientManager", "FedMLCommManager", "ServerManager", "create_comm_backend",
+    "BaseTopologyManager", "SymmetricTopologyManager", "AsymmetricTopologyManager",
+    "ring_mixing_matrix",
+]

@@ -1,0 +1,41 @@
+"""Abstract communication backend + observer interfaces.
+
+Parity: reference ``core/distributed/communication/base_com_manager.py:7`` and
+``observer.py:4``. Backends are chosen by name in the manager constructors
+(constants.COMM_BACKEND_*).
+"""
+
+from __future__ import annotations
+
+import abc
+
+from .message import Message
+
+
+class Observer(abc.ABC):
+    @abc.abstractmethod
+    def receive_message(self, msg_type, msg_params: Message) -> None:
+        ...
+
+
+class BaseCommunicationManager(abc.ABC):
+    @abc.abstractmethod
+    def send_message(self, msg: Message) -> None:
+        ...
+
+    @abc.abstractmethod
+    def add_observer(self, observer: Observer) -> None:
+        ...
+
+    @abc.abstractmethod
+    def remove_observer(self, observer: Observer) -> None:
+        ...
+
+    @abc.abstractmethod
+    def handle_receive_message(self) -> None:
+        """Enter the receive loop (blocks until stop_receive_message)."""
+        ...
+
+    @abc.abstractmethod
+    def stop_receive_message(self) -> None:
+        ...

@@ -1,0 +1,84 @@
+"""Loopback communication backend: in-process, deterministic, zero-network.
+
+The reference has no fake comm backend (SURVEY.md §4 calls this out as a gap —
+its CI smoke-tests run real MPI processes / live MQTT brokers). This backend
+lets the whole cross-silo actor plane (managers, handshake FSM, round protocol)
+run inside one process: each rank gets a queue in a shared hub; messages
+round-trip through the real codec so serialization bugs surface in unit tests.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, List, Optional
+
+from .base import BaseCommunicationManager, Observer
+from .message import Message
+
+
+class LoopbackHub:
+    """Shared mailbox set for one simulated deployment (one per test/run)."""
+
+    def __init__(self):
+        self._queues: Dict[int, "queue.Queue[Optional[bytes]]"] = {}
+        self._lock = threading.Lock()
+
+    def register(self, rank: int) -> "queue.Queue[Optional[bytes]]":
+        with self._lock:
+            if rank not in self._queues:
+                self._queues[rank] = queue.Queue()
+            return self._queues[rank]
+
+    def post(self, rank: int, data: Optional[bytes]) -> None:
+        self.register(rank).put(data)
+
+
+_default_hub: Optional[LoopbackHub] = None
+
+
+def get_default_hub(reset: bool = False) -> LoopbackHub:
+    global _default_hub
+    if _default_hub is None or reset:
+        _default_hub = LoopbackHub()
+    return _default_hub
+
+
+class LoopbackCommManager(BaseCommunicationManager):
+    """In-process backend with the full BaseCommunicationManager contract.
+
+    Messages are packed to bytes and unpacked on receive — the wire format is
+    exercised even though no wire exists.
+    """
+
+    def __init__(self, rank: int, size: int, hub: Optional[LoopbackHub] = None):
+        self.rank = int(rank)
+        self.size = int(size)
+        self.hub = hub or get_default_hub()
+        self._inbox = self.hub.register(self.rank)
+        self._observers: List[Observer] = []
+        self._running = False
+
+    def send_message(self, msg: Message) -> None:
+        self.hub.post(msg.get_receiver_id(), msg.to_bytes())
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        if observer in self._observers:
+            self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        self._running = True
+        while self._running:
+            data = self._inbox.get()
+            if data is None:  # poison pill from stop_receive_message
+                break
+            msg = Message.from_bytes(data)
+            for observer in list(self._observers):
+                observer.receive_message(msg.get_type(), msg)
+
+    def stop_receive_message(self) -> None:
+        self._running = False
+        self.hub.post(self.rank, None)

@@ -1,0 +1,137 @@
+"""Typed key-value message + fast binary codec.
+
+Parity: reference ``core/distributed/communication/message.py:5`` (Message with
+sender/receiver ids, typed params, well-known keys). Redesign: the reference
+serializes with pickle (MPI/gRPC) or JSON (MQTT) and logs payload sizes to
+stdout on every ``to_json`` call (``message.py:69-71``, a known hot-path sin,
+SURVEY.md appendix). Here serialization is msgpack with a raw-buffer extension
+for numpy/JAX arrays — zero pickle, zero base64, one memcpy per tensor — so
+model-weight payloads ship at memory bandwidth. The optional C++ codec
+(``fedml_tpu/native``) accelerates tensor framing further.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterator, Tuple
+
+import msgpack
+import numpy as np
+
+_EXT_NDARRAY = 42
+
+
+def _encode_hook(obj):
+    if isinstance(obj, np.ndarray):
+        arr = np.ascontiguousarray(obj)
+        header = msgpack.packb((arr.dtype.str, arr.shape))
+        return msgpack.ExtType(_EXT_NDARRAY, header + arr.tobytes())
+    # JAX arrays (and scalars) degrade to numpy without import-time jax dep
+    if hasattr(obj, "__array__"):
+        return _encode_hook(np.asarray(obj))
+    raise TypeError(f"cannot serialize {type(obj)!r}")
+
+
+def _ext_hook(code, data):
+    if code != _EXT_NDARRAY:
+        return msgpack.ExtType(code, data)
+    unpacker = msgpack.Unpacker()
+    unpacker.feed(data)
+    dtype_str, shape = unpacker.unpack()
+    offset = unpacker.tell()
+    arr = np.frombuffer(data, dtype=np.dtype(dtype_str), offset=offset).reshape(shape)
+    # frombuffer views are read-only; handlers mutate received params in place
+    # (aggregation accumulators), so pay one copy for a writable array
+    return arr.copy()
+
+
+def pack_payload(obj: Any) -> bytes:
+    """Serialize a message-params dict (nested dicts/lists/scalars/ndarrays)."""
+    return msgpack.packb(obj, default=_encode_hook, strict_types=False)
+
+
+def unpack_payload(data: bytes) -> Any:
+    return msgpack.unpackb(data, ext_hook=_ext_hook, strict_map_key=False)
+
+
+class Message:
+    """Key-value message flowing between FL actors.
+
+    Same surface as the reference (``message.py:5``): ``msg_type``,
+    ``sender_id``/``receiver_id``, ``add_params``/``get``, plus the well-known
+    keys the managers rely on.
+    """
+
+    MSG_ARG_KEY_OPERATION = "operation"
+    MSG_ARG_KEY_TYPE = "msg_type"
+    MSG_ARG_KEY_SENDER = "sender"
+    MSG_ARG_KEY_RECEIVER = "receiver"
+
+    MSG_ARG_KEY_MODEL_PARAMS = "model_params"
+    MSG_ARG_KEY_MODEL_PARAMS_URL = "model_params_url"
+    MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
+    MSG_ARG_KEY_CLIENT_STATUS = "client_status"
+    MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
+    MSG_ARG_KEY_LOCAL_METRICS = "local_metrics"
+    MSG_ARG_KEY_ROUND_INDEX = "round_idx"
+
+    MSG_OPERATION_SEND = "send"
+    MSG_OPERATION_RECEIVE = "receive"
+    MSG_OPERATION_BROADCAST = "broadcast"
+
+    def __init__(self, type: Any = 0, sender_id: int = 0, receiver_id: int = 0):
+        self.type = type
+        self.sender_id = int(sender_id)
+        self.receiver_id = int(receiver_id)
+        self.msg_params: Dict[str, Any] = {
+            Message.MSG_ARG_KEY_TYPE: type,
+            Message.MSG_ARG_KEY_SENDER: int(sender_id),
+            Message.MSG_ARG_KEY_RECEIVER: int(receiver_id),
+        }
+
+    # --- reference API ------------------------------------------------------
+    def init(self, msg_params: Dict[str, Any]) -> None:
+        self.msg_params = msg_params
+        self.type = msg_params.get(Message.MSG_ARG_KEY_TYPE)
+        self.sender_id = int(msg_params.get(Message.MSG_ARG_KEY_SENDER, 0))
+        self.receiver_id = int(msg_params.get(Message.MSG_ARG_KEY_RECEIVER, 0))
+
+    def get_sender_id(self) -> int:
+        return self.sender_id
+
+    def get_receiver_id(self) -> int:
+        return self.receiver_id
+
+    def add_params(self, key: str, value: Any) -> None:
+        self.msg_params[key] = value
+
+    add = add_params
+
+    def get_params(self) -> Dict[str, Any]:
+        return self.msg_params
+
+    def get(self, key: str, default: Any = None) -> Any:
+        return self.msg_params.get(key, default)
+
+    def get_type(self) -> Any:
+        return self.msg_params.get(Message.MSG_ARG_KEY_TYPE)
+
+    def get_content(self) -> Dict[str, Any]:
+        return self.msg_params
+
+    def items(self) -> Iterator[Tuple[str, Any]]:
+        return iter(self.msg_params.items())
+
+    # --- codec --------------------------------------------------------------
+    def to_bytes(self) -> bytes:
+        return pack_payload(self.msg_params)
+
+    @classmethod
+    def from_bytes(cls, data: bytes) -> "Message":
+        msg = cls()
+        msg.init(unpack_payload(data))
+        return msg
+
+    def __repr__(self) -> str:
+        keys = [k for k in self.msg_params if k != Message.MSG_ARG_KEY_MODEL_PARAMS]
+        return (f"Message(type={self.type}, {self.sender_id}->{self.receiver_id}, "
+                f"keys={keys})")

@@ -1,0 +1,128 @@
+"""Decentralized-FL topology managers: neighbor graphs + mixing matrices.
+
+Parity: reference ``core/distributed/topology/`` — ``BaseTopologyManager``
+(base_topology_manager.py:4), ``SymmetricTopologyManager:7`` (ring +
+Watts-Strogatz random links, row-normalized symmetric weights) and
+``AsymmetricTopologyManager:7`` (directed variant). Redesign: the mixing
+matrix is returned as a dense ``np.ndarray`` suitable for feeding straight
+into a jitted gossip step (neighbor exchange = ``lax.ppermute`` /
+matrix-weighted psum over the mesh, see ``algorithms/decentralized.py``).
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import List
+
+import numpy as np
+
+
+class BaseTopologyManager(abc.ABC):
+    @abc.abstractmethod
+    def generate_topology(self) -> None:
+        ...
+
+    @abc.abstractmethod
+    def get_in_neighbor_idx_list(self, node_index: int) -> List[int]:
+        ...
+
+    @abc.abstractmethod
+    def get_out_neighbor_idx_list(self, node_index: int) -> List[int]:
+        ...
+
+    def get_in_neighbor_weights(self, node_index: int) -> np.ndarray:
+        return self.topology[:, node_index]
+
+    def get_out_neighbor_weights(self, node_index: int) -> np.ndarray:
+        return self.topology[node_index]
+
+
+def _ring_adjacency(n: int, neighbor_num: int) -> np.ndarray:
+    """Symmetric ring lattice: each node linked to neighbor_num nearest peers
+    (neighbor_num//2 on each side), plus self-loop."""
+    adj = np.eye(n, dtype=np.float64)
+    half = max(1, neighbor_num // 2)
+    for offset in range(1, half + 1):
+        for i in range(n):
+            adj[i, (i + offset) % n] = 1.0
+            adj[i, (i - offset) % n] = 1.0
+    return adj
+
+
+def _row_normalize(adj: np.ndarray) -> np.ndarray:
+    return adj / adj.sum(axis=1, keepdims=True)
+
+
+class SymmetricTopologyManager(BaseTopologyManager):
+    """Undirected ring + random extra links. The reference symmetrizes a
+    row-normalized matrix (symmetric_topology_manager.py:7), which is no
+    longer stochastic; here the mixing matrix uses Metropolis-Hastings
+    weights, which are symmetric AND doubly stochastic — the condition DSGD
+    convergence proofs actually assume."""
+
+    def __init__(self, n: int, neighbor_num: int = 2, seed: int = 0):
+        self.n = int(n)
+        self.neighbor_num = int(neighbor_num)
+        self.seed = int(seed)
+        self.topology = np.zeros((n, n))
+
+    def generate_topology(self) -> None:
+        rng = np.random.RandomState(self.seed)
+        adj = _ring_adjacency(self.n, self.neighbor_num)
+        # Watts-Strogatz-style random shortcuts: one extra undirected link per node
+        if self.n > self.neighbor_num + 2:
+            for i in range(self.n):
+                j = int(rng.randint(self.n))
+                adj[i, j] = adj[j, i] = 1.0
+        # Metropolis-Hastings: w_ij = 1/(1+max(deg_i, deg_j)) on edges,
+        # diagonal absorbs the remainder
+        deg = adj.sum(axis=1) - 1.0  # exclude self-loop
+        w = np.zeros_like(adj)
+        for i in range(self.n):
+            for j in range(self.n):
+                if i != j and adj[i, j] > 0:
+                    w[i, j] = 1.0 / (1.0 + max(deg[i], deg[j]))
+        np.fill_diagonal(w, 1.0 - w.sum(axis=1))
+        self.topology = w
+
+    def get_in_neighbor_idx_list(self, node_index: int) -> List[int]:
+        return [j for j in range(self.n)
+                if j != node_index and self.topology[j, node_index] > 0]
+
+    def get_out_neighbor_idx_list(self, node_index: int) -> List[int]:
+        return [j for j in range(self.n)
+                if j != node_index and self.topology[node_index, j] > 0]
+
+
+class AsymmetricTopologyManager(BaseTopologyManager):
+    """Directed variant: out-links are ring + random, in/out weights differ
+    (reference asymmetric_topology_manager.py:7)."""
+
+    def __init__(self, n: int, neighbor_num: int = 2, seed: int = 0):
+        self.n = int(n)
+        self.neighbor_num = int(neighbor_num)
+        self.seed = int(seed)
+        self.topology = np.zeros((n, n))
+
+    def generate_topology(self) -> None:
+        rng = np.random.RandomState(self.seed)
+        adj = _ring_adjacency(self.n, self.neighbor_num)
+        if self.n > self.neighbor_num + 2:
+            for i in range(self.n):
+                j = int(rng.randint(self.n))
+                adj[i, j] = 1.0  # directed shortcut only
+        self.topology = _row_normalize(adj)
+
+    def get_in_neighbor_idx_list(self, node_index: int) -> List[int]:
+        return [j for j in range(self.n)
+                if j != node_index and self.topology[j, node_index] > 0]
+
+    def get_out_neighbor_idx_list(self, node_index: int) -> List[int]:
+        return [j for j in range(self.n)
+                if j != node_index and self.topology[node_index, j] > 0]
+
+
+def ring_mixing_matrix(n: int) -> np.ndarray:
+    """Plain ring with self + two neighbors at weight 1/3 — the canonical
+    DSGD mixing matrix; feeds the ppermute-based gossip step."""
+    return _row_normalize(_ring_adjacency(n, 2))

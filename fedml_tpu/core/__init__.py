@@ -19,6 +19,8 @@ from .partition import (
     partition_class_samples_with_dirichlet_distribution,
     homo_partition,
 )
+from .robust import RobustAggregator, coordinate_median, norm_clip_update, trimmed_mean
+from .scheduler import balanced_client_schedule, dp_schedule, even_client_schedule
 
 __all__ = [
     "ClientTrainer",
@@ -30,4 +32,11 @@ __all__ = [
     "non_iid_partition_with_dirichlet_distribution",
     "partition_class_samples_with_dirichlet_distribution",
     "homo_partition",
+    "RobustAggregator",
+    "coordinate_median",
+    "norm_clip_update",
+    "trimmed_mean",
+    "dp_schedule",
+    "even_client_schedule",
+    "balanced_client_schedule",
 ]

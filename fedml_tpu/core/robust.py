@@ -1,0 +1,129 @@
+"""Robust aggregation defenses: norm clipping, weak DP, coordinate median.
+
+Parity: reference ``core/robustness/robust_aggregation.py:41``
+(``norm_diff_clipping:46``, ``add_noise:61``, ``coordinate_median_agg:66``).
+Redesign: defenses are pure pytree functions over *stacked* client updates
+(leading client axis), so they jit and vmap — a whole cohort is clipped in one
+fused XLA program instead of a per-client Python loop, and they slot directly
+into ``FedAlgorithm.aggregate``. BatchNorm running stats are excluded from
+clipping by name, matching the reference's ``is_weight_param`` filter
+(robust_aggregation.py:34-39).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+_NON_WEIGHT_KEYS = ("running_mean", "running_var", "num_batches_tracked", "batch_stats")
+
+
+def _is_weight_path(path) -> bool:
+    names = [getattr(p, "key", getattr(p, "name", "")) for p in path]
+    return not any(any(nk in str(n) for nk in _NON_WEIGHT_KEYS) for n in names)
+
+
+def global_norm(tree: PyTree, weights_only: bool = False) -> jax.Array:
+    """L2 norm over all (weight) leaves of a pytree."""
+    if weights_only:
+        leaves = [
+            v for p, v in jax.tree_util.tree_leaves_with_path(tree) if _is_weight_path(p)
+        ]
+    else:
+        leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves))
+
+
+def norm_clip_update(update: PyTree, norm_bound: float) -> PyTree:
+    """Scale one client's update so ‖update‖₂ ≤ norm_bound (reference
+    ``norm_diff_clipping:46`` computes the same on (local - global)); batch
+    stats pass through unscaled, as the reference excludes them."""
+    norm = global_norm(update, weights_only=True)
+    scale = 1.0 / jnp.maximum(1.0, norm / norm_bound)
+
+    def _clip(path, leaf):
+        return leaf * scale if _is_weight_path(path) else leaf
+
+    return jax.tree_util.tree_map_with_path(_clip, update)
+
+
+def norm_clip_stacked(stacked_updates: PyTree, norm_bound: float) -> PyTree:
+    """vmap of norm_clip_update over the leading client axis."""
+    return jax.vmap(lambda u: norm_clip_update(u, norm_bound))(stacked_updates)
+
+
+def add_gaussian_noise(tree: PyTree, stddev: float, rng: jax.Array) -> PyTree:
+    """Weak-DP Gaussian noise on the aggregate (reference ``add_noise:61``)."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    keys = jax.random.split(rng, len(leaves))
+    noised = [
+        leaf + stddev * jax.random.normal(k, leaf.shape, leaf.dtype)
+        for leaf, k in zip(leaves, keys)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, noised)
+
+
+def coordinate_median(stacked_updates: PyTree) -> PyTree:
+    """Coordinate-wise median over the leading client axis (Yin et al. 2018;
+    reference ``coordinate_median_agg:66`` — there a vectorize/concat/median/
+    unflatten dance over state_dicts; here one tree_map of jnp.median)."""
+    return jax.tree_util.tree_map(lambda x: jnp.median(x, axis=0), stacked_updates)
+
+
+def trimmed_mean(stacked_updates: PyTree, trim_ratio: float = 0.1) -> PyTree:
+    """Coordinate-wise β-trimmed mean (same paper as coordinate median; the
+    reference doesn't ship it but lists it in its robustness docs)."""
+
+    def _tm(x):
+        n = x.shape[0]
+        k = int(n * trim_ratio)
+        s = jnp.sort(x, axis=0)
+        return jnp.mean(s[k: n - k if n - k > k else k + 1], axis=0)
+
+    return jax.tree_util.tree_map(_tm, stacked_updates)
+
+
+@dataclasses.dataclass(frozen=True)
+class RobustAggregator:
+    """Config-driven defense bundle (reference ``RobustAggregator:41``).
+
+    defense_type: 'norm_diff_clipping' | 'weak_dp' | 'coordinate_median' |
+    'trimmed_mean' | None. Call :meth:`aggregate` with stacked updates and
+    normalized weights; returns the defended aggregate.
+    """
+
+    defense_type: Optional[str] = None
+    norm_bound: float = 1.0
+    stddev: float = 0.0
+    trim_ratio: float = 0.1
+
+    def aggregate(self, stacked_updates: PyTree, weights: jax.Array, rng=None) -> PyTree:
+        w = weights / jnp.sum(weights)
+
+        def weighted_mean(tree):
+            return jax.tree_util.tree_map(
+                lambda x: jnp.tensordot(w.astype(x.dtype), x, axes=1), tree
+            )
+
+        if self.defense_type in (None, "none"):
+            return weighted_mean(stacked_updates)
+        if self.defense_type == "norm_diff_clipping":
+            return weighted_mean(norm_clip_stacked(stacked_updates, self.norm_bound))
+        if self.defense_type == "weak_dp":
+            if rng is None:
+                raise ValueError(
+                    "weak_dp requires a fresh per-round rng; a fixed default "
+                    "key would add the same noise every round (no privacy)"
+                )
+            clipped = weighted_mean(norm_clip_stacked(stacked_updates, self.norm_bound))
+            return add_gaussian_noise(clipped, self.stddev, rng)
+        if self.defense_type == "coordinate_median":
+            return coordinate_median(stacked_updates)
+        if self.defense_type == "trimmed_mean":
+            return trimmed_mean(stacked_updates, self.trim_ratio)
+        raise ValueError(f"unknown defense_type '{self.defense_type}'")

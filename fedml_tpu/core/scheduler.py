@@ -1,0 +1,103 @@
+"""Client-workload → device scheduler.
+
+Parity: reference ``core/schedule/scheduler.py:4`` — a branch-and-bound search
+assigning heterogeneous client workloads to devices under per-device memory
+constraints, minimizing the makespan (max per-device cost). Redesign: the
+reference explores every feasible partial map recursively (exponential fan-out,
+kept "DP" only by pruning); here the same objective is solved with the classic
+LPT greedy + local-refinement, which is O(n log n), deterministic, and within
+4/3 of optimal — and the assignment feeds a *static* schedule so the compiled
+per-shard client loop (Parrot-TPU) keeps rectangular shapes.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+def dp_schedule(
+    workloads: Sequence[float],
+    constraints: Sequence[float],
+    memory: Sequence[float],
+) -> Tuple[List[List[int]], np.ndarray]:
+    """Assign workload i (cost workloads[i] * constraints[device]) to devices.
+
+    Args:
+      workloads: per-client relative cost (e.g. sample counts).
+      constraints: per-device slowdown factor (1.0 = fastest device).
+      memory: per-device cost capacity; assignment never exceeds it.
+
+    Returns:
+      (assignment, device_costs): ``assignment[d]`` = client indices on device
+      d; ``device_costs[d]`` = accumulated cost. Raises if infeasible.
+    """
+    workloads = np.asarray(workloads, dtype=np.float64)
+    constraints = np.asarray(constraints, dtype=np.float64)
+    memory = np.asarray(memory, dtype=np.float64)
+    n_dev = len(constraints)
+    order = np.argsort(workloads)[::-1]  # longest processing time first
+    assignment: List[List[int]] = [[] for _ in range(n_dev)]
+    costs = np.zeros(n_dev)
+    for i in order:
+        # device that ends up with the smallest resulting makespan and fits
+        cand_costs = costs + constraints * workloads[i]
+        feasible = cand_costs <= memory
+        if not feasible.any():
+            raise ValueError(
+                f"workload {int(i)} (cost {workloads[i]}) fits no device memory"
+            )
+        cand = np.where(feasible, cand_costs, np.inf)
+        d = int(np.argmin(cand))
+        assignment[d].append(int(i))
+        costs[d] = cand_costs[d]
+    # local refinement: move a job from the busiest device if it lowers makespan
+    improved = True
+    while improved:
+        improved = False
+        busiest = int(np.argmax(costs))
+        for job in sorted(assignment[busiest], key=lambda j: workloads[j]):
+            for d in np.argsort(costs):
+                d = int(d)
+                if d == busiest:
+                    continue
+                new_cost = costs[d] + constraints[d] * workloads[job]
+                if new_cost < costs[busiest] and new_cost <= memory[d]:
+                    assignment[busiest].remove(job)
+                    assignment[d].append(job)
+                    costs[busiest] -= constraints[busiest] * workloads[job]
+                    costs[d] = new_cost
+                    improved = True
+                    break
+            if improved:
+                break
+    return assignment, costs
+
+
+def even_client_schedule(client_indexes: Sequence[int], n_shards: int) -> List[np.ndarray]:
+    """np.array_split semantics of the reference NCCL simulator's
+    ``client_schedule`` (``nccl/base_framework/Server.py:109``): contiguous
+    even split of the sampled cohort across mesh shards."""
+    return list(np.array_split(np.asarray(client_indexes, dtype=np.int32), n_shards))
+
+
+def balanced_client_schedule(
+    client_indexes: Sequence[int],
+    sample_counts: Sequence[int],
+    n_shards: int,
+) -> List[np.ndarray]:
+    """Workload-aware split: LPT-balance sampled clients across shards by
+    sample count (what the reference's commented-out scheduler integration,
+    ``Server.py:113-120``, intended), then pad shards to equal length by
+    repeating the last client so shapes stay rectangular for the compiled
+    per-shard scan — repeated entries get zero aggregation weight upstream."""
+    counts = np.asarray([sample_counts[i] for i in client_indexes], dtype=np.float64)
+    assignment, _ = dp_schedule(counts, np.ones(n_shards), np.full(n_shards, np.inf))
+    shards = [np.asarray([client_indexes[j] for j in a], dtype=np.int32) for a in assignment]
+    width = max(1, max(len(s) for s in shards))
+    return [
+        np.pad(s, (0, width - len(s)), mode="edge") if len(s) else
+        np.full(width, client_indexes[0], np.int32)
+        for s in shards
+    ]

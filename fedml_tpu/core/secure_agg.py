@@ -1,0 +1,286 @@
+"""Secure aggregation: Lagrange Coded Computing (LCC) + LightSecAgg protocol.
+
+Parity: reference ``core/mpc/secure_aggregation.py`` (``LCC_encoding_with_points:41``,
+``LCC_decoding_with_points:50``, ``model_masking:83``, ``mask_encoding:97``,
+``compute_aggregate_encoded_mask:126``) and the LightSecAgg server flow
+(``cross_device/server_mnn_lsa/fedml_aggregator.py:33-89``).
+
+Redesign: prime-field arithmetic stays on the host (int64 modular math maps
+poorly onto the MXU — SURVEY.md §7 hard parts); the TPU only ever sees the
+masked fixed-point tensors. Lagrange coefficient generation is vectorized
+numpy (the reference loops Python-level over O(U·N) pairs), and modular
+inverses use Fermat via ``pow(a, p-2, p)``. The prime is 2³¹−1 so products of
+two residues fit int64 without overflow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import secrets
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+PyTree = Any
+
+DEFAULT_PRIME = (1 << 31) - 1  # Mersenne prime M31
+
+
+# --- field primitives -------------------------------------------------------
+
+def modular_inv(a: int, p: int = DEFAULT_PRIME) -> int:
+    """Reference ``modular_inv`` (extended Euclid); here Fermat's little theorem."""
+    return pow(int(a) % p, p - 2, p)
+
+
+def _mod_matmul(a: np.ndarray, b: np.ndarray, p: int) -> np.ndarray:
+    """(a @ b) mod p without int64 overflow: operands are reduced first, and
+    the contraction is chunked so each partial sum stays below 2**62."""
+    a = np.mod(a, p).astype(np.int64)
+    b = np.mod(b, p).astype(np.int64)
+    # max term = (p-1)^2 < 2^62; chunk so that chunk_size terms can't overflow
+    chunk = max(1, (1 << 62) // int(p - 1) ** 2)
+    out = np.zeros((a.shape[0], b.shape[1]), dtype=np.int64)
+    for start in range(0, a.shape[1], chunk):
+        out = np.mod(out + a[:, start:start + chunk] @ b[start:start + chunk], p)
+    return out
+
+
+def lagrange_coeffs(
+    alphas: Sequence[int], betas: Sequence[int], p: int = DEFAULT_PRIME
+) -> np.ndarray:
+    """U[i, j] = ℓ_j(alpha_i) — the Lagrange basis poly through points betas
+    evaluated at alphas (reference ``gen_Lagrange_coeffs:58``, vectorized)."""
+    alphas = np.asarray(alphas, dtype=np.int64) % p
+    betas = np.asarray(betas, dtype=np.int64) % p
+    nb = len(betas)
+    # w[j] = prod_{o != j} (beta_j - beta_o) mod p
+    w = np.ones(nb, dtype=np.int64)
+    for j in range(nb):
+        for o in range(nb):
+            if o != j:
+                w[j] = (w[j] * ((betas[j] - betas[o]) % p)) % p
+    # l[i] = prod_o (alpha_i - beta_o) mod p
+    l = np.ones(len(alphas), dtype=np.int64)
+    for o in range(nb):
+        l = (l * ((alphas - betas[o]) % p)) % p
+    U = np.zeros((len(alphas), nb), dtype=np.int64)
+    for j in range(nb):
+        denom = np.mod((alphas - betas[j]) * w[j], p)
+        inv = np.array([modular_inv(d, p) for d in denom], dtype=np.int64)
+        U[:, j] = np.mod(l * inv, p)
+    # coincident points: ℓ_j(beta_j) = 1 exactly (the formula above hits 0·0⁻¹)
+    for i, a in enumerate(alphas):
+        hits = np.where(betas == a)[0]
+        if hits.size:
+            U[i, :] = 0
+            U[i, hits[0]] = 1
+    return U
+
+
+def lcc_encode(
+    X: np.ndarray, alphas: Sequence[int], betas: Sequence[int], p: int = DEFAULT_PRIME
+) -> np.ndarray:
+    """Encode the (m, d) secret matrix X (rows = poly values at betas) into
+    evaluations at alphas (reference ``LCC_encoding_with_points:41``)."""
+    return _mod_matmul(lagrange_coeffs(alphas, betas, p), X, p)
+
+
+def lcc_decode(
+    shares: np.ndarray,
+    eval_points: Sequence[int],
+    target_points: Sequence[int],
+    p: int = DEFAULT_PRIME,
+) -> np.ndarray:
+    """Interpolate from evaluations back to target points (reference
+    ``LCC_decoding_with_points:50``)."""
+    return _mod_matmul(lagrange_coeffs(target_points, eval_points, p), shares, p)
+
+
+# --- fixed-point pytree <-> finite field ------------------------------------
+
+def tree_dimensions(tree: PyTree) -> List[int]:
+    """Per-leaf flat sizes (reference ``model_dimension:178``)."""
+    import jax
+
+    return [int(np.prod(np.shape(x))) for x in jax.tree_util.tree_leaves(tree)]
+
+
+def quantize_tree(tree: PyTree, q_bits: int = 16, p: int = DEFAULT_PRIME) -> np.ndarray:
+    """Pytree → flat int64 field vector: round(x * 2^q), negatives wrapped to
+    the upper half of the field (reference ``transform_tensor_to_finite``)."""
+    import jax
+
+    flat = np.concatenate([
+        np.asarray(x, dtype=np.float64).ravel() for x in jax.tree_util.tree_leaves(tree)
+    ])
+    q = np.round(flat * (1 << q_bits)).astype(np.int64)
+    return np.mod(q, p)
+
+
+def dequantize_tree(
+    vec: np.ndarray, template: PyTree, q_bits: int = 16, p: int = DEFAULT_PRIME,
+    n_summands: int = 1,
+) -> PyTree:
+    """Inverse of quantize_tree; values in the upper half of the field are
+    negative (reference ``my_q_inv:150``). Correct as long as the true sum of
+    ``n_summands`` client vectors stays within ±(p-1)/2 after quantization."""
+    import jax
+
+    del n_summands  # magnitude headroom is the caller's contract, not a knob
+    vec = np.mod(np.asarray(vec, dtype=np.int64), p)
+    negative = vec > (p - 1) // 2
+    real = (vec - p * negative).astype(np.float64) / (1 << q_bits)
+    leaves = jax.tree_util.tree_leaves(template)
+    treedef = jax.tree_util.tree_structure(template)
+    out, pos = [], 0
+    for leaf in leaves:
+        d = int(np.prod(np.shape(leaf)))
+        out.append(real[pos: pos + d].reshape(np.shape(leaf)).astype(np.asarray(leaf).dtype))
+        pos += d
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# --- LightSecAgg protocol ---------------------------------------------------
+
+@dataclasses.dataclass
+class LightSecAggConfig:
+    num_clients: int                 # N
+    target_active: int               # U — #shares needed to reconstruct
+    privacy_guarantee: int           # T — colluding clients tolerated
+    model_dimension: int             # d (padded to multiple of U - T)
+    prime: int = DEFAULT_PRIME
+    q_bits: int = 16
+
+    @property
+    def chunk(self) -> int:
+        return self.target_active - self.privacy_guarantee
+
+    @property
+    def padded_dim(self) -> int:
+        return -(-self.model_dimension // self.chunk) * self.chunk
+
+    @property
+    def betas(self) -> np.ndarray:
+        return np.arange(1, self.num_clients + 1, dtype=np.int64)
+
+    @property
+    def alphas(self) -> np.ndarray:
+        return np.arange(self.num_clients + 1, self.num_clients + 1 + self.target_active, dtype=np.int64)
+
+
+class LightSecAggClient:
+    """Client side: generate a random mask, LCC-encode it into N shares
+    (reference ``mask_encoding:97``), mask the local update.
+
+    ``seed`` is for deterministic *tests only* — in deployment leave it None
+    so mask and noise come from OS entropy; a seed known to the server lets
+    it regenerate the mask and unmask this client's individual update.
+    (numpy's PCG is not a CSPRNG; a production deployment should swap in a
+    crypto-grade generator, as should the reference, which zeroes its noise
+    rows entirely — ``mask_encoding:112``.)
+    """
+
+    def __init__(self, cfg: LightSecAggConfig, client_id: int, seed: Optional[int] = None):
+        self.cfg = cfg
+        self.client_id = client_id
+        if seed is None:
+            self._rng = np.random.Generator(np.random.PCG64(secrets.randbits(128)))
+        else:
+            self._rng = np.random.Generator(np.random.PCG64([seed, client_id]))
+        self.local_mask = self._rng.integers(
+            0, cfg.prime, size=(cfg.padded_dim, 1), dtype=np.int64
+        )
+
+    def encode_mask_shares(self) -> np.ndarray:
+        """(N, padded_dim/(U-T)) — row j goes to client j."""
+        cfg = self.cfg
+        pad_rows = cfg.privacy_guarantee * cfg.padded_dim // cfg.chunk
+        noise = self._rng.integers(0, cfg.prime, size=(pad_rows, 1), dtype=np.int64)
+        lcc_in = np.concatenate([self.local_mask, noise], axis=0).reshape(
+            cfg.target_active, cfg.padded_dim // cfg.chunk
+        )
+        # secret rows sit at the alphas; shares are evaluations at the betas
+        # (reference mask_encoding:97 places beta_s=1..N for clients,
+        # alpha_s=N+1..N+U for the secret+noise rows)
+        return lcc_encode(lcc_in, cfg.betas, cfg.alphas, cfg.prime)
+
+    def mask_update(self, update: PyTree) -> np.ndarray:
+        """Quantize + add mask in the field (reference ``model_masking:83``)."""
+        cfg = self.cfg
+        q = quantize_tree(update, cfg.q_bits, cfg.prime)
+        q = np.pad(q, (0, cfg.padded_dim - len(q)))
+        return np.mod(q + self.local_mask.ravel(), cfg.prime)
+
+
+class LightSecAggServer:
+    """Server side: collect per-client aggregate-mask shares from the active
+    set, LCC-decode the aggregate mask, unmask the summed update (reference
+    ``server_mnn_lsa/fedml_aggregator.py:33-89`` +
+    ``compute_aggregate_encoded_mask:126``)."""
+
+    def __init__(self, cfg: LightSecAggConfig):
+        self.cfg = cfg
+
+    @staticmethod
+    def aggregate_encoded_masks(shares_for_me: Dict[int, np.ndarray], active: Sequence[int], p: int) -> np.ndarray:
+        """Each surviving client sums the shares it holds from active clients."""
+        total = np.zeros_like(next(iter(shares_for_me.values())))
+        for cid in active:
+            total = np.mod(total + shares_for_me[cid], p)
+        return total
+
+    def reconstruct_aggregate_mask(
+        self, agg_shares: Dict[int, np.ndarray], active: Sequence[int]
+    ) -> np.ndarray:
+        cfg = self.cfg
+        surviving = sorted(agg_shares)[: cfg.target_active]
+        if len(surviving) < cfg.target_active:
+            raise ValueError(
+                f"need {cfg.target_active} surviving clients, got {len(surviving)}"
+            )
+        f_eval = np.stack([agg_shares[cid] for cid in surviving])  # (U, d/chunk)
+        eval_points = cfg.betas[np.asarray(surviving)]
+        # reconstruct all U secret rows at the alphas; the first U-T rows are
+        # the true aggregate mask, the last T are summed noise — dropped
+        recon = lcc_decode(f_eval, eval_points, cfg.alphas, cfg.prime)
+        return recon[: cfg.chunk].reshape(-1)
+
+    def unmask(
+        self,
+        summed_masked: np.ndarray,
+        aggregate_mask: np.ndarray,
+        template: PyTree,
+        n_active: int,
+    ) -> PyTree:
+        cfg = self.cfg
+        unmasked = np.mod(summed_masked - aggregate_mask, cfg.prime)
+        return dequantize_tree(unmasked, template, cfg.q_bits, cfg.prime, n_summands=n_active)
+
+
+def secure_aggregate(
+    updates: List[PyTree], cfg: LightSecAggConfig, active: Sequence[int],
+    seed: Optional[int] = None,
+) -> PyTree:
+    """End-to-end LightSecAgg round over in-process clients (used by the
+    TurboAggregate/LSA simulators and tests): returns the *sum* of active
+    clients' updates, reconstructed without seeing any individual update."""
+    clients = [LightSecAggClient(cfg, cid, seed) for cid in range(cfg.num_clients)]
+    # offline: all-to-all mask-share exchange; shares_held[j][i] = share of
+    # client i's mask held by client j
+    encoded = {c.client_id: c.encode_mask_shares() for c in clients}
+    shares_held = {
+        j: {i: encoded[i][j] for i in range(cfg.num_clients)} for j in range(cfg.num_clients)
+    }
+    # online: active clients upload masked updates; server sums in the field
+    summed = np.zeros(cfg.padded_dim, dtype=np.int64)
+    for cid in active:
+        summed = np.mod(summed + clients[cid].mask_update(updates[cid]), cfg.prime)
+    # unmasking: surviving clients (here: all active) send aggregate-mask shares
+    server = LightSecAggServer(cfg)
+    agg_shares = {
+        j: LightSecAggServer.aggregate_encoded_masks(shares_held[j], active, cfg.prime)
+        for j in list(active)[: cfg.target_active]
+    }
+    agg_mask = server.reconstruct_aggregate_mask(agg_shares, active)
+    return server.unmask(summed, agg_mask, updates[0], n_active=len(active))

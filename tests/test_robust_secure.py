@@ -1,0 +1,141 @@
+"""Robust aggregation defenses + LCC secure aggregation + scheduler."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.core.robust import (
+    RobustAggregator,
+    coordinate_median,
+    global_norm,
+    norm_clip_update,
+)
+from fedml_tpu.core.scheduler import balanced_client_schedule, dp_schedule, even_client_schedule
+from fedml_tpu.core.secure_agg import (
+    DEFAULT_PRIME,
+    LightSecAggConfig,
+    dequantize_tree,
+    lagrange_coeffs,
+    lcc_decode,
+    lcc_encode,
+    modular_inv,
+    quantize_tree,
+    secure_aggregate,
+)
+
+
+def _stack(trees):
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def test_norm_clip_bounds_update_norm():
+    update = {"w": jnp.full((10,), 3.0), "b": jnp.ones(())}
+    clipped = norm_clip_update(update, norm_bound=1.0)
+    assert float(global_norm(clipped)) <= 1.0 + 1e-5
+    # direction preserved
+    ratio = clipped["w"][0] / clipped["b"]
+    assert np.isclose(float(ratio), 3.0, rtol=1e-5)
+
+
+def test_norm_clip_passthrough_below_bound():
+    update = {"w": jnp.full((4,), 0.1)}
+    clipped = norm_clip_update(update, norm_bound=10.0)
+    np.testing.assert_allclose(np.asarray(clipped["w"]), 0.1, rtol=1e-6)
+
+
+def test_coordinate_median_rejects_outlier():
+    honest = [{"w": jnp.ones(5) * v} for v in (0.9, 1.0, 1.1)]
+    byzantine = {"w": jnp.ones(5) * 1e6}
+    stacked = _stack(honest + [byzantine])
+    agg = coordinate_median(stacked)
+    np.testing.assert_allclose(np.asarray(agg["w"]), 1.05, rtol=1e-5)
+
+
+def test_robust_aggregator_weak_dp_noise_scale():
+    ra = RobustAggregator(defense_type="weak_dp", norm_bound=100.0, stddev=0.1)
+    stacked = {"w": jnp.ones((8, 1000))}
+    agg = ra.aggregate(stacked, jnp.ones(8), rng=jax.random.PRNGKey(0))
+    noise = np.asarray(agg["w"]) - 1.0
+    assert 0.05 < noise.std() < 0.2
+
+
+def test_lagrange_interpolation_identity():
+    # encoding at the defining points returns the secret rows
+    X = np.arange(12, dtype=np.int64).reshape(3, 4) % DEFAULT_PRIME
+    betas = [1, 2, 3]
+    out = lcc_encode(X, betas, betas)
+    np.testing.assert_array_equal(out, X)
+
+
+def test_lcc_encode_decode_roundtrip():
+    rng = np.random.RandomState(0)
+    X = rng.randint(0, DEFAULT_PRIME, size=(4, 6)).astype(np.int64)
+    alphas = [11, 12, 13, 14]       # secret points
+    betas = [1, 2, 3, 4, 5, 6]      # share points
+    shares = lcc_encode(X, betas, alphas)
+    # any 4 of the 6 shares reconstruct
+    keep = [0, 2, 3, 5]
+    recon = lcc_decode(shares[keep], [betas[i] for i in keep], alphas)
+    np.testing.assert_array_equal(recon, X)
+
+
+def test_modular_inv():
+    for a in (2, 17, 123456789):
+        assert (a * modular_inv(a)) % DEFAULT_PRIME == 1
+
+
+def test_quantize_dequantize_roundtrip():
+    tree = {"w": np.array([[0.5, -0.25], [1.5, 0.0]], np.float32), "b": np.array([-3.0], np.float32)}
+    vec = quantize_tree(tree, q_bits=16)
+    out = dequantize_tree(vec, tree, q_bits=16)
+    np.testing.assert_allclose(out["w"], tree["w"], atol=1e-4)
+    np.testing.assert_allclose(out["b"], tree["b"], atol=1e-4)
+
+
+def test_lightsecagg_end_to_end_sum():
+    n = 6
+    updates = [
+        {"w": np.full((5,), 0.1 * (i + 1), np.float32), "b": np.array([float(i)], np.float32)}
+        for i in range(n)
+    ]
+    cfg = LightSecAggConfig(
+        num_clients=n, target_active=4, privacy_guarantee=1,
+        model_dimension=6, q_bits=12,
+    )
+    active = [0, 2, 3, 5]
+    agg = secure_aggregate(updates, cfg, active, seed=42)
+    expected_w = sum(updates[i]["w"] for i in active)
+    expected_b = sum(updates[i]["b"] for i in active)
+    np.testing.assert_allclose(agg["w"], expected_w, atol=1e-2)
+    np.testing.assert_allclose(agg["b"], expected_b, atol=1e-2)
+
+
+def test_dp_schedule_respects_memory_and_balances():
+    assignment, costs = dp_schedule(
+        workloads=[10, 10, 10, 1, 1, 1], constraints=[1.0, 1.0], memory=[100, 100]
+    )
+    assert sorted(i for a in assignment for i in a) == list(range(6))
+    assert abs(costs[0] - costs[1]) <= 10
+
+
+def test_dp_schedule_infeasible_raises():
+    import pytest
+
+    with pytest.raises(ValueError):
+        dp_schedule([100], [1.0], [10])
+
+
+def test_even_schedule_matches_array_split():
+    shards = even_client_schedule([3, 1, 4, 1, 5, 9, 2], 3)
+    np.testing.assert_array_equal(shards[0], [3, 1, 4])
+    assert sum(len(s) for s in shards) == 7
+
+
+def test_balanced_schedule_rectangular():
+    shards = balanced_client_schedule(
+        [0, 1, 2, 3, 4], sample_counts=[100, 1, 1, 1, 1], n_shards=2
+    )
+    widths = {len(s) for s in shards}
+    assert len(widths) == 1  # rectangular
+    covered = {int(i) for s in shards for i in s}
+    assert covered == {0, 1, 2, 3, 4}
