@@ -18,8 +18,10 @@ import jax.numpy as jnp
 import optax
 
 from ..core.algframe import ClientOutput, FedAlgorithm
+from ..core.robust import RobustAggregator, add_gaussian_noise
 from ..constants import (
     FEDML_FEDERATED_OPTIMIZER_FEDAVG,
+    FEDML_FEDERATED_OPTIMIZER_FEDAVG_ROBUST,
     FEDML_FEDERATED_OPTIMIZER_FEDNOVA,
     FEDML_FEDERATED_OPTIMIZER_FEDOPT,
     FEDML_FEDERATED_OPTIMIZER_FEDPROX,
@@ -57,9 +59,54 @@ def get_algorithm(
     server_optimizer: str = "sgd",
     server_momentum: float = 0.9,
     client_fraction: float = 1.0,
+    defense_type: Optional[str] = None,
+    norm_bound: float = 5.0,
+    stddev: float = 0.0,
+    trim_ratio: float = 0.1,
+    dp_seed: int = 0,
 ) -> FedAlgorithm:
     """Build the named optimizer's FedAlgorithm bundle."""
     name_l = name.lower()
+
+    if name_l == FEDML_FEDERATED_OPTIMIZER_FEDAVG_ROBUST.lower():
+        # Reference: simulation/mpi/fedavg_robust/FedAvgRobustAggregator.py:156
+        # wires core/robustness defenses into FedAvg aggregation. Here the
+        # defense is the FedAlgorithm.aggregate hook; weak-DP noise is added
+        # in server_update with a per-round key carried in server state so the
+        # noise is fresh every round.
+        ra = RobustAggregator(
+            defense_type=defense_type or "norm_diff_clipping",
+            norm_bound=norm_bound,
+            stddev=stddev,
+            trim_ratio=trim_ratio,
+        )
+        local_update = make_local_update(apply_fn, cfg, needs_dropout)
+        noisy = ra.defense_type == "weak_dp"
+        base_cfg = ra
+        if noisy:
+            # clip in aggregate; noise in server_update (needs a fresh key)
+            base_cfg = RobustAggregator(
+                defense_type="norm_diff_clipping", norm_bound=norm_bound
+            )
+
+        def aggregate(stacked, w):
+            return base_cfg.aggregate(stacked, w)
+
+        def init_server_state(params):
+            return jax.random.PRNGKey(dp_seed) if noisy else ()
+
+        def server_update(params, agg_delta, state):
+            if noisy:
+                state, sub = jax.random.split(state)
+                agg_delta = add_gaussian_noise(agg_delta, stddev, sub)
+            return tree_add(params, agg_delta), state
+
+        return FedAlgorithm(
+            name=name, init_server_state=init_server_state,
+            init_client_state=_no_state,
+            local_update=local_update, server_update=server_update,
+            aggregate=aggregate,
+        )
 
     if name_l == FEDML_FEDERATED_OPTIMIZER_FEDPROX.lower():
         # default mu=0.1 only when unset; an explicit 0.0 (mu-ablation) is honored

@@ -18,12 +18,16 @@ from .. import models as models_mod
 from ..algorithms import LocalTrainConfig, get_algorithm
 from ..parallel.mesh import AXIS_CLIENT, MeshConfig, create_mesh
 from .fed_sim import FedSimulator, SimConfig, reference_client_sampling
+from .hierarchical import HierarchicalFedSimulator
+from .decentralized import DecentralizedSimulator
 
 __all__ = [
     "FedSimulator",
     "SimConfig",
     "SimulatorSingleProcess",
     "SimulatorTPU",
+    "HierarchicalFedSimulator",
+    "DecentralizedSimulator",
     "reference_client_sampling",
     "build_simulator",
 ]
@@ -59,8 +63,48 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
         ),
     )
     needs_dropout = getattr(args, "model", "lr") in ("cnn",)
+    optimizer_name = str(getattr(args, "federated_optimizer", "FedAvg"))
+    sim_cfg = SimConfig(
+        comm_round=int(getattr(args, "comm_round", 10)),
+        client_num_in_total=int(getattr(args, "client_num_in_total", 10)),
+        client_num_per_round=int(getattr(args, "client_num_per_round", 10)),
+        batch_size=int(getattr(args, "batch_size", 32)),
+        frequency_of_the_test=int(getattr(args, "frequency_of_the_test", 5)),
+        seed=int(getattr(args, "random_seed", 0)),
+    )
+
+    # two-level and serverless variants use dedicated engines
+    if optimizer_name.lower() == "hierarchicalfl":
+        from ..algorithms import make_local_update
+
+        sim = HierarchicalFedSimulator(
+            fed_data, make_local_update(apply_fn, cfg, needs_dropout), variables,
+            sim_cfg,
+            group_num=int(getattr(args, "group_num", 2)),
+            group_comm_round=int(getattr(args, "group_comm_round", 2)),
+            mesh=mesh,
+        )
+        return sim, apply_fn
+    if optimizer_name.lower() == "decentralized":
+        from ..algorithms import make_local_update
+        from ..comm.topology import SymmetricTopologyManager
+
+        tm = SymmetricTopologyManager(
+            sim_cfg.client_num_in_total,
+            neighbor_num=int(getattr(args, "topology_neighbor_num", 2)),
+            seed=sim_cfg.seed,
+        )
+        tm.generate_topology()
+        sim = DecentralizedSimulator(
+            fed_data, make_local_update(apply_fn, cfg, needs_dropout), variables,
+            sim_cfg, mixing_matrix=tm.topology,
+            mode=str(getattr(args, "decentralized_mode", "dsgd")),
+            mesh=mesh,
+        )
+        return sim, apply_fn
+
     alg = get_algorithm(
-        str(getattr(args, "federated_optimizer", "FedAvg")),
+        optimizer_name,
         apply_fn,
         cfg,
         needs_dropout=needs_dropout,
@@ -69,14 +113,11 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
         server_momentum=float(getattr(args, "server_momentum", 0.9)),
         client_fraction=float(getattr(args, "client_num_per_round", 10))
         / max(float(getattr(args, "client_num_in_total", 10)), 1.0),
-    )
-    sim_cfg = SimConfig(
-        comm_round=int(getattr(args, "comm_round", 10)),
-        client_num_in_total=int(getattr(args, "client_num_in_total", 10)),
-        client_num_per_round=int(getattr(args, "client_num_per_round", 10)),
-        batch_size=int(getattr(args, "batch_size", 32)),
-        frequency_of_the_test=int(getattr(args, "frequency_of_the_test", 5)),
-        seed=int(getattr(args, "random_seed", 0)),
+        defense_type=getattr(args, "defense_type", None),
+        norm_bound=float(getattr(args, "norm_bound", 5.0)),
+        stddev=float(getattr(args, "stddev", 0.0)),
+        trim_ratio=float(getattr(args, "trim_ratio", 0.1)),
+        dp_seed=int(getattr(args, "random_seed", 0)),
     )
     sim = FedSimulator(fed_data, alg, variables, sim_cfg, mesh=mesh)
     return sim, apply_fn

@@ -1,0 +1,180 @@
+"""Decentralized FL: DSGD gossip + PushSum over a topology, compiled.
+
+Parity: reference ``simulation/sp/decentralized/`` — ``client_dsgd.py:122``
+(symmetric gossip averaging) and ``client_pushsum.py`` (directed PushSum with
+weight normalization), both driven by a ``TopologyManager`` mixing matrix.
+Redesign: all N node models live stacked on a leading node axis; one round =
+``vmap`` of the local update over nodes + the mixing step as a single
+``einsum('ij,j...->i...', W, stacked)`` — on a mesh the node axis shards over
+ICI and XLA lowers the mixing matmul to the neighbor exchange (for a pure
+ring this is exactly a ``ppermute`` pattern, SURVEY.md §2.8). The reference
+loops nodes in Python and exchanges deepcopied state dicts.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..algorithms.local_sgd import tree_add
+from ..data.federated import FederatedData
+from ..parallel.mesh import AXIS_CLIENT
+from ..parallel.sharding import replicated, shard_along
+from .fed_sim import SimConfig
+
+PyTree = Any
+
+
+def _mix(stacked: PyTree, W: jax.Array) -> PyTree:
+    """x_i <- sum_j W[i,j] x_j for every leaf (leading node axis)."""
+    return jax.tree.map(
+        lambda p: jnp.einsum(
+            "ij,j...->i...", W, p.astype(jnp.float32)
+        ).astype(p.dtype),
+        stacked,
+    )
+
+
+class DecentralizedSimulator:
+    """Every client is a node holding its own model; no server.
+
+    mode='dsgd': symmetric gossip — local update then mix with a (doubly)
+    stochastic W. mode='pushsum': directed graphs — mix (x, w) with a
+    column-stochastic W, train on the de-biased estimate z = x/w
+    (Nedić & Olshevsky 2016; reference client_pushsum.py).
+    """
+
+    def __init__(
+        self,
+        fed_data: FederatedData,
+        local_update: Callable,
+        init_variables: PyTree,
+        cfg: SimConfig,
+        mixing_matrix: np.ndarray,
+        mode: str = "dsgd",
+        mesh=None,
+    ):
+        self.fed = fed_data
+        self.local_update = local_update
+        self.cfg = cfg
+        self.mode = mode
+        self.mesh = mesh
+        self.n_nodes = int(mixing_matrix.shape[0])
+        assert self.n_nodes == cfg.client_num_in_total, "one node per client"
+        self.W = jnp.asarray(mixing_matrix, jnp.float32)
+        if mode == "pushsum":
+            # PushSum mixes along in-edges of a column-stochastic matrix
+            col_sums = np.asarray(mixing_matrix).sum(axis=0)
+            if not np.allclose(col_sums, 1.0, atol=1e-6):
+                self.W = jnp.asarray(mixing_matrix / col_sums[None, :], jnp.float32)
+        # stacked per-node params
+        self.stacked = jax.tree.map(
+            lambda p: jnp.broadcast_to(p[None], (self.n_nodes,) + p.shape), init_variables
+        )
+        self.push_weights = jnp.ones((self.n_nodes,), jnp.float32)
+        self.history: List[Dict[str, float]] = []
+        sizes = [len(v) for v in fed_data.train_data_local_dict.values()]
+        self.num_local_batches = max(1, -(-max(sizes) // cfg.batch_size))
+        self._round_step = self._build_round_step()
+
+    def _build_round_step(self) -> Callable:
+        local_update = self.local_update
+        W = self.W
+        N = self.n_nodes
+        mode = self.mode
+
+        def round_step(stacked, push_w, cohort, rng):
+            rngs = jax.random.split(rng, N)
+            if mode == "pushsum":
+                # de-bias: z_i = x_i / w_i; train on z, push updated mass
+                z = jax.tree.map(
+                    lambda p: p / push_w.reshape((-1,) + (1,) * (p.ndim - 1)), stacked
+                )
+                outs = jax.vmap(local_update, in_axes=(0, None, 0, 0))(z, (), cohort, rngs)
+                updated = tree_add(z, outs.update)
+                # re-weight by w before pushing so mass is conserved
+                x_push = jax.tree.map(
+                    lambda p: p * push_w.reshape((-1,) + (1,) * (p.ndim - 1)), updated
+                )
+                new_stacked = _mix(x_push, W)
+                new_push_w = W @ push_w
+            else:
+                outs = jax.vmap(local_update, in_axes=(0, None, 0, 0))(
+                    stacked, (), cohort, rngs
+                )
+                new_stacked = _mix(tree_add(stacked, outs.update), W)
+                new_push_w = push_w
+            # consensus distance: mean_i ||x_i - x_bar||^2 over all leaves
+            def _consensus(p):
+                mean = p.mean(axis=0, keepdims=True)
+                return jnp.sum(jnp.square((p - mean).astype(jnp.float32)))
+
+            cons = sum(_consensus(p) for p in jax.tree.leaves(new_stacked)) / N
+            return new_stacked, new_push_w, outs.metrics, cons
+
+        if self.mesh is not None:
+            mesh = self.mesh
+            node_sh = shard_along(mesh, AXIS_CLIENT, 0)
+            rep = replicated(mesh)
+            return jax.jit(
+                round_step,
+                in_shardings=(node_sh, rep, node_sh, rep),
+                out_shardings=(node_sh, rep, rep, rep),
+            )
+        return jax.jit(round_step)
+
+    def mean_params(self) -> PyTree:
+        if self.mode == "pushsum":
+            z = jax.tree.map(
+                lambda p: p / self.push_weights.reshape((-1,) + (1,) * (p.ndim - 1)),
+                self.stacked,
+            )
+            return jax.tree.map(lambda p: p.mean(axis=0), z)
+        return jax.tree.map(lambda p: p.mean(axis=0), self.stacked)
+
+    def run(self, apply_fn=None, log_fn=print) -> List[Dict[str, float]]:
+        cfg = self.cfg
+        rng = jax.random.PRNGKey(cfg.seed)
+        pack_rng = np.random.default_rng(cfg.seed)
+        all_nodes = np.arange(self.n_nodes)
+        for round_idx in range(cfg.comm_round):
+            t0 = time.perf_counter()
+            batches = self.fed.pack_clients(
+                all_nodes, cfg.batch_size, self.num_local_batches, rng=pack_rng
+            )
+            cohort = {
+                "x": jnp.asarray(batches.x),
+                "y": jnp.asarray(batches.y),
+                "mask": jnp.asarray(batches.mask),
+                "num_samples": jnp.asarray(batches.num_samples),
+            }
+            rng, step_rng = jax.random.split(rng)
+            self.stacked, self.push_weights, metrics, cons = self._round_step(
+                self.stacked, self.push_weights, cohort, step_rng
+            )
+            rec = {
+                "round": round_idx,
+                "round_time": time.perf_counter() - t0,
+                "train_loss": float(metrics["train_loss"].mean()),
+                "consensus_dist": float(cons),
+            }
+            if apply_fn is not None and (
+                round_idx % cfg.frequency_of_the_test == 0
+                or round_idx == cfg.comm_round - 1
+            ):
+                test = self.fed.test_data_global
+                logits = apply_fn(self.mean_params(), jnp.asarray(test.x), train=False)
+                rec["test_acc"] = float(
+                    (jnp.argmax(logits, -1) == jnp.asarray(test.y)).mean()
+                )
+            self.history.append(rec)
+            if log_fn:
+                log_fn(f"[d-round {round_idx}] " + " ".join(
+                    f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}"
+                    for k, v in rec.items() if k != "round"
+                ))
+        return self.history

@@ -1,0 +1,176 @@
+"""Hierarchical FL: two-level (group -> global) aggregation, compiled.
+
+Parity: reference ``simulation/sp/hierarchical_fl/trainer.py:10``
+(``HierachicalTrainer.train():77``) — clients are grouped (silo/edge tier);
+each group runs ``group_comm_round`` FedAvg rounds internally, then the global
+server averages the group models. The reference nests three Python loops
+(global round / group round / client); here one global round compiles to a
+single XLA program: ``vmap`` over all clients of all groups, group-wise
+aggregation as a ``segment_sum``, and a ``lax.scan`` over the inner group
+rounds. On a mesh this places the client axis over ICI with the group reduce
+as a psum — the same two-tier (ici, dcn) shape SURVEY.md §2.8 maps
+hierarchical aggregation onto.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..algorithms.local_sgd import tree_add
+from ..data.federated import FederatedData
+from ..parallel.mesh import AXIS_CLIENT
+from ..parallel.sharding import replicated, shard_along
+from .fed_sim import SimConfig, reference_client_sampling
+
+PyTree = Any
+
+
+class HierarchicalFedSimulator:
+    """FedAvg with an intermediate group tier.
+
+    ``group_num`` groups; the sampled cohort is split evenly across groups
+    (np.array_split semantics, like the reference's client schedule); each
+    global round runs ``group_comm_round`` compiled inner rounds.
+    """
+
+    def __init__(
+        self,
+        fed_data: FederatedData,
+        local_update: Callable,
+        init_variables: PyTree,
+        cfg: SimConfig,
+        group_num: int = 2,
+        group_comm_round: int = 2,
+        mesh=None,
+    ):
+        self.fed = fed_data
+        self.local_update = local_update
+        self.params = init_variables
+        self.cfg = cfg
+        self.group_num = int(group_num)
+        self.group_comm_round = int(group_comm_round)
+        self.mesh = mesh
+        self.history: List[Dict[str, float]] = []
+        sizes = [len(v) for v in fed_data.train_data_local_dict.values()]
+        self.num_local_batches = max(1, -(-max(sizes) // cfg.batch_size))
+        self._round_step = self._build_round_step()
+
+    def _build_round_step(self) -> Callable:
+        local_update = self.local_update
+        G = self.group_num
+        T = self.group_comm_round
+
+        def round_step(params, cohort, group_ids, rng):
+            C = cohort["num_samples"].shape[0]
+            # replicate global params into per-group models
+            group_params = jax.tree.map(
+                lambda p: jnp.broadcast_to(p[None], (G,) + p.shape), params
+            )
+
+            def group_round(gp, round_rng):
+                client_params = jax.tree.map(lambda p: p[group_ids], gp)
+                rngs = jax.random.split(round_rng, C)
+                outs = jax.vmap(local_update, in_axes=(0, None, 0, 0))(
+                    client_params, (), cohort, rngs
+                )
+                w = outs.weight.astype(jnp.float32)
+                w_group = jax.ops.segment_sum(w, group_ids, num_segments=G)
+                agg = jax.tree.map(
+                    lambda u: (
+                        jax.ops.segment_sum(
+                            u.astype(jnp.float32) * w.reshape((-1,) + (1,) * (u.ndim - 1)),
+                            group_ids,
+                            num_segments=G,
+                        )
+                        / jnp.maximum(w_group, 1.0).reshape((-1,) + (1,) * (u.ndim - 1))
+                    ).astype(u.dtype),
+                    outs.update,
+                )
+                gp = tree_add(gp, agg)
+                return gp, (outs.metrics, w_group)
+
+            group_params, (metrics, w_group) = jax.lax.scan(
+                group_round, group_params, jax.random.split(rng, T)
+            )
+            # global tier: sample-weighted mean of group models (last round's weights)
+            wg = w_group[-1]
+            total = jnp.maximum(wg.sum(), 1.0)
+            new_params = jax.tree.map(
+                lambda p: jnp.tensordot(
+                    wg / total, p.astype(jnp.float32), axes=(0, 0)
+                ).astype(p.dtype),
+                group_params,
+            )
+            return new_params, metrics
+
+        if self.mesh is not None:
+            mesh = self.mesh
+            cohort_sh = shard_along(mesh, AXIS_CLIENT, 0)
+            rep = replicated(mesh)
+            return jax.jit(
+                round_step,
+                in_shardings=(rep, cohort_sh, cohort_sh, rep),
+                out_shardings=(rep, rep),
+            )
+        return jax.jit(round_step)
+
+    def run(self, apply_fn=None, log_fn=print) -> List[Dict[str, float]]:
+        cfg = self.cfg
+        rng = jax.random.PRNGKey(cfg.seed)
+        pack_rng = np.random.default_rng(cfg.seed)
+        for round_idx in range(cfg.comm_round):
+            t0 = time.perf_counter()
+            client_ids = reference_client_sampling(
+                round_idx, cfg.client_num_in_total, cfg.client_num_per_round
+            )
+            # contiguous even split of the cohort into groups
+            group_ids = np.concatenate([
+                np.full(len(part), g, np.int32)
+                for g, part in enumerate(np.array_split(client_ids, self.group_num))
+            ])
+            batches = self.fed.pack_clients(
+                client_ids, cfg.batch_size, self.num_local_batches, rng=pack_rng
+            )
+            cohort = {
+                "x": jnp.asarray(batches.x),
+                "y": jnp.asarray(batches.y),
+                "mask": jnp.asarray(batches.mask),
+                "num_samples": jnp.asarray(batches.num_samples),
+            }
+            rng, step_rng = jax.random.split(rng)
+            self.params, metrics = self._round_step(
+                self.params, cohort, jnp.asarray(group_ids), step_rng
+            )
+            rec = {
+                "round": round_idx,
+                "round_time": time.perf_counter() - t0,
+                "train_loss": float(metrics["train_loss"].mean()),
+                "train_acc": float(
+                    metrics["train_correct"].sum()
+                    / max(float(metrics["train_valid"].sum()), 1.0)
+                ),
+            }
+            if apply_fn is not None and (
+                round_idx % cfg.frequency_of_the_test == 0
+                or round_idx == cfg.comm_round - 1
+            ):
+                rec.update(self._evaluate(apply_fn))
+            self.history.append(rec)
+            if log_fn:
+                log_fn(f"[h-round {round_idx}] " + " ".join(
+                    f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}"
+                    for k, v in rec.items() if k != "round"
+                ))
+        return self.history
+
+    def _evaluate(self, apply_fn) -> Dict[str, float]:
+        test = self.fed.test_data_global
+        logits = apply_fn(self.params, jnp.asarray(test.x), train=False)
+        pred = jnp.argmax(logits, -1)
+        acc = float((pred == jnp.asarray(test.y)).mean())
+        return {"test_acc": acc}

@@ -1,0 +1,69 @@
+"""Hierarchical FL, decentralized DSGD/PushSum, FedAvg_robust end-to-end."""
+
+import numpy as np
+
+import fedml_tpu
+from fedml_tpu.simulation import build_simulator
+
+
+def _args(**kw):
+    base = dict(
+        dataset="mnist", model="lr", debug_small_data=True,
+        client_num_in_total=8, client_num_per_round=8, comm_round=3,
+        learning_rate=0.1, epochs=1, batch_size=8, frequency_of_the_test=2,
+        random_seed=0,
+    )
+    base.update(kw)
+    return fedml_tpu.init(config=base)
+
+
+def test_hierarchical_fl_learns():
+    args = _args(federated_optimizer="HierarchicalFL", group_num=2,
+                 group_comm_round=2, comm_round=4)
+    sim, apply_fn = build_simulator(args)
+    hist = sim.run(apply_fn, log_fn=None)
+    assert hist[0]["train_loss"] > hist[-1]["train_loss"]
+    assert hist[-1]["test_acc"] > 0.5
+
+
+def test_decentralized_dsgd_consensus_and_learning():
+    args = _args(federated_optimizer="Decentralized", comm_round=5)
+    sim, apply_fn = build_simulator(args)
+    hist = sim.run(apply_fn, log_fn=None)
+    assert hist[0]["train_loss"] > hist[-1]["train_loss"]
+    # gossip keeps models near consensus
+    assert hist[-1]["consensus_dist"] < 10.0
+    assert hist[-1]["test_acc"] > 0.4
+
+
+def test_decentralized_pushsum_runs():
+    args = _args(federated_optimizer="Decentralized", decentralized_mode="pushsum",
+                 comm_round=4)
+    sim, apply_fn = build_simulator(args)
+    hist = sim.run(apply_fn, log_fn=None)
+    assert np.isfinite(hist[-1]["train_loss"])
+    assert hist[0]["train_loss"] > hist[-1]["train_loss"]
+
+
+def test_fedavg_robust_clipping_learns():
+    args = _args(federated_optimizer="FedAvg_robust",
+                 defense_type="norm_diff_clipping", norm_bound=1.0, comm_round=4)
+    sim, apply_fn = build_simulator(args)
+    hist = sim.run(apply_fn, log_fn=None)
+    assert hist[0]["train_loss"] > hist[-1]["train_loss"]
+
+
+def test_fedavg_robust_median_learns():
+    args = _args(federated_optimizer="FedAvg_robust",
+                 defense_type="coordinate_median", comm_round=4)
+    sim, apply_fn = build_simulator(args)
+    hist = sim.run(apply_fn, log_fn=None)
+    assert hist[0]["train_loss"] > hist[-1]["train_loss"]
+
+
+def test_fedavg_robust_weak_dp_fresh_noise():
+    args = _args(federated_optimizer="FedAvg_robust", defense_type="weak_dp",
+                 norm_bound=5.0, stddev=1e-4, comm_round=3)
+    sim, apply_fn = build_simulator(args)
+    hist = sim.run(apply_fn, log_fn=None)
+    assert np.isfinite(hist[-1]["train_loss"])
