@@ -78,6 +78,30 @@ def run_cross_silo_client(args: Optional[Arguments] = None):
     return Client(args).run()
 
 
+def run_mnn_server(args: Optional[Arguments] = None):
+    """Reference ``fedml.run_mnn_server()`` (launch_cross_device.py:6)."""
+    import jax as _jax
+
+    from . import data as _data, models as _models
+    from .cross_device import ServerMNN
+
+    args = args or _global_args or init()
+    fed_data, output_dim = _data.load(args)
+    model = _models.create(args, output_dim)
+    sample = _models.sample_input_for(args, fed_data)
+    variables = _models.init_params(
+        model, _jax.random.PRNGKey(int(getattr(args, "random_seed", 0))), sample
+    )
+
+    def apply_fn(vars_, x, train=False, rngs=None):
+        return model.apply(vars_, x, train=train, rngs=rngs)
+
+    return ServerMNN(
+        args, fed_data, variables, apply_fn=apply_fn,
+        backend=str(getattr(args, "backend", "LOOPBACK")),
+    ).run()
+
+
 def run_hierarchical_cross_silo_server(args: Optional[Arguments] = None):
     from .cross_silo import HierarchicalServer
 

@@ -1,1 +1,20 @@
-"""placeholder — filled in later this round"""
+"""Cross-device FL (Beehive): server-side aggregation of device payloads.
+
+Parity: reference ``python/fedml/cross_device/`` (SURVEY.md §2.5). Phone-side
+training is external in the reference too (Android/iOS SDK); this package is
+the server plane: blob codec, FedAvg aggregator, LightSecAgg variant.
+"""
+
+from .server import (
+    FedMLCrossDeviceAggregator,
+    ServerMNN,
+    decode_model_blob,
+    encode_model_blob,
+)
+from .server_lsa import LSAAggregator
+
+__all__ = [
+    "FedMLCrossDeviceAggregator", "ServerMNN",
+    "encode_model_blob", "decode_model_blob",
+    "LSAAggregator",
+]

@@ -1,0 +1,106 @@
+"""Cross-device (Beehive) FL server: aggregates serialized client payloads.
+
+Parity: reference ``cross_device/server_mnn/`` — ``fedavg_cross_device:10``
+(Python server only; phone clients are external), ``FedMLAggregator:15``
+(model params read/written as serialized **.mnn files**,
+``get_global_model_params_file:46``), ``FedMLServerManager:14`` (same
+handshake FSM as Octopus over MQTT_S3_MNN). Redesign: the device payload is a
+format-agnostic *blob* — bytes produced by any on-device codec. The default
+codec is this framework's msgpack tensor format; an MNN-style file codec
+would plug in the same two functions. The round FSM is inherited unchanged
+from the cross-silo server manager (the reference duplicates it).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..comm.message import pack_payload, unpack_payload
+from ..cross_silo.aggregator import FedMLAggregator
+from ..cross_silo.server_manager import FedMLServerManager
+
+PyTree = Any
+
+# --- payload codec (device <-> server) --------------------------------------
+
+def encode_model_blob(params: PyTree) -> bytes:
+    """Serialize a param pytree to the on-wire device format."""
+    flat = {
+        "/".join(str(getattr(k, "key", k)) for k in path): np.asarray(leaf)
+        for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]
+    }
+    return pack_payload(flat)
+
+
+def decode_model_blob(blob: bytes, template: PyTree) -> PyTree:
+    """Deserialize a device blob against the server's param structure."""
+    flat = unpack_payload(blob)
+    paths, treedef = jax.tree_util.tree_flatten_with_path(template)
+    leaves = []
+    for path, leaf in paths:
+        key = "/".join(str(getattr(k, "key", k)) for k in path)
+        arr = np.asarray(flat[key]).reshape(np.shape(leaf))
+        leaves.append(arr.astype(np.asarray(leaf).dtype))
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+class FedMLCrossDeviceAggregator(FedMLAggregator):
+    """Aggregates device blobs; persists the global model file each round
+    (reference ``fedml_aggregator.py:46 get_global_model_params_file``)."""
+
+    def __init__(self, *a, global_model_file_path: Optional[str] = None, **kw):
+        super().__init__(*a, **kw)
+        self.global_model_file_path = global_model_file_path
+
+    def add_local_trained_result(self, index: int, model_params, sample_num) -> None:
+        if isinstance(model_params, (bytes, bytearray)):
+            model_params = decode_model_blob(bytes(model_params), self.model_params)
+        super().add_local_trained_result(index, model_params, sample_num)
+
+    def get_global_model_params_file(self) -> Optional[str]:
+        if self.global_model_file_path is None:
+            return None
+        os.makedirs(os.path.dirname(self.global_model_file_path) or ".", exist_ok=True)
+        with open(self.global_model_file_path, "wb") as f:
+            f.write(encode_model_blob(self.model_params))
+        return self.global_model_file_path
+
+    def aggregate(self) -> PyTree:
+        params = super().aggregate()
+        self.get_global_model_params_file()
+        return params
+
+
+class ServerMNN:
+    """Reference ``fedml.run_mnn_server()`` target (launch_cross_device.py:6):
+    build the aggregator + server manager; devices connect over the chosen
+    backend and upload blobs."""
+
+    def __init__(self, args, fed_data, variables, apply_fn=None,
+                 backend: str = "LOOPBACK", **kw):
+        n_clients = int(getattr(args, "client_num_per_round",
+                                getattr(args, "client_num_in_total", 1)))
+        self.aggregator = FedMLCrossDeviceAggregator(
+            fed_data.test_data_global,
+            fed_data.train_data_global,
+            fed_data.train_data_num,
+            n_clients,
+            args,
+            variables,
+            apply_fn=apply_fn,
+            global_model_file_path=getattr(args, "global_model_file_path", None),
+        )
+        self.manager = FedMLServerManager(
+            args, self.aggregator, rank=0, client_num=n_clients,
+            backend=backend, **kw,
+        )
+
+    def run(self):
+        self.manager.start()
+        self.manager.run()
+        return self.manager.history

@@ -1,0 +1,77 @@
+"""LightSecAgg cross-device server: aggregate without seeing any update.
+
+Parity: reference ``cross_device/server_mnn_lsa/fedml_aggregator.py:33-89``
+(``add_local_aggregate_encoded_mask:67``,
+``check_whether_all_aggregate_encoded_mask_receive:84``) — two extra
+collection phases on top of the FedAvg round: (1) devices upload masked
+updates, (2) surviving devices upload their summed mask *shares*; the server
+LCC-reconstructs the aggregate mask and unmasks the sum. Field math is
+host-side (``core/secure_agg.py``); only the unmasked aggregate touches the
+TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..core.secure_agg import (
+    LightSecAggConfig,
+    LightSecAggServer,
+    dequantize_tree,
+)
+
+PyTree = Any
+
+
+class LSAAggregator:
+    """Server-side LightSecAgg state machine for one round."""
+
+    def __init__(self, cfg: LightSecAggConfig, model_params: PyTree):
+        self.cfg = cfg
+        self.model_params = model_params
+        self._server = LightSecAggServer(cfg)
+        self.masked_sum: Optional[np.ndarray] = None
+        self.active_clients: List[int] = []
+        self.agg_mask_shares: Dict[int, np.ndarray] = {}
+
+    # phase 1: masked updates -------------------------------------------------
+    def add_masked_update(self, client_id: int, masked: np.ndarray) -> None:
+        masked = np.mod(np.asarray(masked, dtype=np.int64), self.cfg.prime)
+        if self.masked_sum is None:
+            self.masked_sum = masked.copy()
+        else:
+            self.masked_sum = np.mod(self.masked_sum + masked, self.cfg.prime)
+        self.active_clients.append(int(client_id))
+
+    def check_all_updates_received(self, expected: int) -> bool:
+        return len(self.active_clients) >= expected
+
+    # phase 2: aggregate-mask shares -----------------------------------------
+    def add_local_aggregate_encoded_mask(self, client_id: int, share: np.ndarray) -> None:
+        """Reference ``add_local_aggregate_encoded_mask:67``."""
+        self.agg_mask_shares[int(client_id)] = np.asarray(share, dtype=np.int64)
+
+    def check_whether_all_aggregate_encoded_mask_receive(self) -> bool:
+        """Reference ``:84`` — need U surviving shares to decode."""
+        return len(self.agg_mask_shares) >= self.cfg.target_active
+
+    # finalize ----------------------------------------------------------------
+    def aggregate(self) -> PyTree:
+        assert self.masked_sum is not None, "no masked updates received"
+        agg_mask = self._server.reconstruct_aggregate_mask(
+            self.agg_mask_shares, self.active_clients
+        )
+        summed_update = self._server.unmask(
+            self.masked_sum, agg_mask, self.model_params, len(self.active_clients)
+        )
+        # FedAvg: uniform mean of the securely-summed updates, applied to params
+        n = max(len(self.active_clients), 1)
+        self.model_params = jax.tree.map(
+            lambda p, d: p + (np.asarray(d) / n).astype(np.asarray(p).dtype),
+            self.model_params,
+            summed_update,
+        )
+        return self.model_params

@@ -1,1 +1,26 @@
-"""placeholder — filled in later this round"""
+"""Cross-silo FL (Octopus): message-driven server/client over real networks.
+
+Parity: reference ``python/fedml/cross_silo/`` (SURVEY.md §2.4). The WAN
+plane (managers, handshake FSM, aggregation barrier) is preserved; the
+intra-silo compute plane is TPU-native (mesh data parallelism instead of
+DDP).
+"""
+
+from .aggregator import FedMLAggregator
+from .client_manager import FedMLClientManager
+from .horizontal_api import (
+    Client,
+    FedML_Horizontal,
+    HierarchicalClient,
+    HierarchicalServer,
+    Server,
+)
+from .message_define import MyMessage
+from .server_manager import FedMLServerManager
+from .trainer import FedMLTrainer
+
+__all__ = [
+    "FedMLAggregator", "FedMLClientManager", "FedMLServerManager", "FedMLTrainer",
+    "FedML_Horizontal", "Server", "Client", "HierarchicalServer", "HierarchicalClient",
+    "MyMessage",
+]

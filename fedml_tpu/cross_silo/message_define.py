@@ -1,0 +1,35 @@
+"""Cross-silo message-type registry.
+
+Parity: reference ``cross_silo/horizontal/message_define.py`` (same numbering:
+CONNECTION_READY=0, S2C INIT=1 / SYNC=2 / CHECK_STATUS=6, C2S MODEL=3 /
+STATS=4 / STATUS=5).
+"""
+
+
+class MyMessage:
+    MSG_TYPE_CONNECTION_IS_READY = 0
+
+    # server -> client
+    MSG_TYPE_S2C_INIT_CONFIG = 1
+    MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT = 2
+    MSG_TYPE_S2C_CHECK_CLIENT_STATUS = 6
+    MSG_TYPE_S2C_FINISH = 7
+
+    # client -> server
+    MSG_TYPE_C2S_SEND_MODEL_TO_SERVER = 3
+    MSG_TYPE_C2S_SEND_STATS_TO_SERVER = 4
+    MSG_TYPE_C2S_CLIENT_STATUS = 5
+
+    MSG_ARG_KEY_TYPE = "msg_type"
+    MSG_ARG_KEY_SENDER = "sender"
+    MSG_ARG_KEY_RECEIVER = "receiver"
+
+    MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
+    MSG_ARG_KEY_MODEL_PARAMS = "model_params"
+    MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
+    MSG_ARG_KEY_CLIENT_STATUS = "client_status"
+    MSG_ARG_KEY_CLIENT_OS = "client_os"
+    MSG_ARG_KEY_ROUND_INDEX = "round_idx"
+
+    MSG_CLIENT_STATUS_OFFLINE = "OFFLINE"
+    MSG_CLIENT_STATUS_IDLE = "IDLE"

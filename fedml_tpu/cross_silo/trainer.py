@@ -1,0 +1,89 @@
+"""Silo-side trainer: the compiled local update, optionally data-parallel
+over the silo's own device mesh.
+
+Parity: reference ``cross_silo/horizontal/fedml_trainer.py`` (``FedMLTrainer``
+swap-dataset wrapper) + the hierarchical silo's DDP adapter
+(``trainer_dist_adapter.py:40`` wrapping the model in
+``torch.nn.parallel.DistributedDataParallel``). Redesign: intra-silo data
+parallelism needs no process group, no DDP, no master/slave broadcast — the
+jitted ``local_update`` runs with its batch axis sharded over the silo's
+``data`` mesh axis and XLA inserts the gradient all-reduce (psum over ICI).
+The reference's ``ProcessGroupManager`` + pdsh/torchrun launcher collapse
+into a Mesh constructor.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.federated import FederatedData
+from ..parallel.mesh import AXIS_DATA
+from ..parallel.sharding import replicated, shard_along
+
+PyTree = Any
+
+
+class FedMLTrainer:
+    """Holds the silo's local shard; ``train(round_idx)`` runs one compiled
+    local update and returns (update, num_samples)."""
+
+    def __init__(
+        self,
+        client_index: int,
+        fed_data: FederatedData,
+        model_params: PyTree,
+        local_update: Callable,
+        args,
+        mesh=None,
+    ):
+        self.fed = fed_data
+        self.client_index = int(client_index)
+        self.model_params = model_params
+        self.args = args
+        self.mesh = mesh
+        self.batch_size = int(getattr(args, "batch_size", 32))
+        if mesh is not None:
+            batch_sh = shard_along(mesh, AXIS_DATA, 1)  # (NB, BS, ...) -> shard BS
+            rep = replicated(mesh)
+            self._local_update = jax.jit(
+                local_update,
+                in_shardings=(rep, rep, {"x": batch_sh, "y": batch_sh,
+                                         "mask": batch_sh, "num_samples": rep}, rep),
+                out_shardings=rep,
+            )
+        else:
+            self._local_update = jax.jit(local_update)
+        self._rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+        self._pack_rng = np.random.default_rng(int(getattr(args, "random_seed", 0)))
+
+    def update_model(self, weights: PyTree) -> None:
+        self.model_params = weights
+
+    def update_dataset(self, client_index: int) -> None:
+        """Reference swap-dataset semantics: silo trains partition
+        ``client_index`` this round (data_silo_selection output)."""
+        self.client_index = int(client_index)
+
+    def train(self, round_idx: int):
+        bs = self.batch_size
+        if self.mesh is not None:
+            # batch must divide the data axis; pad up via packing width
+            data_axis = self.mesh.shape[AXIS_DATA]
+            bs = -(-bs // data_axis) * data_axis
+        batches = self.fed.pack_clients(
+            [self.client_index], bs, num_batches=None, rng=self._pack_rng
+        )
+        data = {
+            "x": jnp.asarray(batches.x[0]),
+            "y": jnp.asarray(batches.y[0]),
+            "mask": jnp.asarray(batches.mask[0]),
+            "num_samples": jnp.asarray(batches.num_samples[0]),
+        }
+        self._rng, step_rng = jax.random.split(self._rng)
+        out = self._local_update(self.model_params, (), data, step_rng)
+        weights_np = jax.tree.map(np.asarray, out.update)
+        return weights_np, int(batches.num_samples[0])
