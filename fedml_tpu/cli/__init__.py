@@ -1,1 +1,9 @@
-"""placeholder — filled in later this round"""
+"""CLI / local agent surface (reference ``python/fedml/cli/``, SURVEY.md §2.6).
+
+Note: the click entry lives in ``fedml_tpu.cli.main``; only the group object
+is re-exported here so the ``main`` *submodule* name stays importable.
+"""
+
+from .main import cli
+
+__all__ = ["cli"]

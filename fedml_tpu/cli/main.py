@@ -1,0 +1,146 @@
+"""``fedml_tpu`` command-line interface.
+
+Parity: reference ``python/fedml/cli/cli.py:24`` (click group with
+``version``, ``status``, ``logs``, ``build``, ``login``, ``logout``) plus a
+``run`` command the reference spreads across example main.py files. The
+MLOps-platform network calls are replaced by a local state directory
+(``~/.fedml_tpu``): ``login`` records the account binding, ``status``/
+``logs`` read local runner state — the agent daemon surface without the
+hosted backend (which is gated in this zero-egress build).
+
+Usage: ``python -m fedml_tpu.cli <command>``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import zipfile
+
+import click
+
+STATE_DIR = os.path.expanduser(os.environ.get("FEDML_TPU_HOME", "~/.fedml_tpu"))
+
+
+def _state_path(name: str) -> str:
+    os.makedirs(STATE_DIR, exist_ok=True)
+    return os.path.join(STATE_DIR, name)
+
+
+@click.group()
+def cli():
+    """fedml_tpu: TPU-native federated learning."""
+
+
+@cli.command("version", help="Display fedml_tpu version.")
+def version():
+    import fedml_tpu
+
+    click.echo("fedml_tpu version: " + fedml_tpu.__version__)
+
+
+@cli.command("login", help="Bind this device to an account id (local record).")
+@click.argument("account_id")
+@click.option("--role", default="client", type=click.Choice(["client", "server"]))
+def login(account_id, role):
+    with open(_state_path("session.json"), "w") as f:
+        json.dump({"account_id": account_id, "role": role, "time": time.time()}, f)
+    click.echo(f"bound account {account_id} as {role} (state: {STATE_DIR})")
+
+
+@cli.command("logout", help="Clear the account binding.")
+def logout():
+    p = _state_path("session.json")
+    if os.path.exists(p):
+        os.remove(p)
+    click.echo("logged out")
+
+
+@cli.command("status", help="Display training status.")
+def status():
+    p = _state_path("status.json")
+    if not os.path.exists(p):
+        click.echo("Client training status: IDLE")
+        return
+    with open(p) as f:
+        click.echo("Client training status: " + json.load(f).get("status", "IDLE").upper())
+
+
+@cli.command("logs", help="Display recent run logs.")
+@click.option("--client", "-c", is_flag=True, help="Client logs.")
+@click.option("--server", "-s", is_flag=True, help="Server logs.")
+@click.option("--lines", "-n", default=30)
+def logs(client, server, lines):
+    log_dir = _state_path("logs")
+    if not os.path.isdir(log_dir) or not os.listdir(log_dir):
+        click.echo("no logs yet")
+        return
+    newest = max(
+        (os.path.join(log_dir, f) for f in os.listdir(log_dir)), key=os.path.getmtime
+    )
+    with open(newest) as f:
+        for line in f.readlines()[-lines:]:
+            click.echo(line.rstrip())
+
+
+@cli.command("build", help="Package entry script + config for distribution.")
+@click.option("--type", "-t", "pkg_type", type=click.Choice(["client", "server"]), required=True)
+@click.option("--source_folder", "-sf", required=True)
+@click.option("--entry_point", "-ep", required=True)
+@click.option("--config_folder", "-cf", required=True)
+@click.option("--dest_folder", "-df", required=True)
+def build(pkg_type, source_folder, entry_point, config_folder, dest_folder):
+    """Reference ``fedml build`` (cli.py:351 ``build_mlops_package:434``):
+    zips entry + source + config into a deployable package."""
+    os.makedirs(dest_folder, exist_ok=True)
+    out = os.path.join(dest_folder, f"fedml_tpu-{pkg_type}-package.zip")
+    with zipfile.ZipFile(out, "w", zipfile.ZIP_DEFLATED) as z:
+        for root, _, files in os.walk(source_folder):
+            for name in files:
+                full = os.path.join(root, name)
+                z.write(full, os.path.join("source", os.path.relpath(full, source_folder)))
+        for root, _, files in os.walk(config_folder):
+            for name in files:
+                full = os.path.join(root, name)
+                z.write(full, os.path.join("config", os.path.relpath(full, config_folder)))
+        z.writestr(
+            "package.json",
+            json.dumps({"type": pkg_type, "entry_point": entry_point,
+                        "built_at": time.time()}),
+        )
+    click.echo(f"package built: {out}")
+
+
+@cli.command("run", help="Run a simulation from a YAML config.")
+@click.option("--cf", "config_file", required=True, type=click.Path(exists=True))
+@click.option("--backend", default=None, help="sp | TPU (overrides YAML)")
+def run(config_file, backend):
+    import fedml_tpu
+    from fedml_tpu.arguments import load_arguments
+
+    args_list = ["--cf", config_file]
+    args = load_arguments(args_list=args_list)
+    if backend:
+        args.backend = backend
+    fedml_tpu.init(args=args)
+    with open(_state_path("status.json"), "w") as f:
+        json.dump({"status": "RUNNING", "time": time.time()}, f)
+    try:
+        history = fedml_tpu.run_simulation(args=args)
+        final = history[-1] if history else {}
+        with open(_state_path("status.json"), "w") as f:
+            json.dump({"status": "FINISHED", "final": final, "time": time.time()}, f)
+        click.echo(json.dumps(final))
+    except Exception:
+        with open(_state_path("status.json"), "w") as f:
+            json.dump({"status": "FAILED", "time": time.time()}, f)
+        raise
+
+
+def main():
+    cli()
+
+
+if __name__ == "__main__":
+    main()

@@ -8,7 +8,14 @@ replacing the reference's ``core/distributed/communication`` stack
 """
 
 from .base import BaseCommunicationManager, Observer
-from .message import Message, pack_payload, unpack_payload
+from .message import (
+    Message,
+    compress_tree,
+    decompress_tree,
+    is_compressed,
+    pack_payload,
+    unpack_payload,
+)
 from .loopback import LoopbackCommManager, LoopbackHub, get_default_hub
 from .managers import ClientManager, FedMLCommManager, ServerManager, create_comm_backend
 from .topology import (
@@ -21,6 +28,7 @@ from .topology import (
 __all__ = [
     "BaseCommunicationManager", "Observer",
     "Message", "pack_payload", "unpack_payload",
+    "compress_tree", "decompress_tree", "is_compressed",
     "LoopbackCommManager", "LoopbackHub", "get_default_hub",
     "ClientManager", "FedMLCommManager", "ServerManager", "create_comm_backend",
     "BaseTopologyManager", "SymmetricTopologyManager", "AsymmetricTopologyManager",

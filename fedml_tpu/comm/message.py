@@ -53,6 +53,69 @@ def unpack_payload(data: bytes) -> Any:
     return msgpack.unpackb(data, ext_hook=_ext_hook, strict_map_key=False)
 
 
+def compress_tree(tree: Any) -> Dict[str, Any]:
+    """Lossy int8 compression of a float pytree for WAN shipping (~3.9x
+    smaller than f32): per-256-chunk absmax scales via the native codec
+    (fedml_tpu/native, numpy fallback). Non-float leaves pass through."""
+    from .. import native
+
+    flat, treedef = _tree_flatten_named(tree)
+    out = {}
+    for key, arr in flat.items():
+        arr = np.asarray(arr)
+        if arr.dtype in (np.float32, np.float64) and arr.size >= 64:
+            q, scales = native.quantize_i8(arr.astype(np.float32))
+            out[key] = {"q": q, "s": scales, "shape": list(arr.shape), "c": 1}
+        else:
+            out[key] = {"raw": arr, "c": 0}
+    return {"__quantized__": 1, "leaves": out, "treedef": treedef}
+
+
+def decompress_tree(payload: Dict[str, Any]) -> Any:
+    from .. import native
+
+    flat = {}
+    for key, rec in payload["leaves"].items():
+        if rec.get("c"):
+            flat[key] = native.dequantize_i8(
+                np.asarray(rec["q"], np.int8), np.asarray(rec["s"], np.float32),
+                tuple(rec["shape"]),
+            )
+        else:
+            flat[key] = np.asarray(rec["raw"])
+    return _tree_unflatten_named(flat, payload["treedef"])
+
+
+def is_compressed(obj: Any) -> bool:
+    return isinstance(obj, dict) and obj.get("__quantized__") == 1
+
+
+def _tree_flatten_named(tree: Any):
+    """Flatten nested dicts to {path: leaf}; non-dict trees get leaf ids."""
+    flat: Dict[str, Any] = {}
+
+    def walk(node, prefix):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(v, f"{prefix}/{k}" if prefix else str(k))
+        else:
+            flat[prefix] = node
+
+    walk(tree, "")
+    return flat, None
+
+
+def _tree_unflatten_named(flat: Dict[str, Any], _treedef) -> Any:
+    root: Dict[str, Any] = {}
+    for path, leaf in flat.items():
+        parts = path.split("/")
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = leaf
+    return root
+
+
 class Message:
     """Key-value message flowing between FL actors.
 

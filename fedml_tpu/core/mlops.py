@@ -1,0 +1,205 @@
+"""Observability: runtime log, metrics, profiler events, system stats.
+
+Parity: reference ``core/mlops/`` (SURVEY.md §5.1/§5.5) —
+``MLOpsRuntimeLog:15`` (prefixed logging + excepthook), ``MLOpsMetrics:16``
+(training status/round/model reports), ``MLOpsProfilerEvent:11``
+(started/ended event spans), ``SysStats:8`` (psutil system metrics).
+Redesign: reports go to pluggable *sinks* (in-memory ring, JSONL file, or a
+comm-backend messenger) instead of a hard-wired MQTT broker + hosted
+platform; the reporting API is kept so cross-silo managers can emit the same
+spans the reference wraps around its round FSM
+(``fedml_server_manager.py:66-69``: ``server.wait``, ``server.agg_and_eval``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import sys
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+
+class MetricsSink:
+    """Default sink: bounded in-memory record list + optional JSONL file."""
+
+    def __init__(self, path: Optional[str] = None, max_records: int = 100_000):
+        self.path = path
+        self.records: List[Dict[str, Any]] = []
+        self.max_records = max_records
+        self._fh = open(path, "a") if path else None
+
+    def emit(self, record: Dict[str, Any]) -> None:
+        if len(self.records) < self.max_records:
+            self.records.append(record)
+        if self._fh:
+            self._fh.write(json.dumps(record, default=str) + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+
+class MLOpsRuntimeLog:
+    """Prefixed logging + excepthook capture (reference
+    ``mlops_runtime_log.py:15``; prefix format at :37-85)."""
+
+    _instance: Optional["MLOpsRuntimeLog"] = None
+
+    def __init__(self, args):
+        self.args = args
+        self.origin_excepthook = None
+
+    @classmethod
+    def get_instance(cls, args) -> "MLOpsRuntimeLog":
+        if cls._instance is None:
+            cls._instance = cls(args)
+        return cls._instance
+
+    def init_logs(self, show_stdout: bool = True) -> None:
+        rank = int(getattr(self.args, "rank", 0))
+        role = "Server" if rank == 0 else "Client"
+        edge_id = getattr(self.args, "edge_id", rank)
+        fmt = (
+            f"[FedML-{role}({rank}) @device-id-{edge_id}] "
+            "[%(asctime)s] [%(levelname)s] [%(filename)s:%(lineno)d] %(message)s"
+        )
+        handlers: List[logging.Handler] = []
+        if show_stdout:
+            handlers.append(logging.StreamHandler(sys.stdout))
+        log_dir = getattr(self.args, "log_file_dir", None)
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            run_id = getattr(self.args, "run_id", "0")
+            handlers.append(logging.FileHandler(
+                os.path.join(log_dir, f"fedml-run-{run_id}-edge-{edge_id}.log")
+            ))
+        logging.basicConfig(level=logging.INFO, format=fmt, handlers=handlers, force=True)
+        # capture uncaught exceptions into the log (reference :30)
+        self.origin_excepthook = sys.excepthook
+
+        def hook(exc_type, exc_value, exc_tb):
+            logging.exception("uncaught", exc_info=(exc_type, exc_value, exc_tb))
+            if self.origin_excepthook:
+                self.origin_excepthook(exc_type, exc_value, exc_tb)
+
+        sys.excepthook = hook
+
+
+class MLOpsMetrics:
+    """Training/round/model/system metric reports (reference
+    ``mlops_metrics.py:16``). ``messenger`` may be a MetricsSink or a comm
+    manager (anything with ``emit``/``send_message``)."""
+
+    STATUS_IDLE = "IDLE"
+    STATUS_RUNNING = "RUNNING"
+    STATUS_KILLED = "KILLED"
+    STATUS_FAILED = "FAILED"
+    STATUS_FINISHED = "FINISHED"
+
+    def __init__(self, sink: Optional[MetricsSink] = None):
+        self.sink = sink or MetricsSink()
+        self.run_id = 0
+        self.edge_id = 0
+
+    def set_messenger(self, sink, args=None) -> None:
+        self.sink = sink
+        if args is not None:
+            self.run_id = getattr(args, "run_id", 0)
+            self.edge_id = getattr(args, "rank", 0)
+
+    def _emit(self, kind: str, payload: Dict[str, Any]) -> None:
+        self.sink.emit({
+            "kind": kind, "run_id": self.run_id, "edge_id": self.edge_id,
+            "timestamp": time.time(), **payload,
+        })
+
+    def report_client_training_status(self, edge_id: int, status: str) -> None:
+        self._emit("client_status", {"edge_id": edge_id, "status": status})
+
+    def report_server_training_status(self, run_id, status: str) -> None:
+        self._emit("server_status", {"run_id": run_id, "status": status})
+
+    def report_server_training_round_info(self, round_info: Dict[str, Any]) -> None:
+        """Reference ``report_server_training_round_info:98``."""
+        self._emit("round_info", round_info)
+
+    def report_aggregated_model_info(self, model_info: Dict[str, Any]) -> None:
+        """Reference ``report_aggregated_model_info:112``."""
+        self._emit("model_info", model_info)
+
+    def report_system_metric(self, metric: Optional[Dict[str, Any]] = None) -> None:
+        self._emit("system", metric or SysStats().to_dict())
+
+
+class MLOpsProfilerEvent:
+    """Started/ended event spans (reference ``mlops_profiler_event.py:11``)."""
+
+    def __init__(self, args=None, sink: Optional[MetricsSink] = None):
+        self.args = args
+        self.sink = sink or MetricsSink()
+        self.run_id = getattr(args, "run_id", 0) if args else 0
+        self._open_events: Dict[str, float] = {}
+
+    def log_event_started(self, event_name: str, event_value: Optional[str] = None,
+                          event_edge_id: Optional[int] = None) -> None:
+        self._open_events[event_name] = time.time()
+        self.sink.emit({
+            "kind": "event_started", "run_id": self.run_id, "event": event_name,
+            "value": event_value, "edge_id": event_edge_id, "timestamp": time.time(),
+        })
+
+    def log_event_ended(self, event_name: str, event_value: Optional[str] = None,
+                        event_edge_id: Optional[int] = None) -> None:
+        now = time.time()
+        started = self._open_events.pop(event_name, None)
+        self.sink.emit({
+            "kind": "event_ended", "run_id": self.run_id, "event": event_name,
+            "value": event_value, "edge_id": event_edge_id, "timestamp": now,
+            "duration": (now - started) if started is not None else None,
+        })
+
+
+class SysStats:
+    """psutil CPU/mem/disk/net + JAX device memory (reference
+    ``system_stats.py:8`` uses psutil+pynvml; TPU memory comes from
+    ``device.memory_stats()`` instead of NVML)."""
+
+    def __init__(self):
+        import psutil
+
+        self.cpu_utilization = psutil.cpu_percent(interval=None)
+        vm = psutil.virtual_memory()
+        self.process_memory_gb = psutil.Process().memory_info().rss / 1e9
+        self.host_memory_used_gb = vm.used / 1e9
+        self.host_memory_total_gb = vm.total / 1e9
+        du = psutil.disk_usage("/")
+        self.disk_utilization = du.percent
+        net = psutil.net_io_counters()
+        self.net_sent_mb = net.bytes_sent / 1e6
+        self.net_recv_mb = net.bytes_recv / 1e6
+        self.device_memory: List[Dict[str, float]] = []
+        try:
+            import jax
+
+            for d in jax.devices():
+                ms = d.memory_stats() or {}
+                if ms:
+                    self.device_memory.append({
+                        "device": str(d),
+                        "bytes_in_use_gb": ms.get("bytes_in_use", 0) / 1e9,
+                        "bytes_limit_gb": ms.get("bytes_limit", 0) / 1e9,
+                    })
+        except Exception:  # devices unavailable in some contexts — not fatal
+            pass
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dict(self.__dict__)
+
+
+def generate_run_id() -> str:
+    return uuid.uuid4().hex[:12]

@@ -62,6 +62,10 @@ class FedMLAggregator:
 
     def add_local_trained_result(self, index: int, model_params: PyTree, sample_num) -> None:
         logging.debug("add_model. index = %d", index)
+        from ..comm.message import decompress_tree, is_compressed
+
+        if is_compressed(model_params):
+            model_params = decompress_tree(model_params)
         self.model_dict[index] = model_params
         self.sample_num_dict[index] = float(sample_num)
         self.flag_client_model_uploaded_dict[index] = True

@@ -62,6 +62,10 @@ class FedMLClientManager(ClientManager):
     def _train(self) -> None:
         logging.info("client %d: round %d train start", self.rank, self.round_idx)
         update, local_sample_num = self.trainer.train(self.round_idx)
+        if getattr(self.args, "comm_quantize", False):
+            from ..comm.message import compress_tree
+
+            update = compress_tree(update)
         msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
         msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, update)
         msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, local_sample_num)

@@ -55,6 +55,9 @@ class FederatedData:
     train_data_local_dict: Dict[int, ArrayPair]
     test_data_local_dict: Dict[int, ArrayPair]
     class_num: int
+    # client -> indices into train_data_global; when present the native
+    # packer gathers straight from the global arrays (no per-client copies)
+    _global_index: Dict[int, np.ndarray] | None = None
 
     @property
     def client_num(self) -> int:
@@ -98,22 +101,40 @@ class FederatedData:
 
         feat_shape = pairs[0].x.shape[1:]
         label_shape = pairs[0].y.shape[1:]  # () scalar labels, (T,) per-token
-        x_dtype = pairs[0].x.dtype
-        y_dtype = pairs[0].y.dtype
         C = len(pairs)
-        xs = np.zeros((C, cap) + feat_shape, dtype=x_dtype)
-        ys = np.zeros((C, cap) + label_shape, dtype=y_dtype)
+        new_shape = (C, num_batches, batch_size)
+        perms = None
+        if rng is not None:
+            perms = [rng.permutation(len(p)) for p in pairs]
+
+        # fast path: fused native shuffle+gather+pad over the global arrays
+        # (fedml_tpu/native); falls back to the numpy loop below
+        if self._global_index is not None and pairs[0].x.dtype == np.float32:
+            from .. import native
+
+            if native.native_available():
+                idx_lists = [self._global_index[c] for c in client_ids]
+                xs, ys, mask = native.pack_cohort(
+                    self.train_data_global.x, self.train_data_global.y,
+                    idx_lists, cap, perms=perms,
+                )
+                return ClientBatches(
+                    x=xs.reshape(new_shape + feat_shape),
+                    y=ys.reshape(new_shape + label_shape).astype(pairs[0].y.dtype),
+                    mask=mask.reshape(new_shape),
+                    num_samples=np.minimum(sizes, cap).astype(np.int32),
+                )
+
+        xs = np.zeros((C, cap) + feat_shape, dtype=pairs[0].x.dtype)
+        ys = np.zeros((C, cap) + label_shape, dtype=pairs[0].y.dtype)
         mask = np.zeros((C, cap), dtype=np.float32)
         for i, p in enumerate(pairs):
             n = min(len(p), cap)
-            order = np.arange(len(p))
-            if rng is not None:
-                order = rng.permutation(len(p))
+            order = perms[i] if perms is not None else np.arange(len(p))
             take = order[:n]
             xs[i, :n] = p.x[take]
             ys[i, :n] = p.y[take]
             mask[i, :n] = 1.0
-        new_shape = (C, num_batches, batch_size)
         return ClientBatches(
             x=xs.reshape(new_shape + feat_shape),
             y=ys.reshape(new_shape + label_shape),
@@ -148,4 +169,7 @@ def build_federated_data(
         train_data_local_dict=train_local,
         test_data_local_dict=test_local,
         class_num=class_num,
+        _global_index={
+            c: np.asarray(idx, np.int64) for c, idx in net_dataidx_map.items()
+        },
     )

@@ -152,6 +152,27 @@ def load_partition_data(
         alpha = float(parts[1]) if len(parts) > 2 else 1.0
         beta = float(parts[2]) if len(parts) > 2 else 1.0
         return synthetic_alpha_beta(alpha, beta, client_num=client_num)
+    elif dataset == "seg_synthetic":
+        # federated segmentation stand-in (FedSeg): images with a bright
+        # square; labels = per-pixel {bg, fg} flattened to (H*W,) tokens so
+        # the per-token loss path applies (models/unet.py)
+        h = w = 32
+        n_tr, n_te = (int(2000 * scale) or 64, int(400 * scale) or 32)
+        rng = np.random.default_rng(99)
+
+        def gen_seg(n, r):
+            x = r.normal(0, 0.1, (n, h, w, 1)).astype(np.float32)
+            y = np.zeros((n, h * w), np.int32)
+            for i in range(n):
+                r0, c0 = r.integers(0, h - 8), r.integers(0, w - 8)
+                x[i, r0:r0 + 8, c0:c0 + 8, 0] += 1.0
+                m = np.zeros((h, w), np.int32)
+                m[r0:r0 + 8, c0:c0 + 8] = 1
+                y[i] = m.reshape(-1)
+            return ArrayPair(x, y)
+
+        train, test = gen_seg(n_tr, rng), gen_seg(n_te, rng)
+        class_num = 2
     elif dataset in ("shakespeare", "fed_shakespeare", "stackoverflow_nwp"):
         vocab = 90 if "shakespeare" in dataset else 10000
         seq_len = 80 if "shakespeare" in dataset else 20

@@ -4,10 +4,10 @@ Parity: reference ``python/fedml/model/model_hub.py:20-94`` — dispatch on
 ``(args.model, args.dataset)``. Returns an (un-initialized) Flax module;
 ``init_params(model, rng, sample_input)`` produces the param pytree.
 
-Implemented: lr, cnn (CNN_DropOut), cnn_fedavg, resnet18_gn, resnet56, rnn
-(per-dataset LSTM variants), rnn_fedavg, mobilenet (v1), vit (small).
-Remaining reference entries (mobilenet_v3, efficientnet, DARTS nets, GAN) are
-tracked in ROADMAP.md.
+Implemented: lr, cnn (CNN_DropOut), cnn_fedavg, resnet18_gn, resnet56/20,
+rnn (per-dataset LSTM variants), rnn_fedavg, mobilenet (v1), mobilenet_v3,
+efficientnet, vgg11, vit, transformer_lm, darts (FedNAS search net), unet
+(FedSeg), GAN generator/discriminator, GKT client/server pair.
 """
 
 from __future__ import annotations
@@ -22,13 +22,21 @@ from .linear import LogisticRegression
 from .resnet import CifarResNet, ResNet18
 from .rnn import RNNOriginalFedAvg, RNNStackOverFlow
 from .mobilenet import MobileNetV1
+from .mobilenet_v3 import EfficientNetLite, MobileNetV3Small, VGG
 from .transformer import TransformerLM, ViT
+from .gan import Discriminator, Generator
+from .gkt import GKTClientNet, GKTServerNet
+from .darts import DARTSSearchNet, derive_genotype
+from .unet import UNetLite
 
 __all__ = [
     "create", "init_params", "sample_input_for",
     "LogisticRegression", "CNNDropOut", "CNNOriginalFedAvg",
     "CifarResNet", "ResNet18", "RNNOriginalFedAvg", "RNNStackOverFlow",
-    "MobileNetV1", "TransformerLM", "ViT",
+    "MobileNetV1", "MobileNetV3Small", "EfficientNetLite", "VGG",
+    "TransformerLM", "ViT",
+    "Generator", "Discriminator", "GKTClientNet", "GKTServerNet",
+    "DARTSSearchNet", "derive_genotype", "UNetLite",
 ]
 
 
@@ -59,6 +67,16 @@ def create(args, output_dim: int):
                            norm_kind=norm, dtype=dtype)
     if model_name == "mobilenet":
         return MobileNetV1(num_classes=output_dim, dtype=dtype)
+    if model_name == "mobilenet_v3":
+        return MobileNetV3Small(num_classes=output_dim, dtype=dtype)
+    if model_name == "efficientnet":
+        return EfficientNetLite(num_classes=output_dim, dtype=dtype)
+    if model_name == "vgg11":
+        return VGG(num_classes=output_dim, dtype=dtype)
+    if model_name == "darts":
+        return DARTSSearchNet(num_classes=output_dim, dtype=dtype)
+    if model_name == "unet":
+        return UNetLite(num_classes=output_dim, dtype=dtype)
     if model_name in ("rnn", "rnn_fedavg"):
         if "stackoverflow" in dataset:
             return RNNStackOverFlow(dtype=dtype)

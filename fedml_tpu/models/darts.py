@@ -1,0 +1,87 @@
+"""DARTS-lite: differentiable architecture search net for FedNAS.
+
+Parity: reference ``model/cv/darts/`` (``model_search.py:377`` mixed-op cells
+with architecture parameters alpha) used by FedNAS
+(``simulation/mpi/fednas/``). Redesign: a compact search space — each
+``MixedOp`` is a softmax(alpha)-weighted sum of {conv3x3, conv5x5, avgpool,
+identity} — with the alphas as ordinary Flax params, so FedNAS = FedAvg over
+the joint (weights, alphas) pytree and the whole bilevel-ish update stays one
+compiled program. ``derive_genotype`` reads off argmax(alpha) after search.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+OP_NAMES = ("conv3", "conv5", "avgpool", "identity")
+
+
+class MixedOp(nn.Module):
+    channels: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        alpha = self.param("alpha", nn.initializers.zeros, (len(OP_NAMES),))
+        w = jax.nn.softmax(alpha)
+        outs = [
+            nn.Conv(self.channels, (3, 3), dtype=self.dtype)(x),
+            nn.Conv(self.channels, (5, 5), dtype=self.dtype)(x),
+            nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME"),
+            x,
+        ]
+        return sum(w[i] * o for i, o in enumerate(outs))
+
+
+class SearchCell(nn.Module):
+    channels: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Conv(self.channels, (1, 1), dtype=self.dtype)(x)
+        h = nn.relu(nn.GroupNorm(num_groups=8, dtype=self.dtype)(h))
+        a = MixedOp(self.channels, dtype=self.dtype)(h, train)
+        b = MixedOp(self.channels, dtype=self.dtype)(nn.relu(a), train)
+        return nn.relu(a + b)
+
+
+class DARTSSearchNet(nn.Module):
+    """Stacked search cells + classifier (reference Network in model_search.py)."""
+
+    num_classes: int = 10
+    channels: int = 16
+    n_cells: int = 2
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.channels, (3, 3), dtype=self.dtype)(x)
+        for i in range(self.n_cells):
+            x = SearchCell(self.channels * (2 ** i), dtype=self.dtype)(x, train)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+def derive_genotype(variables: Any) -> List[Dict[str, str]]:
+    """argmax(alpha) per MixedOp — the reference's genotype derivation
+    (model_search.py genotype())."""
+    genotype = []
+
+    def visit(path, leaf):
+        names = [str(getattr(p, "key", p)) for p in path]
+        if names and names[-1] == "alpha":
+            genotype.append({
+                "op": OP_NAMES[int(jnp.argmax(leaf))],
+                "path": "/".join(names[:-1]),
+            })
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, variables)
+    return genotype

@@ -1,0 +1,43 @@
+"""MNIST GAN. Parity: reference ``python/fedml/model/model_hub.py:88-94``
+(MNIST GAN entry) + the FedGAN MPI aggregator's G/D pair
+(``simulation/mpi/fedgan/``). DCGAN-style generator/discriminator sized for
+28x28x1; kept bf16-friendly (transposed convs hit the MXU)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class Generator(nn.Module):
+    """z (B, latent_dim) -> images (B, 28, 28, 1) in [-1, 1]."""
+
+    latent_dim: int = 64
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, z, train: bool = False):
+        z = z.astype(self.dtype)
+        x = nn.Dense(7 * 7 * 64, dtype=self.dtype)(z)
+        x = nn.relu(x)
+        x = x.reshape((-1, 7, 7, 64))
+        x = nn.ConvTranspose(32, (4, 4), strides=(2, 2), dtype=self.dtype)(x)  # 14x14
+        x = nn.relu(x)
+        x = nn.ConvTranspose(1, (4, 4), strides=(2, 2), dtype=self.dtype)(x)  # 28x28
+        return jnp.tanh(x)
+
+
+class Discriminator(nn.Module):
+    """images (B, 28, 28, 1) -> real/fake logit (B,)."""
+
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (4, 4), strides=(2, 2), dtype=self.dtype)(x)  # 14x14
+        x = nn.leaky_relu(x, 0.2)
+        x = nn.Conv(64, (4, 4), strides=(2, 2), dtype=self.dtype)(x)  # 7x7
+        x = nn.leaky_relu(x, 0.2)
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(1, dtype=self.dtype)(x)[:, 0]

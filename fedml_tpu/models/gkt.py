@@ -1,0 +1,54 @@
+"""FedGKT model pair: small client edge net + large server net.
+
+Parity: reference split ResNet-56 for FedGKT
+(``model/cv/resnet56/resnet_client.py`` / ``resnet_server.py``): the client
+runs a shallow feature extractor + tiny head on-device; the server continues
+from the client's feature maps with the deep trunk. Sized here for CIFAR-like
+32x32 inputs.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class GKTClientNet(nn.Module):
+    """Shallow extractor + local head. Returns (features, logits)."""
+
+    num_classes: int = 10
+    feature_dim: int = 32
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(16, (3, 3), dtype=self.dtype)(x)
+        x = nn.GroupNorm(num_groups=8, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        h = nn.Conv(self.feature_dim, (3, 3), dtype=self.dtype)(x)
+        h = nn.relu(h)  # (B, 16, 16, feature_dim) — shipped to the server
+        pooled = h.mean(axis=(1, 2))
+        logits = nn.Dense(self.num_classes, dtype=self.dtype)(pooled)
+        return h, logits
+
+
+class GKTServerNet(nn.Module):
+    """Deep trunk continuing from client feature maps."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, h, train: bool = False):
+        x = h.astype(self.dtype)
+        for width in (64, 128):
+            x = nn.Conv(width, (3, 3), dtype=self.dtype)(x)
+            x = nn.GroupNorm(num_groups=16, dtype=self.dtype)(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.mean(axis=(1, 2))
+        x = nn.Dense(256, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)

@@ -1,0 +1,147 @@
+"""MobileNetV3 (small) + EfficientNet-lite. Parity: reference
+``model/cv/mobilenet_v3.py`` and ``model/cv/efficientnet/`` (model_hub.py
+entries ``mobilenet_v3``, ``efficientnet``). Both are built from the same
+inverted-residual (MBConv) block; GroupNorm replaces BatchNorm (FL-standard,
+see resnet.py docstring) so no mutable batch stats cross client boundaries."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+def hard_swish(x):
+    return x * nn.relu6(x + 3.0) / 6.0
+
+
+class SqueezeExcite(nn.Module):
+    channels: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        s = x.mean(axis=(1, 2))
+        s = nn.relu(nn.Dense(max(8, self.channels // 4), dtype=self.dtype)(s))
+        s = nn.hard_sigmoid(nn.Dense(self.channels, dtype=self.dtype)(s))
+        return x * s[:, None, None, :]
+
+
+class MBConv(nn.Module):
+    """Inverted residual: expand (1x1) -> depthwise -> [SE] -> project (1x1)."""
+
+    out_ch: int
+    expand: int = 4
+    stride: int = 1
+    kernel: int = 3
+    use_se: bool = True
+    act: str = "hswish"  # hswish | relu
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        act = hard_swish if self.act == "hswish" else nn.relu
+        in_ch = x.shape[-1]
+        mid = in_ch * self.expand
+        h = nn.Conv(mid, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        h = act(nn.GroupNorm(num_groups=min(8, mid), dtype=self.dtype)(h))
+        h = nn.Conv(
+            mid, (self.kernel, self.kernel), strides=(self.stride, self.stride),
+            feature_group_count=mid, use_bias=False, dtype=self.dtype,
+        )(h)
+        h = act(nn.GroupNorm(num_groups=min(8, mid), dtype=self.dtype)(h))
+        if self.use_se:
+            h = SqueezeExcite(mid, dtype=self.dtype)(h)
+        h = nn.Conv(self.out_ch, (1, 1), use_bias=False, dtype=self.dtype)(h)
+        h = nn.GroupNorm(num_groups=min(8, self.out_ch), dtype=self.dtype)(h)
+        if self.stride == 1 and in_ch == self.out_ch:
+            h = h + x
+        return h
+
+
+class MobileNetV3Small(nn.Module):
+    """Reference ``mobilenet_v3`` entry (small profile, GN variant)."""
+
+    num_classes: int = 10
+    width: float = 1.0
+    dtype: jnp.dtype = jnp.float32
+    # (out_ch, expand, stride, kernel, use_se, act)
+    blocks: Sequence[Tuple[int, int, int, int, bool, str]] = (
+        (16, 1, 2, 3, True, "relu"),
+        (24, 4, 2, 3, False, "relu"),
+        (24, 3, 1, 3, False, "relu"),
+        (40, 3, 2, 5, True, "hswish"),
+        (40, 3, 1, 5, True, "hswish"),
+        (48, 3, 1, 5, True, "hswish"),
+        (96, 6, 2, 5, True, "hswish"),
+    )
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        c = int(16 * self.width)
+        x = nn.Conv(c, (3, 3), strides=(2, 2), use_bias=False, dtype=self.dtype)(x)
+        x = hard_swish(nn.GroupNorm(num_groups=8, dtype=self.dtype)(x))
+        for out_ch, expand, stride, kernel, use_se, act in self.blocks:
+            x = MBConv(
+                int(out_ch * self.width), expand, stride, kernel, use_se, act,
+                dtype=self.dtype,
+            )(x)
+        x = nn.Conv(int(288 * self.width), (1, 1), use_bias=False, dtype=self.dtype)(x)
+        x = hard_swish(nn.GroupNorm(num_groups=8, dtype=self.dtype)(x))
+        x = x.mean(axis=(1, 2))
+        x = hard_swish(nn.Dense(int(512 * self.width), dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+class EfficientNetLite(nn.Module):
+    """Reference ``efficientnet`` entry (B0-lite profile: no SE in lite,
+    relu6; depth/width at 1.0)."""
+
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+    blocks: Sequence[Tuple[int, int, int, int]] = (
+        # (out_ch, expand, stride, kernel)
+        (16, 1, 1, 3),
+        (24, 6, 2, 3),
+        (40, 6, 2, 5),
+        (80, 6, 2, 3),
+        (112, 6, 1, 5),
+        (192, 6, 2, 5),
+        (320, 6, 1, 3),
+    )
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(32, (3, 3), strides=(2, 2), use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu6(nn.GroupNorm(num_groups=8, dtype=self.dtype)(x))
+        for out_ch, expand, stride, kernel in self.blocks:
+            x = MBConv(out_ch, expand, stride, kernel, use_se=False, act="relu",
+                       dtype=self.dtype)(x)
+        x = nn.Conv(1280, (1, 1), use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu6(nn.GroupNorm(num_groups=8, dtype=self.dtype)(x))
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+class VGG(nn.Module):
+    """Reference ``model/cv/vgg.py`` (VGG-11 profile, GN)."""
+
+    num_classes: int = 10
+    cfg: Sequence = (64, "M", 128, "M", 256, 256, "M", 512, 512, "M")
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        for v in self.cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(int(v), (3, 3), use_bias=False, dtype=self.dtype)(x)
+                x = nn.relu(nn.GroupNorm(num_groups=8, dtype=self.dtype)(x))
+        x = x.mean(axis=(1, 2))
+        x = nn.relu(nn.Dense(512, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)

@@ -39,17 +39,38 @@ class SelfAttention(nn.Module):
     num_heads: int
     causal: bool = True
     dtype: jnp.dtype = jnp.float32
+    # sequence parallelism: when set (with ``mesh``), attention runs as ring
+    # attention inside shard_map over this mesh axis — K/V blocks rotate via
+    # ppermute, memory stays O(T/n) per device (ops/attention.py)
+    seq_axis: Optional[str] = None
+    mesh: Optional[object] = None
 
     @nn.compact
     def __call__(self, x):
-        from ..ops.attention import multihead_attention
+        from ..ops.attention import multihead_attention, ring_attention
 
         B, T, D = x.shape
         H = self.num_heads
         qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=self.dtype, name="qkv")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         reshape = lambda t: t.reshape(B, T, H, D // H)  # noqa: E731
-        out = multihead_attention(reshape(q), reshape(k), reshape(v), causal=self.causal)
+        q, k, v = reshape(q), reshape(k), reshape(v)
+        if self.seq_axis is not None:
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            spec = P(None, self.seq_axis, None, None)
+            out = shard_map(
+                lambda q, k, v: ring_attention(
+                    q, k, v, self.seq_axis, causal=self.causal
+                ),
+                mesh=self.mesh,
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                check_vma=False,
+            )(q, k, v)
+        else:
+            out = multihead_attention(q, k, v, causal=self.causal)
         out = out.reshape(B, T, D)
         return nn.Dense(self.dim, use_bias=False, dtype=self.dtype, name="proj")(out)
 
@@ -59,12 +80,15 @@ class Block(nn.Module):
     num_heads: int
     causal: bool = True
     dtype: jnp.dtype = jnp.float32
+    seq_axis: Optional[str] = None
+    mesh: Optional[object] = None
 
     @nn.compact
     def __call__(self, x):
-        x = x + SelfAttention(self.dim, self.num_heads, self.causal, self.dtype)(
-            nn.LayerNorm(dtype=self.dtype)(x)
-        )
+        x = x + SelfAttention(
+            self.dim, self.num_heads, self.causal, self.dtype,
+            seq_axis=self.seq_axis, mesh=self.mesh,
+        )(nn.LayerNorm(dtype=self.dtype)(x))
         x = x + MLPBlock(self.dim, dtype=self.dtype)(nn.LayerNorm(dtype=self.dtype)(x))
         return x
 
@@ -78,6 +102,8 @@ class TransformerLM(nn.Module):
     num_layers: int = 4
     max_len: int = 2048
     dtype: jnp.dtype = jnp.float32
+    seq_axis: Optional[str] = None
+    mesh: Optional[object] = None
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -88,7 +114,8 @@ class TransformerLM(nn.Module):
         )
         h = h + pos
         for i in range(self.num_layers):
-            h = Block(self.dim, self.num_heads, causal=True, dtype=self.dtype, name=f"block_{i}")(h)
+            h = Block(self.dim, self.num_heads, causal=True, dtype=self.dtype,
+                      seq_axis=self.seq_axis, mesh=self.mesh, name=f"block_{i}")(h)
         h = nn.LayerNorm(dtype=self.dtype, name="ln_f")(h)
         return nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype, name="head")(h)
 

@@ -1,0 +1,39 @@
+"""UNet-lite for federated segmentation (FedSeg).
+
+Parity: reference FedSeg (``simulation/mpi/fedseg/``, DeepLab/UNet family in
+``app/fedcv``). Output is per-pixel logits flattened to (B, H*W, C) so the
+per-token masked CE/accuracy path (ops/losses.py, shared with the LM models)
+applies unchanged — segmentation labels ride the packing pipeline as (H*W,)
+token targets.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class UNetLite(nn.Module):
+    num_classes: int = 2
+    base: int = 16
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+
+        def block(h, ch):
+            h = nn.Conv(ch, (3, 3), dtype=self.dtype)(h)
+            h = nn.relu(nn.GroupNorm(num_groups=min(8, ch), dtype=self.dtype)(h))
+            return h
+
+        e1 = block(x, self.base)                                   # H
+        e2 = block(nn.max_pool(e1, (2, 2), strides=(2, 2)), self.base * 2)  # H/2
+        bott = block(nn.max_pool(e2, (2, 2), strides=(2, 2)), self.base * 4)  # H/4
+        u2 = nn.ConvTranspose(self.base * 2, (2, 2), strides=(2, 2), dtype=self.dtype)(bott)
+        d2 = block(jnp.concatenate([u2, e2], axis=-1), self.base * 2)
+        u1 = nn.ConvTranspose(self.base, (2, 2), strides=(2, 2), dtype=self.dtype)(d2)
+        d1 = block(jnp.concatenate([u1, e1], axis=-1), self.base)
+        logits = nn.Conv(self.num_classes, (1, 1), dtype=self.dtype)(d1)
+        B, H, W, C = logits.shape
+        return logits.reshape(B, H * W, C)

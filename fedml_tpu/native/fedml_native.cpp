@@ -1,0 +1,120 @@
+// Native runtime components for fedml_tpu.
+//
+// The reference outsources all native code to external libs (SURVEY.md §2.7:
+// its cpp/ and rust/ trees are empty placeholders). Here the host-side hot
+// paths that sit OUTSIDE XLA get a C++ implementation:
+//
+//  1. cohort packer — builds the rectangular (clients, cap, feat) training
+//     block from ragged per-client sample indices: fused shuffle+gather+pad
+//     with one pass per client, no intermediate numpy copies. This is the
+//     per-round host work feeding the compiled FL round step.
+//  2. fp16/int8 quantization codec — WAN weight compression for the
+//     cross-silo plane (2-4x smaller Messages than raw f32).
+//
+// Exposed as a plain C ABI for ctypes (no pybind11 in this image).
+
+#include <cstdint>
+#include <cstring>
+#include <cmath>
+#include <algorithm>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// 1. cohort packer
+//
+// x:        (n_samples, feat_size) float32, C-contiguous
+// y:        (n_samples, label_size) int32 (label_size>=1; scalar labels = 1)
+// idx:      concatenated per-client sample indices (int64)
+// offsets:  (n_clients+1) prefix offsets into idx
+// perm:     permutation of each client's local order (same layout as idx);
+//           pass identity for no shuffle
+// cap:      samples per client after padding (num_batches * batch_size)
+// outputs:  out_x (n_clients, cap, feat), out_y (n_clients, cap, label),
+//           out_mask (n_clients, cap) float32
+// ---------------------------------------------------------------------------
+void pack_cohort_f32(
+    const float* x, const int32_t* y,
+    const int64_t* idx, const int64_t* offsets, const int64_t* perm,
+    int64_t n_clients, int64_t feat_size, int64_t label_size, int64_t cap,
+    float* out_x, int32_t* out_y, float* out_mask, int32_t n_threads)
+{
+    if (n_threads <= 0) {
+        n_threads = (int32_t)std::min<int64_t>(
+            n_clients, std::max(1u, std::thread::hardware_concurrency()));
+    }
+    auto work = [&](int64_t c0, int64_t c1) {
+        for (int64_t c = c0; c < c1; ++c) {
+            const int64_t lo = offsets[c], hi = offsets[c + 1];
+            const int64_t n = std::min(hi - lo, cap);
+            float* ox = out_x + c * cap * feat_size;
+            int32_t* oy = out_y + c * cap * label_size;
+            float* om = out_mask + c * cap;
+            for (int64_t i = 0; i < n; ++i) {
+                const int64_t src = idx[lo + perm[lo + i]];
+                std::memcpy(ox + i * feat_size, x + src * feat_size,
+                            sizeof(float) * (size_t)feat_size);
+                std::memcpy(oy + i * label_size, y + src * label_size,
+                            sizeof(int32_t) * (size_t)label_size);
+                om[i] = 1.0f;
+            }
+            // zero the padded tail
+            std::memset(ox + n * feat_size, 0,
+                        sizeof(float) * (size_t)((cap - n) * feat_size));
+            std::memset(oy + n * label_size, 0,
+                        sizeof(int32_t) * (size_t)((cap - n) * label_size));
+            std::memset(om + n, 0, sizeof(float) * (size_t)(cap - n));
+        }
+    };
+    if (n_threads == 1 || n_clients == 1) {
+        work(0, n_clients);
+        return;
+    }
+    std::vector<std::thread> threads;
+    const int64_t chunk = (n_clients + n_threads - 1) / n_threads;
+    for (int64_t t = 0; t < n_threads; ++t) {
+        const int64_t c0 = t * chunk, c1 = std::min(n_clients, c0 + chunk);
+        if (c0 >= c1) break;
+        threads.emplace_back(work, c0, c1);
+    }
+    for (auto& th : threads) th.join();
+}
+
+// ---------------------------------------------------------------------------
+// 2. quantization codec: f32 <-> int8 with per-chunk absmax scales
+//    (chunk = 256 values; scales stored f32). Ratio ~3.9x vs f32.
+// ---------------------------------------------------------------------------
+static const int64_t QCHUNK = 256;
+
+int64_t quant_i8_bound(int64_t n) {  // bytes needed for payload
+    const int64_t n_chunks = (n + QCHUNK - 1) / QCHUNK;
+    return n + n_chunks * (int64_t)sizeof(float);
+}
+
+void quantize_i8(const float* src, int64_t n, int8_t* dst_q, float* dst_scales) {
+    const int64_t n_chunks = (n + QCHUNK - 1) / QCHUNK;
+    for (int64_t c = 0; c < n_chunks; ++c) {
+        const int64_t lo = c * QCHUNK, hi = std::min(n, lo + QCHUNK);
+        float amax = 0.0f;
+        for (int64_t i = lo; i < hi; ++i) amax = std::max(amax, std::fabs(src[i]));
+        const float scale = amax > 0 ? amax / 127.0f : 1.0f;
+        dst_scales[c] = scale;
+        const float inv = 1.0f / scale;
+        for (int64_t i = lo; i < hi; ++i) {
+            dst_q[i] = (int8_t)std::lrintf(src[i] * inv);
+        }
+    }
+}
+
+void dequantize_i8(const int8_t* q, const float* scales, int64_t n, float* dst) {
+    const int64_t n_chunks = (n + QCHUNK - 1) / QCHUNK;
+    for (int64_t c = 0; c < n_chunks; ++c) {
+        const int64_t lo = c * QCHUNK, hi = std::min(n, lo + QCHUNK);
+        const float s = scales[c];
+        for (int64_t i = lo; i < hi; ++i) dst[i] = (float)q[i] * s;
+    }
+}
+
+}  // extern "C"

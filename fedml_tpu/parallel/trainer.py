@@ -1,0 +1,169 @@
+"""Cheetah: distributed training acceleration (dp × tp × sp over one mesh).
+
+The reference reserves this product line as an empty placeholder
+(``python/fedml/distributed/`` — SURVEY.md product table: "Cheetah ...
+placeholder only"); here it is functional. A causal-LM training step is jit
+over a ``(data, seq, model)`` mesh:
+
+- **data**: batch sharding; XLA inserts the gradient psum (the DDP
+  equivalent, reference ``trainer_dist_adapter.py:66-68``).
+- **model**: tensor parallelism via parameter PartitionSpecs — column-sharded
+  qkv/mlp-in kernels, row-sharded proj/mlp-out, vocab-sharded head; GSPMD
+  places the activation collectives (Megatron layout, expressed as shardings
+  not hand-written collectives, per the scaling-book recipe).
+- **seq**: sequence/context parallelism — tokens sharded along T; attention
+  runs as explicit ring attention (``ops/attention.py``) with K/V blocks
+  rotating on ``ppermute`` over ICI. This is the long-context axis
+  (SURVEY.md §5.7: absent in reference, first-class here).
+
+Pipeline (``pipe``) is intentionally not in this trainer: at FL/LM scales the
+same devices are better spent on dp×tp×sp; SplitNN (algorithms/split_nn.py)
+covers the layer-split execution pattern.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.transformer import TransformerLM
+from .mesh import AXIS_DATA, AXIS_MODEL, AXIS_SEQ, MeshConfig, create_mesh
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class DistTrainConfig:
+    dp: int = 1
+    tp: int = 1
+    sp: int = 1
+    lr: float = 3e-4
+    weight_decay: float = 0.01
+    use_remat: bool = True   # jax.checkpoint the blocks: FLOPs for HBM
+
+
+def make_lm_mesh(cfg: DistTrainConfig, devices=None) -> Mesh:
+    return create_mesh(
+        MeshConfig(axes=((AXIS_DATA, cfg.dp), (AXIS_SEQ, cfg.sp), (AXIS_MODEL, cfg.tp))),
+        devices=devices,
+    )
+
+
+def transformer_param_specs(params: PyTree) -> PyTree:
+    """Megatron-style TP layout by parameter path.
+
+    qkv / mlp-in kernels: column-sharded (output dim over ``model``);
+    proj / mlp-out: row-sharded (input dim); head: vocab-sharded output;
+    embeddings, norms, biases: replicated.
+    """
+
+    def spec_for(path, leaf) -> P:
+        names = [str(getattr(p, "key", p)) for p in path]
+        joined = "/".join(names)
+        if leaf.ndim < 2:
+            return P()
+        if "qkv" in joined and names[-1] == "kernel":
+            return P(None, AXIS_MODEL)
+        if "proj" in joined and names[-1] == "kernel":
+            return P(AXIS_MODEL, None)
+        if "MLPBlock" in joined and "Dense_0" in joined and names[-1] == "kernel":
+            return P(None, AXIS_MODEL)
+        if "MLPBlock" in joined and "Dense_1" in joined and names[-1] == "kernel":
+            return P(AXIS_MODEL, None)
+        if "head" in joined and names[-1] == "kernel":
+            return P(None, AXIS_MODEL)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+class DistributedLMTrainer:
+    """Compiled distributed causal-LM trainer (the Cheetah engine)."""
+
+    def __init__(
+        self,
+        cfg: DistTrainConfig,
+        vocab_size: int = 1024,
+        dim: int = 256,
+        num_heads: int = 8,
+        num_layers: int = 4,
+        max_len: int = 2048,
+        dtype=jnp.bfloat16,
+        mesh: Optional[Mesh] = None,
+        seed: int = 0,
+    ):
+        self.cfg = cfg
+        self.mesh = mesh or make_lm_mesh(cfg)
+        self.model = TransformerLM(
+            vocab_size=vocab_size, dim=dim, num_heads=num_heads,
+            num_layers=num_layers, max_len=max_len, dtype=dtype,
+            seq_axis=AXIS_SEQ if cfg.sp > 1 else None,
+            mesh=self.mesh if cfg.sp > 1 else None,
+        )
+        # init on host with a tiny batch, then place with TP shardings
+        variables = self.model.init(
+            jax.random.PRNGKey(seed), jnp.zeros((1, max(8, cfg.sp)), jnp.int32)
+        )
+        self.param_specs = transformer_param_specs(variables)
+        self.param_shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), self.param_specs,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        self.params = jax.device_put(variables, self.param_shardings)
+        self.opt = optax.adamw(cfg.lr, weight_decay=cfg.weight_decay)
+        # moments inherit the params' shardings (init maps over sharded params)
+        self.opt_state = self.opt.init(self.params)
+        self.batch_sharding = NamedSharding(self.mesh, P(AXIS_DATA, AXIS_SEQ))
+        self._train_step = self._build_train_step()
+
+    def _build_train_step(self) -> Callable:
+        model = self.model
+        opt = self.opt
+        use_remat = self.cfg.use_remat
+
+        def loss_fn(params, tokens, targets):
+            apply = model.apply
+            if use_remat:
+                apply = jax.checkpoint(model.apply)
+            logits = apply(params, tokens)
+            logz = jax.nn.log_softmax(logits.astype(jnp.float32))
+            ll = jnp.take_along_axis(logz, targets[..., None], -1)[..., 0]
+            return -ll.mean()
+
+        def train_step(params, opt_state, tokens, targets):
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        rep = NamedSharding(self.mesh, P())
+        return jax.jit(
+            train_step,
+            in_shardings=(self.param_shardings, None, self.batch_sharding, self.batch_sharding),
+            out_shardings=(self.param_shardings, None, rep),
+            donate_argnums=(0, 1),
+        )
+
+    def step(self, tokens: np.ndarray, targets: np.ndarray) -> float:
+        tokens = jax.device_put(jnp.asarray(tokens, jnp.int32), self.batch_sharding)
+        targets = jax.device_put(jnp.asarray(targets, jnp.int32), self.batch_sharding)
+        self.params, self.opt_state, loss = self._train_step(
+            self.params, self.opt_state, tokens, targets
+        )
+        return float(loss)
+
+    def train(self, data_iter, steps: int, log_every: int = 10, log_fn=print) -> list:
+        losses = []
+        for i in range(steps):
+            tokens, targets = next(data_iter)
+            loss = self.step(tokens, targets)
+            losses.append(loss)
+            if log_fn and i % log_every == 0:
+                log_fn(f"[cheetah step {i}] loss={loss:.4f}")
+        return losses

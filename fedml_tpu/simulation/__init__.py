@@ -71,6 +71,9 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
         batch_size=int(getattr(args, "batch_size", 32)),
         frequency_of_the_test=int(getattr(args, "frequency_of_the_test", 5)),
         seed=int(getattr(args, "random_seed", 0)),
+        checkpoint_dir=getattr(args, "checkpoint_dir", None),
+        checkpoint_frequency=int(getattr(args, "checkpoint_frequency", 10)),
+        resume=bool(getattr(args, "resume", True)),
     )
 
     # two-level and serverless variants use dedicated engines

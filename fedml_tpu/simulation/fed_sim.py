@@ -60,6 +60,10 @@ class SimConfig:
     # fix the per-client batch count for a stable compiled shape; None =
     # derive from the largest client (padding+mask covers the rest)
     num_local_batches: Optional[int] = None
+    # checkpoint/resume (orbax; the reference has none — SURVEY.md §5.4)
+    checkpoint_dir: Optional[str] = None
+    checkpoint_frequency: int = 10
+    resume: bool = True
 
 
 class FedSimulator:
@@ -172,13 +176,26 @@ class FedSimulator:
 
     def run(self, apply_fn=None, log_fn=print) -> List[Dict[str, float]]:
         cfg = self.cfg
-        rng = jax.random.PRNGKey(cfg.seed)
-        pack_rng = np.random.default_rng(cfg.seed)
-        for round_idx in range(cfg.comm_round):
+        base_rng = jax.random.PRNGKey(cfg.seed)
+        start_round, ckpt = 0, None
+        if cfg.checkpoint_dir:
+            from ..utils.checkpoint import (
+                CheckpointManager, restore_simulator_state, save_simulator_state,
+            )
+
+            ckpt = CheckpointManager(cfg.checkpoint_dir)
+            if cfg.resume and ckpt.latest_step() is not None:
+                start_round = restore_simulator_state(ckpt, self)
+                if log_fn:
+                    log_fn(f"[resume] from round {start_round} @ {cfg.checkpoint_dir}")
+        for round_idx in range(start_round, cfg.comm_round):
             t0 = time.perf_counter()
             client_ids = reference_client_sampling(
                 round_idx, cfg.client_num_in_total, cfg.client_num_per_round
             )
+            # round-indexed RNG streams: resume at round k reproduces an
+            # uninterrupted run exactly
+            pack_rng = np.random.default_rng([cfg.seed, round_idx])
             batches = self.fed.pack_clients(
                 client_ids, cfg.batch_size, self.num_local_batches, rng=pack_rng
             )
@@ -189,7 +206,7 @@ class FedSimulator:
                 "num_samples": jnp.asarray(batches.num_samples),
             }
             states = self._cohort_states(client_ids)
-            rng, step_rng = jax.random.split(rng)
+            step_rng = jax.random.fold_in(base_rng, round_idx)
             self.params, self.server_state, new_states, metrics = self._round_step(
                 self.params, self.server_state, cohort, states, step_rng
             )
@@ -207,11 +224,18 @@ class FedSimulator:
             ):
                 rec.update(self.evaluate(apply_fn))
             self.history.append(rec)
+            if ckpt is not None and (
+                (round_idx + 1) % cfg.checkpoint_frequency == 0
+                or round_idx == cfg.comm_round - 1
+            ):
+                save_simulator_state(ckpt, self, round_idx)
             if log_fn:
                 log_fn(f"[round {round_idx}] " + " ".join(
                     f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}"
                     for k, v in rec.items() if k != "round"
                 ))
+        if ckpt is not None:
+            ckpt.close()
         return self.history
 
     def evaluate(self, apply_fn) -> Dict[str, float]:
@@ -222,7 +246,8 @@ class FedSimulator:
         bs = min(self.cfg.eval_batch_size, n)
         n_keep = (n // bs) * bs  # truncate tail for a static shape
         xs = jnp.asarray(test.x[:n_keep]).reshape((-1, bs) + test.x.shape[1:])
-        ys = jnp.asarray(test.y[:n_keep]).reshape((-1, bs))
+        # keep trailing label dims (per-token/per-pixel targets)
+        ys = jnp.asarray(test.y[:n_keep]).reshape((-1, bs) + test.y.shape[1:])
         l, c, cnt = self._eval_fn(self.params, xs, ys)
         return {
             "test_loss": float(l) / max(float(cnt), 1.0),

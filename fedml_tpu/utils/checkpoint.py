@@ -1,0 +1,75 @@
+"""Checkpoint/resume via orbax.
+
+The reference has no train-state checkpointing (SURVEY.md §5.4 — only a
+global-model file cache and implicit S3 weight history); this is a
+first-class addition: full simulator state (params, server state, round
+index, per-client states) saved atomically per round, restorable to resume a
+run mid-training.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+PyTree = Any
+
+
+class CheckpointManager:
+    """Thin orbax wrapper: ``save(step, state)`` / ``restore(step=None)``."""
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=max_to_keep, create=True
+            ),
+        )
+
+    def save(self, step: int, state: PyTree, force: bool = False) -> bool:
+        saved = self.manager.save(
+            step, args=self._ocp.args.StandardSave(state), force=force
+        )
+        self.manager.wait_until_finished()
+        return saved
+
+    def latest_step(self) -> Optional[int]:
+        return self.manager.latest_step()
+
+    def restore(self, step: Optional[int] = None, template: Optional[PyTree] = None) -> PyTree:
+        step = step if step is not None else self.manager.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {self.directory}")
+        if template is not None:
+            return self.manager.restore(
+                step, args=self._ocp.args.StandardRestore(template)
+            )
+        return self.manager.restore(step)
+
+    def close(self) -> None:
+        self.manager.close()
+
+
+def save_simulator_state(manager: CheckpointManager, sim, round_idx: int) -> None:
+    """Persist a FedSimulator's resumable state."""
+    state = {
+        "params": sim.params,
+        "server_state": sim.server_state,
+        "round": round_idx,
+        "client_states": {str(k): v for k, v in sim.client_states.items()},
+    }
+    manager.save(round_idx, state)
+
+
+def restore_simulator_state(manager: CheckpointManager, sim) -> int:
+    """Restore into ``sim``; returns the next round index to run."""
+    state = manager.restore()
+    sim.params = state["params"]
+    sim.server_state = state["server_state"]
+    sim.client_states = {int(k): v for k, v in state.get("client_states", {}).items()}
+    return int(state["round"]) + 1

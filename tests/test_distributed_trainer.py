@@ -1,0 +1,69 @@
+"""Cheetah distributed LM trainer: dp/tp/sp shardings + ring attention."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.parallel.trainer import (
+    DistTrainConfig,
+    DistributedLMTrainer,
+    transformer_param_specs,
+)
+from jax.sharding import PartitionSpec as P
+
+
+def _toy_data(vocab, B, T, seed=0):
+    rng = np.random.default_rng(seed)
+    while True:
+        # learnable pattern: next token = (token + 1) % vocab
+        start = rng.integers(0, vocab, (B, 1))
+        seq = (start + np.arange(T + 1)) % vocab
+        yield seq[:, :-1].astype(np.int32), seq[:, 1:].astype(np.int32)
+
+
+def test_param_specs_megatron_layout():
+    cfg = DistTrainConfig(dp=8, tp=1, sp=1)
+    tr = DistributedLMTrainer(cfg, vocab_size=64, dim=32, num_heads=4,
+                              num_layers=1, max_len=64, dtype=jnp.float32)
+    specs = transformer_param_specs(tr.params)
+    flat = {
+        "/".join(str(getattr(k, "key", k)) for k in path): s
+        for path, s in jax.tree_util.tree_flatten_with_path(
+            specs, is_leaf=lambda x: isinstance(x, P))[0]
+    }
+    qkv = [v for k, v in flat.items() if "qkv" in k and k.endswith("kernel")]
+    proj = [v for k, v in flat.items() if "proj" in k and k.endswith("kernel")]
+    assert qkv == [P(None, "model")]
+    assert proj == [P("model", None)]
+
+
+@pytest.mark.parametrize("dp,tp,sp", [(8, 1, 1), (2, 2, 2), (1, 1, 8)])
+def test_distributed_lm_trains(dp, tp, sp):
+    cfg = DistTrainConfig(dp=dp, tp=tp, sp=sp, lr=1e-2)
+    vocab, B, T = 32, 8, 16
+    tr = DistributedLMTrainer(cfg, vocab_size=vocab, dim=64, num_heads=4,
+                              num_layers=2, max_len=T, dtype=jnp.float32)
+    losses = tr.train(_toy_data(vocab, B, T), steps=30, log_fn=None)
+    assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
+
+
+def test_ring_attention_matches_dense():
+    """SP ring attention must equal dense attention numerically."""
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+
+    from fedml_tpu.ops.attention import multihead_attention, ring_attention
+    from fedml_tpu.parallel import AXIS_SEQ, MeshConfig, create_mesh
+
+    mesh = create_mesh(MeshConfig(axes=((AXIS_SEQ, 8),)))
+    B, T, H, D = 2, 64, 4, 16
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.normal(size=(B, T, H, D)), jnp.float32) for _ in range(3))
+    dense = multihead_attention(q, k, v, causal=True)
+    spec = P(None, AXIS_SEQ, None, None)
+    ring = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, AXIS_SEQ, causal=True),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec, check_vma=False,
+    )(q, k, v)
+    np.testing.assert_allclose(np.asarray(dense), np.asarray(ring), atol=2e-5)

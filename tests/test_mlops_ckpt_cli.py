@@ -1,0 +1,133 @@
+"""Observability sinks, orbax checkpoint/resume, CLI commands."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.core.mlops import (
+    MetricsSink,
+    MLOpsMetrics,
+    MLOpsProfilerEvent,
+    SysStats,
+)
+from fedml_tpu.simulation import build_simulator
+
+
+def test_metrics_sink_and_reports(tmp_path):
+    sink = MetricsSink(path=str(tmp_path / "metrics.jsonl"))
+    m = MLOpsMetrics(sink)
+    m.report_server_training_round_info({"round": 1, "acc": 0.5})
+    m.report_aggregated_model_info({"round": 1, "url": "local"})
+    m.report_client_training_status(3, MLOpsMetrics.STATUS_RUNNING)
+    sink.close()
+    lines = [json.loads(l) for l in (tmp_path / "metrics.jsonl").read_text().splitlines()]
+    assert [r["kind"] for r in lines] == ["round_info", "model_info", "client_status"]
+
+
+def test_profiler_event_spans():
+    sink = MetricsSink()
+    ev = MLOpsProfilerEvent(sink=sink)
+    ev.log_event_started("server.agg")
+    ev.log_event_ended("server.agg")
+    kinds = [r["kind"] for r in sink.records]
+    assert kinds == ["event_started", "event_ended"]
+    assert sink.records[1]["duration"] >= 0
+
+
+def test_sys_stats_fields():
+    s = SysStats().to_dict()
+    assert s["host_memory_total_gb"] > 0
+    assert "cpu_utilization" in s
+
+
+def test_checkpoint_resume_matches_uninterrupted(tmp_path):
+    cfg = dict(
+        dataset="mnist", model="lr", debug_small_data=True,
+        client_num_in_total=8, client_num_per_round=4, comm_round=6,
+        learning_rate=0.1, batch_size=8, frequency_of_the_test=100,
+        random_seed=0,
+    )
+    # uninterrupted run
+    args = fedml_tpu.init(config=dict(cfg))
+    sim, apply_fn = build_simulator(args)
+    full_hist = sim.run(apply_fn=None, log_fn=None)
+    full_params = sim.params
+
+    # interrupted: 3 rounds with checkpoints, then resume to 6
+    ck = str(tmp_path / "ck")
+    args1 = fedml_tpu.init(config=dict(cfg, comm_round=3, checkpoint_dir=ck,
+                                       checkpoint_frequency=1))
+    sim1, _ = build_simulator(args1)
+    sim1.run(apply_fn=None, log_fn=None)
+    args2 = fedml_tpu.init(config=dict(cfg, comm_round=6, checkpoint_dir=ck,
+                                       checkpoint_frequency=1))
+    sim2, _ = build_simulator(args2)
+    hist2 = sim2.run(apply_fn=None, log_fn=None)
+    assert hist2[0]["round"] == 3  # resumed, not restarted
+
+    import jax
+
+    for a, b in zip(jax.tree.leaves(full_params), jax.tree.leaves(sim2.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_cli_version_build_login(tmp_path, monkeypatch):
+    from click.testing import CliRunner
+    import fedml_tpu.cli.main as cli_main
+
+    monkeypatch.setattr(cli_main, "STATE_DIR", str(tmp_path / "state"))
+    runner = CliRunner()
+    out = runner.invoke(cli_main.cli, ["version"])
+    assert out.exit_code == 0 and "fedml_tpu version" in out.output
+
+    # build a package
+    src = tmp_path / "src"; src.mkdir(); (src / "main.py").write_text("print('hi')")
+    cfgd = tmp_path / "cfg"; cfgd.mkdir(); (cfgd / "c.yaml").write_text("a: 1")
+    out = runner.invoke(cli_main.cli, [
+        "build", "-t", "client", "-sf", str(src), "-ep", "main.py",
+        "-cf", str(cfgd), "-df", str(tmp_path / "dist"),
+    ])
+    assert out.exit_code == 0, out.output
+    assert (tmp_path / "dist" / "fedml_tpu-client-package.zip").exists()
+
+    out = runner.invoke(cli_main.cli, ["login", "acct-42"])
+    assert out.exit_code == 0
+    out = runner.invoke(cli_main.cli, ["status"])
+    assert "IDLE" in out.output
+    out = runner.invoke(cli_main.cli, ["logout"])
+    assert out.exit_code == 0
+
+
+def test_cli_run_from_yaml(tmp_path, monkeypatch):
+    from click.testing import CliRunner
+    import fedml_tpu.cli.main as cli_main
+
+    monkeypatch.setattr(cli_main, "STATE_DIR", str(tmp_path / "state"))
+    cfg = tmp_path / "fedml_config.yaml"
+    cfg.write_text("""
+common_args:
+  training_type: simulation
+  random_seed: 0
+data_args:
+  dataset: mnist
+  debug_small_data: true
+model_args:
+  model: lr
+train_args:
+  federated_optimizer: FedAvg
+  client_num_in_total: 4
+  client_num_per_round: 4
+  comm_round: 2
+  learning_rate: 0.1
+  batch_size: 8
+validation_args:
+  frequency_of_the_test: 1
+""")
+    runner = CliRunner()
+    out = runner.invoke(cli_main.cli, ["run", "--cf", str(cfg), "--backend", "sp"])
+    assert out.exit_code == 0, out.output
+    status = json.loads((tmp_path / "state" / "status.json").read_text())
+    assert status["status"] == "FINISHED"
