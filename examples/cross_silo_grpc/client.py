@@ -1,0 +1,5 @@
+import fedml_tpu
+
+if __name__ == "__main__":
+    args = fedml_tpu.init()
+    fedml_tpu.run_cross_silo_client(args)

@@ -1,0 +1,6 @@
+import fedml_tpu
+
+if __name__ == "__main__":
+    args = fedml_tpu.init()
+    args.rank = 0
+    fedml_tpu.run_cross_silo_server(args)

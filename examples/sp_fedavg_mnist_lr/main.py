@@ -1,0 +1,8 @@
+"""python main.py --cf fedml_config.yaml (reference example entry parity)."""
+
+import fedml_tpu
+
+if __name__ == "__main__":
+    args = fedml_tpu.init()
+    history = fedml_tpu.run_simulation(args=args)
+    print("final:", history[-1])

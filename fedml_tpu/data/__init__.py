@@ -26,14 +26,60 @@ __all__ = [
 
 
 def load(args):
-    """Load + federate the dataset named by args (reference data_loader.py:29)."""
+    """Load + federate the dataset named by args (reference data_loader.py:29).
+
+    Mode switches (parity with the reference's extra entry points):
+    - ``centralized=True``: all samples on one client
+      (``load_centralized_data``, data_loader.py:277).
+    - ``full_batch=True``: caller should set batch_size to the max client
+      size; flagged here for config parity (data_loader.py:300).
+    - ``poison_ratio>0``: backdoor-poison that fraction of clients
+      (``load_poisoned_dataset``, data_loader.py:326 / edge_case_examples).
+    """
     dataset = getattr(args, "dataset", "mnist")
+    centralized = bool(getattr(args, "centralized", False))
+    client_num = 1 if centralized else int(getattr(args, "client_num_in_total", 10))
     fed = load_partition_data(
         dataset=dataset,
         data_cache_dir=getattr(args, "data_cache_dir", None),
-        partition_method=getattr(args, "partition_method", "hetero"),
+        partition_method="homo" if centralized else getattr(args, "partition_method", "hetero"),
         partition_alpha=float(getattr(args, "partition_alpha", 0.5)),
-        client_num=int(getattr(args, "client_num_in_total", 10)),
+        client_num=client_num,
         small=bool(getattr(args, "debug_small_data", False)),
     )
+    poison_ratio = float(getattr(args, "poison_ratio", 0.0))
+    if poison_ratio > 0.0:
+        fed = poison_clients(
+            fed,
+            ratio=poison_ratio,
+            target_label=int(getattr(args, "poison_target_label", 0)),
+            seed=int(getattr(args, "random_seed", 0)),
+        )
     return fed, fed.class_num
+
+
+def poison_clients(fed: FederatedData, ratio: float, target_label: int = 0,
+                   seed: int = 0) -> FederatedData:
+    """Backdoor-poison a fraction of clients: a bright trigger patch in the
+    corner + label flipped to ``target_label`` (the robustness-experiment
+    data path the reference gates behind load_poisoned_dataset)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    n_poison = max(1, int(ratio * fed.client_num))
+    poisoned = set(rng.choice(fed.client_num, n_poison, replace=False).tolist())
+    new_local = {}
+    for c, pair in fed.train_data_local_dict.items():
+        if c in poisoned and pair.x.ndim >= 3:
+            x = pair.x.copy()
+            x[:, :3, :3] = x.max()  # trigger patch
+            y = np.full_like(pair.y, target_label)
+            new_local[c] = ArrayPair(x, y)
+        else:
+            new_local[c] = pair
+    import dataclasses as _dc
+
+    return _dc.replace(
+        fed, train_data_local_dict=new_local,
+        _global_index=None,  # per-client arrays diverge from the global ones
+    )

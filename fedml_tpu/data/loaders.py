@@ -119,6 +119,16 @@ _SIZES = {  # default (train, test) cardinalities for synthetic fallbacks
     "cifar100": (50000, 10000),
     "cinic10": (90000, 90000),
     "fed_cifar100": (50000, 10000),
+    # image datasets below use reduced synthetic cardinalities offline
+    "ILSVRC2012": (20000, 2000),
+    "gld23k": (23080, 1000),
+    "gld160k": (16000, 1600),
+}
+
+_IMG_SPECS = {  # dataset -> (shape, classes, seed) for large-image fallbacks
+    "ILSVRC2012": ((64, 64, 3), 1000, 64),
+    "gld23k": ((64, 64, 3), 203, 65),
+    "gld160k": ((64, 64, 3), 2028, 66),
 }
 
 
@@ -152,6 +162,104 @@ def load_partition_data(
         alpha = float(parts[1]) if len(parts) > 2 else 1.0
         beta = float(parts[2]) if len(parts) > 2 else 1.0
         return synthetic_alpha_beta(alpha, beta, client_num=client_num)
+    elif dataset in _IMG_SPECS:
+        # ImageNet / Google Landmarks: real pipelines need the archives on
+        # disk (zero-egress image); offline the shape/cardinality-faithful
+        # synthetic stand-in keeps configs and models runnable
+        shape, class_num, seed = _IMG_SPECS[dataset]
+        n_tr, n_te = (max(class_num, int(s * scale)) for s in _SIZES[dataset])
+        train, test = make_classification_like(n_tr, n_te, shape, class_num, seed=seed)
+    elif dataset == "stackoverflow_lr":
+        # reference: bag-of-words logistic regression, 10k vocab counts ->
+        # 500 tag classes (data/stackoverflow/data_loader.py)
+        vocab, tags = (10000, 500) if not small else (200, 20)
+        n_tr, n_te = (int(40000 * scale) or 256, int(5000 * scale) or 64)
+        rng = np.random.default_rng(17)
+        proto = rng.normal(size=(tags, vocab)).astype(np.float32)
+
+        def gen_bow(n, s):
+            r = np.random.default_rng(s)
+            y = r.integers(0, tags, n).astype(np.int32)
+            counts = r.poisson(1.0, (n, vocab)).astype(np.float32)
+            counts += np.maximum(proto[y], 0)  # tag-correlated word mass
+            return ArrayPair(np.log1p(counts), y)
+
+        train, test = gen_bow(n_tr, 18), gen_bow(n_te, 19)
+        class_num = tags
+    elif dataset in ("UCI", "uci_adult", "lending_club_loan"):
+        # tabular binary classification (reference data/UCI, data/lending_club_loan)
+        n_feat = 14 if dataset != "lending_club_loan" else 90
+        n_tr, n_te = (int(30000 * scale) or 200, int(5000 * scale) or 64)
+        rng = np.random.default_rng(23)
+        w = rng.normal(size=(n_feat,))
+
+        def gen_tab(n, s):
+            r = np.random.default_rng(s)
+            x = r.normal(size=(n, n_feat)).astype(np.float32)
+            y = ((x @ w + 0.3 * r.normal(size=n)) > 0).astype(np.int32)
+            return ArrayPair(x, y)
+
+        train, test = gen_tab(n_tr, 24), gen_tab(n_te, 25)
+        class_num = 2
+    elif dataset == "NUS_WIDE":
+        # multi-modal tabular features (reference data/NUS_WIDE feeds vertical
+        # FL: 634 low-level image features + 1000 tag features, 2+ parties)
+        n_feat = 634 + 1000 if not small else 64
+        n_tr, n_te = (int(20000 * scale) or 200, int(4000 * scale) or 64)
+        rng = np.random.default_rng(29)
+        w = rng.normal(size=(n_feat, 5))
+
+        def gen_nus(n, s):
+            r = np.random.default_rng(s)
+            x = r.normal(size=(n, n_feat)).astype(np.float32)
+            y = np.argmax(x @ w + 0.5 * r.normal(size=(n, 5)), axis=1).astype(np.int32)
+            return ArrayPair(x, y)
+
+        train, test = gen_nus(n_tr, 30), gen_nus(n_te, 31)
+        class_num = 5
+    elif dataset in ("fets2021", "FeTS2021"):
+        # medical segmentation (reference data/FeTS2021); 2D stand-in with 4
+        # tissue classes, per-pixel labels flattened like seg_synthetic
+        h = w = 32
+        n_tr, n_te = (int(2000 * scale) or 64, int(400 * scale) or 32)
+        rng = np.random.default_rng(41)
+
+        def gen_fets(n, r):
+            x = r.normal(0, 0.1, (n, h, w, 4)).astype(np.float32)  # 4 modalities
+            y = np.zeros((n, h * w), np.int32)
+            for i in range(n):
+                for cls in (1, 2, 3):
+                    r0, c0 = r.integers(0, h - 6), r.integers(0, w - 6)
+                    x[i, r0:r0 + 6, c0:c0 + 6, cls % 4] += 0.8
+                    m = y[i].reshape(h, w)
+                    m[r0:r0 + 6, c0:c0 + 6] = cls
+            return ArrayPair(x, y)
+
+        train, test = gen_fets(n_tr, rng), gen_fets(n_te, rng)
+        class_num = 4
+    elif dataset in ("20news", "agnews", "text_classification"):
+        # FedNLP text classification (reference app/fednlp/text_classification;
+        # 20news via data/FedNLP loaders). Synthetic stand-in: class-topical
+        # token distributions over a vocab, fixed-length sequences.
+        n_cls = 20 if dataset == "20news" else 4
+        vocab = 2000 if not small else 256
+        seq_len = 128 if not small else 32
+        n_tr, n_te = (int(11314 * scale) or 200, int(7532 * scale) or 64)
+        rng = np.random.default_rng(51)
+        topics = rng.dirichlet(np.full(vocab, 0.05), size=n_cls)
+
+        def gen_text(n, s):
+            r = np.random.default_rng(s)
+            y = r.integers(0, n_cls, n).astype(np.int32)
+            x = np.zeros((n, seq_len), np.int32)
+            for c in range(n_cls):
+                idx = np.where(y == c)[0]
+                if len(idx):
+                    x[idx] = r.choice(vocab, size=(len(idx), seq_len), p=topics[c])
+            return ArrayPair(x, y)
+
+        train, test = gen_text(n_tr, 52), gen_text(n_te, 53)
+        class_num = n_cls
     elif dataset == "seg_synthetic":
         # federated segmentation stand-in (FedSeg): images with a bright
         # square; labels = per-pixel {bg, fg} flattened to (H*W,) tokens so

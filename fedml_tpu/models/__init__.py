@@ -23,7 +23,7 @@ from .resnet import CifarResNet, ResNet18
 from .rnn import RNNOriginalFedAvg, RNNStackOverFlow
 from .mobilenet import MobileNetV1
 from .mobilenet_v3 import EfficientNetLite, MobileNetV3Small, VGG
-from .transformer import TransformerLM, ViT
+from .transformer import TransformerClassifier, TransformerLM, ViT
 from .gan import Discriminator, Generator
 from .gkt import GKTClientNet, GKTServerNet
 from .darts import DARTSSearchNet, derive_genotype
@@ -34,7 +34,7 @@ __all__ = [
     "LogisticRegression", "CNNDropOut", "CNNOriginalFedAvg",
     "CifarResNet", "ResNet18", "RNNOriginalFedAvg", "RNNStackOverFlow",
     "MobileNetV1", "MobileNetV3Small", "EfficientNetLite", "VGG",
-    "TransformerLM", "ViT",
+    "TransformerLM", "TransformerClassifier", "ViT",
     "Generator", "Discriminator", "GKTClientNet", "GKTServerNet",
     "DARTSSearchNet", "derive_genotype", "UNetLite",
 ]
@@ -83,6 +83,12 @@ def create(args, output_dim: int):
         return RNNOriginalFedAvg(vocab_size=output_dim, dtype=dtype)
     if model_name == "transformer_lm":
         return TransformerLM(vocab_size=output_dim, dtype=dtype)
+    if model_name in ("transformer_classifier", "bert_tiny"):
+        vocab = int(getattr(args, "vocab_size", 2000) or 2000)
+        return TransformerClassifier(
+            num_classes=output_dim, vocab_size=vocab,
+            max_len=int(getattr(args, "max_seq_len", 512) or 512), dtype=dtype,
+        )
     if model_name == "vit":
         return ViT(num_classes=output_dim, dtype=dtype)
     raise ValueError(f"unknown model '{model_name}'")

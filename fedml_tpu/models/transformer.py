@@ -120,6 +120,35 @@ class TransformerLM(nn.Module):
         return nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype, name="head")(h)
 
 
+class TransformerClassifier(nn.Module):
+    """Encoder + CLS-pool classifier — the FedNLP text-classification model
+    family (reference ``app/fednlp/text_classification/model/bert_model.py``
+    wraps HuggingFace BERT; here a native encoder sized for federated
+    fine-tuning experiments)."""
+
+    num_classes: int = 20
+    vocab_size: int = 30522
+    dim: int = 256
+    num_heads: int = 8
+    num_layers: int = 4
+    max_len: int = 512
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        B, T = tokens.shape
+        h = nn.Embed(self.vocab_size, self.dim, dtype=self.dtype, name="wte")(tokens)
+        pos = nn.Embed(self.max_len, self.dim, dtype=self.dtype, name="wpe")(
+            jnp.arange(T)[None, :]
+        )
+        h = h + pos
+        for i in range(self.num_layers):
+            h = Block(self.dim, self.num_heads, causal=False, dtype=self.dtype,
+                      name=f"block_{i}")(h)
+        h = nn.LayerNorm(dtype=self.dtype, name="ln_f")(h)
+        return nn.Dense(self.num_classes, dtype=self.dtype, name="cls")(h.mean(axis=1))
+
+
 class ViT(nn.Module):
     """Small vision transformer (FedCV-parity family)."""
 

@@ -20,14 +20,27 @@ from jax import lax
 
 
 def multihead_attention(
-    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False,
+    impl: Optional[str] = None,
 ) -> jax.Array:
-    """Dense attention. q/k/v: (B, T, H, Dh) -> (B, T, H, Dh)."""
-    Dh = q.shape[-1]
+    """Attention. q/k/v: (B, T, H, Dh) -> (B, T, H, Dh).
+
+    ``impl``: 'flash' (pallas kernel, ops/pallas/flash_attention.py),
+    'dense', or None = auto (flash when shapes tile into whole blocks).
+    """
+    T, Dh = q.shape[1], q.shape[-1]
+    if impl is None:
+        from .pallas import flash_shapes_ok
+
+        impl = "flash" if flash_shapes_ok(T, Dh) else "dense"
+    if impl == "flash":
+        from .pallas import flash_attention
+
+        return flash_attention(q, k, v, causal)
     scale = 1.0 / jnp.sqrt(Dh).astype(q.dtype)
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     if causal:
-        T, S = logits.shape[-2], logits.shape[-1]
+        S = logits.shape[-1]
         mask = jnp.tril(jnp.ones((T, S), dtype=bool))
         logits = jnp.where(mask, logits, jnp.finfo(logits.dtype).min)
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)

@@ -1,0 +1,89 @@
+"""Extended loaders, poisoned/centralized modes, device mapping."""
+
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu import data as data_mod
+from fedml_tpu.device import load_device_mapping, mapping_for_rank, total_processes
+
+
+@pytest.mark.parametrize("dataset,classes", [
+    ("ILSVRC2012", 1000), ("gld23k", 203), ("stackoverflow_lr", 20),
+    ("UCI", 2), ("lending_club_loan", 2), ("NUS_WIDE", 5), ("fets2021", 4),
+])
+def test_extended_loaders_shapes(dataset, classes):
+    args = fedml_tpu.init(config=dict(
+        dataset=dataset, debug_small_data=True, client_num_in_total=4,
+        partition_method="homo", random_seed=0))
+    fed, class_num = data_mod.load(args)
+    assert class_num == classes
+    assert fed.client_num == 4
+    assert fed.train_data_num > 0 and fed.test_data_num > 0
+    # tuple contract parity
+    t = fed.to_tuple()
+    assert len(t) == 8 and t[7] == classes
+
+
+def test_centralized_mode_single_client():
+    args = fedml_tpu.init(config=dict(
+        dataset="mnist", debug_small_data=True, centralized=True,
+        client_num_in_total=10, random_seed=0))
+    fed, _ = data_mod.load(args)
+    assert fed.client_num == 1
+    assert fed.train_data_local_num_dict[0] == fed.train_data_num
+
+
+def test_poisoned_clients_trigger_and_label():
+    args = fedml_tpu.init(config=dict(
+        dataset="mnist", debug_small_data=True, client_num_in_total=4,
+        partition_method="homo", poison_ratio=0.5, poison_target_label=7,
+        random_seed=0))
+    fed, _ = data_mod.load(args)
+    poisoned = [
+        c for c, p in fed.train_data_local_dict.items()
+        if (p.y == 7).all() and len(p.y) > 0
+    ]
+    assert len(poisoned) == 2
+
+
+def test_device_mapping_yaml(tmp_path):
+    f = tmp_path / "gpu_mapping.yaml"
+    f.write_text("""
+mapping_default:
+  host1: [2, 1]
+  host2: [1]
+""")
+    mapping = load_device_mapping(str(f))
+    assert total_processes(mapping) == 4
+    assert mapping_for_rank(mapping, 0) == [0]
+    assert mapping_for_rank(mapping, 1) == [0]
+    assert mapping_for_rank(mapping, 2) == [1]
+    assert mapping_for_rank(mapping, 3) == [0]  # host2 slot 0
+    with pytest.raises(ValueError):
+        mapping_for_rank(mapping, 4)
+
+
+def test_get_device_returns_jax_device():
+    import jax
+
+    d = fedml_tpu.device.get_device(None) if hasattr(fedml_tpu, "device") else None
+    from fedml_tpu.device import get_device
+
+    d = get_device(None)
+    assert d in jax.devices()
+
+
+def test_fednlp_text_classification_learns():
+    from fedml_tpu.simulation import build_simulator
+
+    args = fedml_tpu.init(config=dict(
+        dataset="20news", model="transformer_classifier", vocab_size=256,
+        max_seq_len=32, debug_small_data=True, client_num_in_total=6,
+        client_num_per_round=6, comm_round=3, learning_rate=1e-3,
+        client_optimizer="adam", batch_size=8, frequency_of_the_test=2,
+        random_seed=0))
+    sim, apply_fn = build_simulator(args)
+    hist = sim.run(apply_fn, log_fn=None)
+    assert hist[-1]["train_loss"] < hist[0]["train_loss"]
+    assert hist[-1]["test_acc"] > 0.2  # 20 classes, random = 0.05
