@@ -72,10 +72,19 @@ class FedMLAggregator:
 
     def check_whether_all_receive(self) -> bool:
         if all(self.flag_client_model_uploaded_dict.values()):
-            for i in range(self.client_num):
-                self.flag_client_model_uploaded_dict[i] = False
+            self.reset_flags()
             return True
         return False
+
+    def reset_flags(self) -> None:
+        """Clear the per-round receive barrier (also used by the straggler
+        timeout path, which aggregates a partial cohort)."""
+        for i in range(self.client_num):
+            self.flag_client_model_uploaded_dict[i] = False
+
+    @property
+    def received_count(self) -> int:
+        return len(self.model_dict)
 
     def _aggregate_stacked(self, stacked: PyTree, weights: jax.Array) -> PyTree:
         if self._robust is not None:

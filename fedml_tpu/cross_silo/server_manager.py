@@ -14,6 +14,7 @@ ride the binary codec instead of pickle/S3 URLs.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -46,6 +47,16 @@ class FedMLServerManager(ServerManager):
         self.is_initialized = False
         self.start_running_time = 0.0
         self.history: List[Dict[str, float]] = []
+        # straggler tolerance (ours; the reference barrier waits forever —
+        # SURVEY.md §5.3): if set, a round closes round_timeout seconds after
+        # its first upload with whatever subset arrived (>= min_clients)
+        self.round_timeout: Optional[float] = (
+            float(getattr(args, "round_timeout", 0)) or None
+        )
+        self.min_clients = int(getattr(args, "min_clients_per_round", 1))
+        self._round_lock = threading.Lock()
+        self._round_gen = 0  # increments at each round completion
+        self._timer: Optional[threading.Timer] = None
 
     # --- round protocol -----------------------------------------------------
 
@@ -107,12 +118,65 @@ class FedMLServerManager(ServerManager):
     def _on_model_from_client(self, msg: Message) -> None:
         model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         local_sample_num = msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
-        # map real edge id -> dense slot index for the barrier bookkeeping
-        slot = self.client_id_list_in_this_round.index(msg.get_sender_id())
-        self.aggregator.add_local_trained_result(slot, model_params, local_sample_num)
-        if not self.aggregator.check_whether_all_receive():
-            return
+        with self._round_lock:
+            msg_round = msg.get(MyMessage.MSG_ARG_KEY_ROUND_INDEX)
+            stale = msg_round is not None and int(msg_round) != self.round_idx
+            if stale or msg.get_sender_id() not in self.client_id_list_in_this_round:
+                logging.warning(
+                    "server: stale/late upload from %d (round %s, now %d) ignored",
+                    msg.get_sender_id(), msg_round, self.round_idx,
+                )
+                return
+            # map real edge id -> dense slot index for the barrier bookkeeping
+            slot = self.client_id_list_in_this_round.index(msg.get_sender_id())
+            self.aggregator.add_local_trained_result(slot, model_params, local_sample_num)
+            if self.round_timeout and self._timer is None:
+                gen = self._round_gen
+                self._timer = threading.Timer(
+                    self.round_timeout, self._on_round_timeout, args=(gen,)
+                )
+                self._timer.daemon = True
+                self._timer.start()
+            if not self.aggregator.check_whether_all_receive():
+                return
+            self._complete_round()
 
+    def _on_round_timeout(self, gen: int) -> None:
+        with self._round_lock:
+            if gen != self._round_gen:
+                return  # round already completed normally
+            n = self.aggregator.received_count
+            if n < self.min_clients:
+                logging.error(
+                    "server: round %d timed out with %d/%d uploads (< min %d) — "
+                    "extending wait", self.round_idx, n,
+                    len(self.client_id_list_in_this_round), self.min_clients,
+                )
+                self._timer = threading.Timer(
+                    self.round_timeout, self._on_round_timeout, args=(gen,)
+                )
+                self._timer.daemon = True
+                self._timer.start()
+                return
+            missing = [
+                cid for i, cid in enumerate(self.client_id_list_in_this_round)
+                if i not in self.aggregator.model_dict
+            ]
+            logging.warning(
+                "server: round %d closing on timeout with %d/%d uploads "
+                "(stragglers: %s)", self.round_idx, n,
+                len(self.client_id_list_in_this_round), missing,
+            )
+            self.aggregator.reset_flags()
+            self._complete_round()
+
+    def _complete_round(self) -> None:
+        """Aggregate whatever the round collected and start the next one.
+        Caller holds the round lock."""
+        self._round_gen += 1
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
         self.aggregator.aggregate()
         metrics = self.aggregator.test_on_server_for_all_clients(self.round_idx) or {}
         self.history.append({"round": self.round_idx, **metrics})

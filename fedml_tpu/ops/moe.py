@@ -1,0 +1,76 @@
+"""Mixture-of-Experts FFN with expert parallelism.
+
+Absent in the reference (like TP/SP, SURVEY.md §2.8); first-class here
+because the ``expert`` mesh axis is part of the parallelism contract. Design:
+top-1 gating with capacity factor; dispatch/combine are einsums against a
+one-hot routing tensor, so the whole layer is dense linear algebra the MXU
+likes; the stacked expert weights (E, D, H) shard over ``AXIS_EXPERT`` and
+GSPMD turns the dispatch einsum into the all-to-all. Aux load-balancing loss
+follows Shazeer et al. (fraction-routed x mean-gate dot product).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def top1_routing(
+    gate_logits: jax.Array, num_experts: int, capacity: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """(B*T, E) logits -> (dispatch (N, E, C), combine (N, E, C), aux_loss).
+
+    Tokens beyond an expert's capacity are dropped (standard top-1 MoE);
+    position-in-expert computed with a cumulative sum, everything static-shape.
+    """
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)                      # (N,)
+    expert_onehot = jax.nn.one_hot(expert_idx, num_experts)      # (N, E)
+    # position of each token within its expert's queue
+    pos_in_expert = (jnp.cumsum(expert_onehot, axis=0) - 1.0) * expert_onehot
+    keep = (pos_in_expert < capacity) * expert_onehot            # (N, E)
+    pos = jnp.clip(pos_in_expert.astype(jnp.int32), 0, capacity - 1)
+    pos_onehot = jax.nn.one_hot(pos, capacity) * keep[..., None]  # (N, E, C)
+    gate = (probs * keep).sum(axis=-1, keepdims=True)            # (N, 1)
+    dispatch = pos_onehot
+    combine = pos_onehot * gate[..., None]
+    # aux load-balance loss: E * <fraction routed, mean gate prob>
+    frac = expert_onehot.mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    aux = num_experts * jnp.sum(frac * mean_prob)
+    return dispatch, combine, aux
+
+
+class MoEBlock(nn.Module):
+    """Top-1 MoE FFN. Input (B, T, D) -> (B, T, D); stacked expert kernels
+    (E, D, H)/(E, H, D) are the leaves to shard over ``AXIS_EXPERT``."""
+
+    num_experts: int = 8
+    dim: int = 256
+    hidden_mult: int = 4
+    capacity_factor: float = 1.25
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B, T, D = x.shape
+        N = B * T
+        E = self.num_experts
+        H = self.dim * self.hidden_mult
+        C = max(1, int(self.capacity_factor * N / E))
+        tokens = x.reshape(N, D)
+        gate_logits = nn.Dense(E, use_bias=False, dtype=self.dtype, name="gate")(tokens)
+        dispatch, combine, aux = top1_routing(gate_logits, E, C)
+        self.sow("intermediates", "moe_aux_loss", aux)
+
+        w_in = self.param("w_in", nn.initializers.lecun_normal(), (E, D, H), self.dtype)
+        w_out = self.param("w_out", nn.initializers.lecun_normal(), (E, H, D), self.dtype)
+        # dispatch: (N, E, C) x (N, D) -> (E, C, D); per-expert FFN; combine back
+        expert_in = jnp.einsum("nec,nd->ecd", dispatch.astype(self.dtype), tokens)
+        hidden = jax.nn.gelu(jnp.einsum("ecd,edh->ech", expert_in, w_in))
+        expert_out = jnp.einsum("ech,ehd->ecd", hidden, w_out)
+        out = jnp.einsum("nec,ecd->nd", combine.astype(self.dtype), expert_out)
+        return out.reshape(B, T, D)
