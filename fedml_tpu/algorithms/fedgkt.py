@@ -148,19 +148,22 @@ class FedGKTSimulator:
 
     def run(self, log_fn=print) -> List[Dict[str, float]]:
         cfg = self.cfg
-        pack_rng = np.random.default_rng(cfg.seed)
         client_ids = np.arange(cfg.client_num_in_total)
         n_classes = self.fed.class_num
+        # pack ONCE with a stable order: server logits are per-(client, batch,
+        # slot) and must stay aligned with the same samples across rounds —
+        # a per-round reshuffle would distill each example toward another
+        # example's teacher distribution
+        batches = self.fed.pack_clients(
+            client_ids, cfg.batch_size, self.num_local_batches, rng=None
+        )
+        cohort = {
+            "x": jnp.asarray(batches.x),
+            "y": jnp.asarray(batches.y),
+            "mask": jnp.asarray(batches.mask),
+        }
         for round_idx in range(cfg.comm_round):
             t0 = time.perf_counter()
-            batches = self.fed.pack_clients(
-                client_ids, cfg.batch_size, self.num_local_batches, rng=pack_rng
-            )
-            cohort = {
-                "x": jnp.asarray(batches.x),
-                "y": jnp.asarray(batches.y),
-                "mask": jnp.asarray(batches.mask),
-            }
             if self.server_logits is None:
                 self.server_logits = jnp.zeros(
                     cohort["y"].shape + (n_classes,), jnp.float32

@@ -53,6 +53,7 @@ class MLOpsRuntimeLog:
     def __init__(self, args):
         self.args = args
         self.origin_excepthook = None
+        self._hook_installed = False
 
     @classmethod
     def get_instance(cls, args) -> "MLOpsRuntimeLog":
@@ -79,7 +80,11 @@ class MLOpsRuntimeLog:
                 os.path.join(log_dir, f"fedml-run-{run_id}-edge-{edge_id}.log")
             ))
         logging.basicConfig(level=logging.INFO, format=fmt, handlers=handlers, force=True)
-        # capture uncaught exceptions into the log (reference :30)
+        # capture uncaught exceptions into the log (reference :30); install
+        # once — re-init must not capture our own hook as the "original"
+        # (that would recurse on the next uncaught exception)
+        if self._hook_installed:
+            return
         self.origin_excepthook = sys.excepthook
 
         def hook(exc_type, exc_value, exc_tb):
@@ -88,6 +93,7 @@ class MLOpsRuntimeLog:
                 self.origin_excepthook(exc_type, exc_value, exc_tb)
 
         sys.excepthook = hook
+        self._hook_installed = True
 
 
 class MLOpsMetrics:

@@ -83,8 +83,8 @@ class ServerMNN:
 
     def __init__(self, args, fed_data, variables, apply_fn=None,
                  backend: str = "LOOPBACK", **kw):
-        n_clients = int(getattr(args, "client_num_per_round",
-                                getattr(args, "client_num_in_total", 1)))
+        n_clients = int(getattr(args, "client_num_in_total",
+                                getattr(args, "client_num_per_round", 1)))
         self.aggregator = FedMLCrossDeviceAggregator(
             fed_data.test_data_global,
             fed_data.train_data_global,

@@ -44,6 +44,10 @@ class FedMLAggregator:
         self.model_dict: Dict[int, PyTree] = {}
         self.sample_num_dict: Dict[int, float] = {}
         self.flag_client_model_uploaded_dict = {i: False for i in range(client_num)}
+        # cohort size of the current round; rounds may select fewer clients
+        # than client_num (client_num_per_round < total), so the barrier
+        # compares against this, not the full flag dict
+        self.expected_this_round = client_num
         defense = getattr(args, "defense_type", None)
         self._robust = RobustAggregator(
             defense_type=defense,
@@ -70,8 +74,14 @@ class FedMLAggregator:
         self.sample_num_dict[index] = float(sample_num)
         self.flag_client_model_uploaded_dict[index] = True
 
+    def set_expected_this_round(self, n: int) -> None:
+        self.expected_this_round = int(n)
+
     def check_whether_all_receive(self) -> bool:
-        if all(self.flag_client_model_uploaded_dict.values()):
+        """True once every client *selected this round* has uploaded (the
+        reference checks the full flag dict, which deadlocks whenever
+        client_num_per_round < client_num)."""
+        if self.received_count >= self.expected_this_round:
             self.reset_flags()
             return True
         return False

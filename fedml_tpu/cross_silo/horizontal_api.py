@@ -83,9 +83,11 @@ class Server:
 
     def __init__(self, args, mesh=None, backend: Optional[str] = None, **kw):
         backend = backend or str(getattr(args, "backend", "LOOPBACK"))
+        # client_num = connected silos (ranks 1..N); per-round selection may
+        # pick a subset — the round barrier tracks the cohort, not N
         self.manager = FedML_Horizontal(
-            args, 0, int(getattr(args, "client_num_per_round",
-                                 getattr(args, "client_num_in_total", 1))),
+            args, 0, int(getattr(args, "client_num_in_total",
+                                 getattr(args, "client_num_per_round", 1))),
             backend=backend, mesh=mesh, **kw,
         )
 
@@ -102,8 +104,8 @@ class Client:
         backend = backend or str(getattr(args, "backend", "LOOPBACK"))
         rank = int(getattr(args, "rank", 1))
         self.manager = FedML_Horizontal(
-            args, rank, int(getattr(args, "client_num_per_round",
-                                    getattr(args, "client_num_in_total", 1))),
+            args, rank, int(getattr(args, "client_num_in_total",
+                                    getattr(args, "client_num_per_round", 1))),
             backend=backend, mesh=mesh, **kw,
         )
 

@@ -67,6 +67,7 @@ class FedMLServerManager(ServerManager):
 
     def send_init_msg(self) -> None:
         self.start_running_time = time.time()
+        self.aggregator.set_expected_this_round(len(self.client_id_list_in_this_round))
         global_model_params = self.aggregator.get_global_model_params()
         for idx, client_id in enumerate(self.client_id_list_in_this_round):
             msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank, client_id)
@@ -195,6 +196,7 @@ class FedMLServerManager(ServerManager):
             int(getattr(self.args, "client_num_in_total", self.client_num)),
             len(self.client_id_list_in_this_round),
         )
+        self.aggregator.set_expected_this_round(len(self.client_id_list_in_this_round))
         global_model_params = self.aggregator.get_global_model_params()
         for idx, client_id in enumerate(self.client_id_list_in_this_round):
             sync = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, client_id)

@@ -45,8 +45,11 @@ def top1_routing(
 
 
 class MoEBlock(nn.Module):
-    """Top-1 MoE FFN. Input (B, T, D) -> (B, T, D); stacked expert kernels
-    (E, D, H)/(E, H, D) are the leaves to shard over ``AXIS_EXPERT``."""
+    """Top-1 MoE FFN. Input (B, T, D) -> ``(out (B, T, D), aux_loss scalar)``;
+    stacked expert kernels (E, D, H)/(E, H, D) are the leaves to shard over
+    ``AXIS_EXPERT``. Callers must add ``aux_weight * aux_loss`` (typically
+    1e-2) to their objective — without it the router has no balancing
+    pressure and can collapse all tokens onto one expert."""
 
     num_experts: int = 8
     dim: int = 256
@@ -64,7 +67,6 @@ class MoEBlock(nn.Module):
         tokens = x.reshape(N, D)
         gate_logits = nn.Dense(E, use_bias=False, dtype=self.dtype, name="gate")(tokens)
         dispatch, combine, aux = top1_routing(gate_logits, E, C)
-        self.sow("intermediates", "moe_aux_loss", aux)
 
         w_in = self.param("w_in", nn.initializers.lecun_normal(), (E, D, H), self.dtype)
         w_out = self.param("w_out", nn.initializers.lecun_normal(), (E, H, D), self.dtype)
@@ -73,4 +75,4 @@ class MoEBlock(nn.Module):
         hidden = jax.nn.gelu(jnp.einsum("ecd,edh->ech", expert_in, w_in))
         expert_out = jnp.einsum("ech,ehd->ecd", hidden, w_out)
         out = jnp.einsum("nec,ecd->nd", combine.astype(self.dtype), expert_out)
-        return out.reshape(B, T, D)
+        return out.reshape(B, T, D), aux

@@ -106,9 +106,10 @@ class DistributedLMTrainer:
             seq_axis=AXIS_SEQ if cfg.sp > 1 else None,
             mesh=self.mesh if cfg.sp > 1 else None,
         )
-        # init on host with a tiny batch, then place with TP shardings
+        # init on host with a tiny batch, then place with TP shardings; the
+        # init token length must divide by sp (ring attention shards T)
         variables = self.model.init(
-            jax.random.PRNGKey(seed), jnp.zeros((1, max(8, cfg.sp)), jnp.int32)
+            jax.random.PRNGKey(seed), jnp.zeros((1, 8 * max(1, cfg.sp)), jnp.int32)
         )
         self.param_specs = transformer_param_specs(variables)
         self.param_shardings = jax.tree.map(

@@ -68,6 +68,15 @@ def test_cross_silo_online_handshake_gates_init():
     assert server.is_initialized
 
 
+def test_cross_silo_subset_cohort_no_deadlock():
+    """client_num_per_round < connected silos: the round barrier must track
+    the cohort, not the full silo set (review finding: full-flag-dict check
+    deadlocks)."""
+    args = _args(client_num_in_total=3, client_num_per_round=2, comm_round=3)
+    server = _run_deployment(args, n_clients=3)
+    assert len(server.history) == 3
+
+
 def test_cross_silo_grpc_full_run():
     pytest.importorskip("grpc")
     args = _args(comm_round=2, grpc_base_port=19200)

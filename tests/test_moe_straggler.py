@@ -40,9 +40,10 @@ def test_moe_block_runs_and_shards():
     shardings = jax.tree_util.tree_map_with_path(spec_for, params)
     params = jax.device_put(params, shardings)
     x_sh = jax.device_put(x, NamedSharding(mesh, P(AXIS_DATA)))
-    out = jax.jit(lambda p, x: block.apply(p, x))(params, x_sh)
+    out, aux = jax.jit(lambda p, x: block.apply(p, x))(params, x_sh)
     assert out.shape == x.shape
     assert np.isfinite(np.asarray(out)).all()
+    assert np.isfinite(float(aux))
 
 
 def test_moe_learns_routing():
@@ -60,8 +61,8 @@ def test_moe_learns_routing():
     @jax.jit
     def step(params, state):
         def loss_fn(p):
-            out = block.apply(p, x)
-            return jnp.mean(jnp.square(out - y))
+            out, aux = block.apply(p, x)
+            return jnp.mean(jnp.square(out - y)) + 1e-2 * aux
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         upd, state2 = opt.update(grads, state, params)
