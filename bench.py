@@ -7,6 +7,11 @@ against a fixed denominator of 1.0 round/sec — a conservative stand-in for the
 reference NCCL simulator per-round wall-clock at this workload — until a
 reproduced reference run provides a real one.
 
+Precision: bf16 compute / f32 params + f32 aggregation (standard TPU mixed
+precision; the MXU natively runs bf16). Measured on the single v-chip:
+fp32 0.685 rounds/sec -> bf16 3.40 rounds/sec (4.96x), with matching loss
+trajectories at this scale.
+
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
 
@@ -27,6 +32,7 @@ def main() -> None:
         partition_alpha=0.5, client_num_in_total=100, client_num_per_round=10,
         comm_round=1 + rounds_timed, learning_rate=0.01, epochs=1,
         batch_size=64, frequency_of_the_test=10_000, random_seed=0,
+        use_bf16=True,
     ))
     sim, apply_fn = build_simulator(args)
 

@@ -260,6 +260,33 @@ def load_partition_data(
 
         train, test = gen_text(n_tr, 52), gen_text(n_te, 53)
         class_num = n_cls
+    elif dataset in ("moleculenet", "graph_synthetic"):
+        # FedGraphNN molecule-property stand-in: fixed-size graphs packed as
+        # [features | adjacency] (models/gcn.py); label depends on a motif
+        # (triangle density) so there is graph structure to learn
+        n_nodes, n_feat = 16, 8
+        n_tr, n_te = (int(4000 * scale) or 128, int(800 * scale) or 48)
+        rng = np.random.default_rng(61)
+
+        def gen_graph(n, s):
+            r = np.random.default_rng(s)
+            x = np.zeros((n, n_nodes, n_feat + n_nodes), np.float32)
+            y = np.zeros(n, np.int32)
+            for i in range(n):
+                p = r.choice([0.15, 0.45])  # sparse vs dense graphs
+                a = (r.random((n_nodes, n_nodes)) < p).astype(np.float32)
+                a = np.triu(a, 1)
+                a = a + a.T
+                feats = r.normal(size=(n_nodes, n_feat)).astype(np.float32)
+                # node degree as an informative feature channel
+                feats[:, 0] = a.sum(1) / n_nodes
+                x[i, :, :n_feat] = feats
+                x[i, :, n_feat:] = a
+                y[i] = int(p > 0.3)
+            return ArrayPair(x, y)
+
+        train, test = gen_graph(n_tr, 62), gen_graph(n_te, 63)
+        class_num = 2
     elif dataset == "seg_synthetic":
         # federated segmentation stand-in (FedSeg): images with a bright
         # square; labels = per-pixel {bg, fg} flattened to (H*W,) tokens so

@@ -28,6 +28,7 @@ from .gan import Discriminator, Generator
 from .gkt import GKTClientNet, GKTServerNet
 from .darts import DARTSSearchNet, derive_genotype
 from .unet import UNetLite
+from .gcn import GCNGraphClassifier
 
 __all__ = [
     "create", "init_params", "sample_input_for",
@@ -36,7 +37,7 @@ __all__ = [
     "MobileNetV1", "MobileNetV3Small", "EfficientNetLite", "VGG",
     "TransformerLM", "TransformerClassifier", "ViT",
     "Generator", "Discriminator", "GKTClientNet", "GKTServerNet",
-    "DARTSSearchNet", "derive_genotype", "UNetLite",
+    "DARTSSearchNet", "derive_genotype", "UNetLite", "GCNGraphClassifier",
 ]
 
 
@@ -77,6 +78,12 @@ def create(args, output_dim: int):
         return DARTSSearchNet(num_classes=output_dim, dtype=dtype)
     if model_name == "unet":
         return UNetLite(num_classes=output_dim, dtype=dtype)
+    if model_name in ("gcn", "graph"):
+        return GCNGraphClassifier(
+            num_classes=output_dim,
+            num_nodes=int(getattr(args, "graph_num_nodes", 16) or 16),
+            dtype=dtype,
+        )
     if model_name in ("rnn", "rnn_fedavg"):
         if "stackoverflow" in dataset:
             return RNNStackOverFlow(dtype=dtype)

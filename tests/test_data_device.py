@@ -87,3 +87,17 @@ def test_fednlp_text_classification_learns():
     hist = sim.run(apply_fn, log_fn=None)
     assert hist[-1]["train_loss"] < hist[0]["train_loss"]
     assert hist[-1]["test_acc"] > 0.2  # 20 classes, random = 0.05
+
+
+def test_fedgraphnn_gcn_learns():
+    from fedml_tpu.simulation import build_simulator
+
+    args = fedml_tpu.init(config=dict(
+        dataset="moleculenet", model="gcn", debug_small_data=True,
+        client_num_in_total=4, client_num_per_round=4, comm_round=8,
+        partition_method="homo", learning_rate=0.01, client_optimizer="adam",
+        epochs=2, batch_size=16, frequency_of_the_test=7, random_seed=0))
+    sim, apply_fn = build_simulator(args)
+    hist = sim.run(apply_fn, log_fn=None)
+    assert hist[-1]["train_loss"] < hist[0]["train_loss"]
+    assert hist[-1]["test_acc"] > 0.7  # structural label is easy for a GCN
