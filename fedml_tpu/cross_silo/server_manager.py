@@ -57,6 +57,14 @@ class FedMLServerManager(ServerManager):
         self._round_lock = threading.Lock()
         self._round_gen = 0  # increments at each round completion
         self._timer: Optional[threading.Timer] = None
+        # event spans around the round FSM (reference wraps server.wait /
+        # server.agg_and_eval the same way, fedml_server_manager.py:66-69)
+        self.mlops_event = None
+        if getattr(args, "enable_tracking", False):
+            from ..core.mlops import MetricsSink, MLOpsProfilerEvent
+
+            sink = MetricsSink(path=getattr(args, "tracking_path", None))
+            self.mlops_event = MLOpsProfilerEvent(args, sink=sink)
 
     # --- round protocol -----------------------------------------------------
 
@@ -178,8 +186,14 @@ class FedMLServerManager(ServerManager):
         if self._timer is not None:
             self._timer.cancel()
             self._timer = None
+        if self.mlops_event:
+            self.mlops_event.log_event_started("server.agg_and_eval",
+                                               event_value=str(self.round_idx))
         self.aggregator.aggregate()
         metrics = self.aggregator.test_on_server_for_all_clients(self.round_idx) or {}
+        if self.mlops_event:
+            self.mlops_event.log_event_ended("server.agg_and_eval",
+                                             event_value=str(self.round_idx))
         self.history.append({"round": self.round_idx, **metrics})
 
         self.round_idx += 1

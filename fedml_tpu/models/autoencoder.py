@@ -1,0 +1,24 @@
+"""Autoencoder for FedIoT anomaly detection (reference ``app/fediot``:
+a small symmetric AE over per-flow traffic feature vectors)."""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class AnomalyAutoencoder(nn.Module):
+    input_dim: int = 115   # the reference's N-BaIoT feature count
+    hidden: Sequence[int] = (64, 32, 16)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = x.astype(self.dtype)
+        for width in self.hidden:
+            h = nn.relu(nn.Dense(width, dtype=self.dtype)(h))
+        for width in list(self.hidden[-2::-1]):
+            h = nn.relu(nn.Dense(width, dtype=self.dtype)(h))
+        return nn.Dense(self.input_dim, dtype=self.dtype)(h)

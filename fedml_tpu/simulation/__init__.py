@@ -74,6 +74,7 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
         checkpoint_dir=getattr(args, "checkpoint_dir", None),
         checkpoint_frequency=int(getattr(args, "checkpoint_frequency", 10)),
         resume=bool(getattr(args, "resume", True)),
+        client_dropout_rate=float(getattr(args, "client_dropout_rate", 0.0)),
     )
 
     # two-level and serverless variants use dedicated engines

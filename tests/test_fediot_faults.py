@@ -1,0 +1,95 @@
+"""FedIoT anomaly detection + client-dropout fault injection + tracing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import fedml_tpu
+from fedml_tpu.simulation import build_simulator
+from fedml_tpu.simulation.fed_sim import FedSimulator, SimConfig
+
+
+def test_fediot_autoencoder_detects_anomalies():
+    from fedml_tpu.algorithms.fediot import (
+        anomaly_scores,
+        detection_threshold,
+        get_fediot_algorithm,
+    )
+    from fedml_tpu.data.federated import ArrayPair, build_federated_data
+    from fedml_tpu.core.partition import homo_partition
+    from fedml_tpu.models.autoencoder import AnomalyAutoencoder
+
+    rng = np.random.default_rng(0)
+    d = 20
+    # benign traffic lives on a low-dim manifold; anomalies are off-manifold
+    basis = rng.normal(size=(4, d)).astype(np.float32)
+    benign = (rng.normal(size=(600, 4)).astype(np.float32) @ basis)
+    anomalous = rng.normal(size=(100, d)).astype(np.float32) * 3.0
+    train = ArrayPair(benign[:500], np.zeros(500, np.int32))
+    test = ArrayPair(benign[500:], np.zeros(100, np.int32))
+    np.random.seed(0)
+    fed = build_federated_data(train, test, homo_partition(500, 4), 2)
+
+    model = AnomalyAutoencoder(input_dim=d, hidden=(16, 8))
+
+    def apply_fn(params, x, train=False, rngs=None):
+        return model.apply(params, x)
+
+    variables = model.init(jax.random.PRNGKey(0), jnp.zeros((1, d)))
+    alg = get_fediot_algorithm(apply_fn, lr=5e-3, epochs=2)
+    sim = FedSimulator(
+        fed, alg, variables,
+        SimConfig(comm_round=12, client_num_in_total=4, client_num_per_round=4,
+                  batch_size=32, frequency_of_the_test=100),
+    )
+    sim.run(apply_fn=None, log_fn=None)
+
+    benign_scores = anomaly_scores(apply_fn, sim.params, jnp.asarray(test.x))
+    anom_scores = anomaly_scores(apply_fn, sim.params, jnp.asarray(anomalous))
+    thresh = detection_threshold(benign_scores, k_sigma=3.0)
+    tpr = float((anom_scores > thresh).mean())
+    fpr = float((benign_scores > thresh).mean())
+    assert tpr > 0.9, (tpr, fpr)
+    assert fpr < 0.2
+
+
+def test_client_dropout_fault_injection_still_learns():
+    args = fedml_tpu.init(config=dict(
+        dataset="mnist", model="lr", debug_small_data=True,
+        client_num_in_total=10, client_num_per_round=8, comm_round=6,
+        learning_rate=0.1, batch_size=16, frequency_of_the_test=5,
+        random_seed=0, client_dropout_rate=0.4,
+    ))
+    sim, apply_fn = build_simulator(args)
+    hist = sim.run(apply_fn, log_fn=None)
+    # training survives 40% client crashes per round
+    assert hist[-1]["test_acc"] > 0.6
+    assert np.isfinite(hist[-1]["train_loss"])
+
+
+def test_cross_silo_tracing_emits_spans(tmp_path):
+    import threading
+
+    from fedml_tpu.comm import LoopbackHub
+    from fedml_tpu.cross_silo import FedML_Horizontal
+
+    args = fedml_tpu.init(config=dict(
+        dataset="mnist", model="lr", debug_small_data=True,
+        client_num_in_total=2, client_num_per_round=2, comm_round=2,
+        learning_rate=0.1, batch_size=8, frequency_of_the_test=1,
+        random_seed=0, enable_tracking=True,
+    ))
+    hub = LoopbackHub()
+    server = FedML_Horizontal(args, 0, 2, backend="LOOPBACK", hub=hub)
+    clients = [FedML_Horizontal(args, r, 2, backend="LOOPBACK", hub=hub) for r in (1, 2)]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    server.start()
+    server.run()
+    for t in threads:
+        t.join(timeout=30)
+    spans = server.mlops_event.sink.records
+    kinds = [r["kind"] for r in spans]
+    assert kinds.count("event_started") == 2 and kinds.count("event_ended") == 2
+    assert all(r["event"] == "server.agg_and_eval" for r in spans)
