@@ -91,17 +91,33 @@ def make_local_update(
     apply_fn: Callable,
     cfg: LocalTrainConfig,
     needs_dropout: bool = False,
+    has_batch_stats: bool = False,
 ) -> Callable:
     """Build the jittable per-client local update.
 
     ``data`` is one client's rectangle: dict with x (NB,BS,*feat), y (NB,BS),
     mask (NB,BS), num_samples scalar. ``client_state`` is algorithm state
     (SCAFFOLD carries (c_global, c_local); others None/empty).
+
+    ``has_batch_stats=True`` threads the mutable BatchNorm ``batch_stats``
+    collection through the batch scan: the variables dict is
+    ``{'params', 'batch_stats'}``, gradients are taken on ``params`` only,
+    running stats advance on every non-padded batch, and the shipped delta
+    covers BOTH collections — aggregation then weighted-averages the running
+    stats across clients exactly as the reference FedAvg does
+    (``simulation/sp/fedavg/fedavg_api.py:163-170`` iterates all state_dict
+    keys, BN buffers included).
     """
     opt = cfg.make_optimizer()
     loss_fn = make_loss_fn(apply_fn, needs_dropout)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
     prox_mu = 0.0 if cfg.prox_mu is None else cfg.prox_mu
+    if has_batch_stats:
+        assert not cfg.use_scaffold, (
+            "SCAFFOLD control variates are defined on params only; "
+            "combine with GroupNorm models instead"
+        )
+        return _make_bn_local_update(apply_fn, cfg, opt, prox_mu, needs_dropout)
 
     def local_update(global_params, client_state, data, rng) -> ClientOutput:
         x, y, mask = data["x"], data["y"], data["mask"]
@@ -172,14 +188,89 @@ def make_local_update(
     return local_update
 
 
-def make_eval_fn(apply_fn: Callable) -> Callable:
-    """Batched global eval: (params, x, y) -> (loss_sum, correct, count)."""
+def _make_bn_local_update(
+    apply_fn: Callable, cfg: LocalTrainConfig, opt, prox_mu: float,
+    needs_dropout: bool,
+) -> Callable:
+    """BatchNorm-threading variant of the local update (see make_local_update)."""
 
-    def eval_fn(params, x, y):
-        logits = apply_fn(params, x, train=False)
-        mask = jnp.ones_like(y, jnp.float32)
+    def bn_loss_fn(params, batch_stats, x, y, mask, rng):
+        variables = {"params": params, "batch_stats": batch_stats}
+        kwargs = {"rngs": {"dropout": rng}} if needs_dropout else {}
+        logits, updated = apply_fn(
+            variables, x, train=True, mutable=["batch_stats"], **kwargs
+        )
         loss = masked_softmax_cross_entropy(logits, y, mask)
         correct, valid = masked_accuracy(logits, y, mask)
-        return loss * y.shape[0], correct, valid
+        return loss, (correct, valid, updated["batch_stats"])
+
+    grad_fn = jax.value_and_grad(bn_loss_fn, has_aux=True)
+
+    def local_update(global_variables, client_state, data, rng) -> ClientOutput:
+        x, y, mask = data["x"], data["y"], data["mask"]
+        num_samples = data["num_samples"]
+        g_params = global_variables["params"]
+
+        def batch_step(carry, inputs):
+            params, stats, opt_state, step = carry
+            bx, by, bm = inputs
+            step_rng = jax.random.fold_in(rng, step)
+            (loss, (correct, valid, new_stats)), grads = grad_fn(
+                params, stats, bx, by, bm, step_rng
+            )
+            if prox_mu > 0.0:
+                grads = tree_add(grads, tree_scale(tree_sub(params, g_params), prox_mu))
+            bweight = (bm.sum() > 0).astype(jnp.float32)
+            grads = tree_scale(grads, bweight)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            # running stats must not advance on fully-padded batches
+            stats = jax.tree.map(
+                lambda o, n: jnp.where(bweight > 0, n, o), stats, new_stats
+            )
+            return (params, stats, opt_state, step + 1), (loss, correct, valid, bweight)
+
+        def epoch_step(carry, _):
+            carry, outs = jax.lax.scan(batch_step, carry, (x, y, mask))
+            return carry, outs
+
+        init = (
+            g_params, global_variables["batch_stats"],
+            opt.init(g_params), jnp.int32(0),
+        )
+        (params, stats, _, _), (losses, corrects, valids, bweights) = jax.lax.scan(
+            epoch_step, init, None, length=cfg.epochs
+        )
+
+        new_variables = {"params": params, "batch_stats": stats}
+        delta = tree_sub(new_variables, global_variables)
+        metrics = {
+            "train_loss": (losses * bweights).sum() / jnp.maximum(bweights.sum(), 1.0),
+            "train_correct": corrects.sum(),
+            "train_valid": valids.sum(),
+            "local_steps": bweights.sum(),
+        }
+        return ClientOutput(
+            update=delta,
+            weight=num_samples.astype(jnp.float32),
+            metrics=metrics,
+            state=client_state,
+        )
+
+    return local_update
+
+
+def make_eval_fn(apply_fn: Callable) -> Callable:
+    """Batched global eval: (params, x, y, mask) -> (loss_sum, correct, count).
+
+    ``mask`` is a per-example validity mask so the last (padded) eval batch
+    contributes exactly its real samples — no tail truncation error.
+    """
+
+    def eval_fn(params, x, y, mask):
+        logits = apply_fn(params, x, train=False)
+        loss = masked_softmax_cross_entropy(logits, y, mask)
+        correct, valid = masked_accuracy(logits, y, mask)
+        return loss * valid, correct, valid
 
     return eval_fn

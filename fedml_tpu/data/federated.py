@@ -31,6 +31,20 @@ class ArrayPair(NamedTuple):
         return len(self.x)
 
 
+class ClientIndexBatches(NamedTuple):
+    """Index-only cohort rectangle for the device-resident data path.
+
+    idx (C, NB, BS) int32 rows into the *global* train arrays (0 for padding),
+    mask (C, NB, BS) float32 {0,1}, num_samples (C,) int32. The simulator
+    ships only these few KB to the device and gathers x/y from HBM-resident
+    global arrays inside the compiled round step.
+    """
+
+    idx: np.ndarray
+    mask: np.ndarray
+    num_samples: np.ndarray
+
+
 class ClientBatches(NamedTuple):
     """Rectangular padded batches for a cohort of clients.
 
@@ -76,6 +90,43 @@ class FederatedData:
             self.class_num,
         )
 
+    def pack_client_index(
+        self,
+        client_ids: Sequence[int],
+        batch_size: int,
+        num_batches: int | None = None,
+        rng: np.random.Generator | None = None,
+    ) -> ClientIndexBatches:
+        """Index-only counterpart of ``pack_clients`` (device-resident path).
+
+        Consumes ``rng`` identically to ``pack_clients`` (one permutation per
+        client, in cohort order) so a run is bit-reproducible whichever path
+        packs a given round.
+        """
+        assert self._global_index is not None
+        idx_lists = [self._global_index[c] for c in client_ids]
+        sizes = np.asarray([len(ix) for ix in idx_lists], dtype=np.int32)
+        if num_batches is None:
+            num_batches = max(1, -(-int(sizes.max()) // batch_size))
+        cap = num_batches * batch_size
+        C = len(idx_lists)
+        idx = np.zeros((C, cap), dtype=np.int32)
+        mask = np.zeros((C, cap), dtype=np.float32)
+        for i, ix in enumerate(idx_lists):
+            n = min(len(ix), cap)
+            order = (
+                rng.permutation(len(ix))[:n] if rng is not None
+                else np.arange(n)
+            )
+            idx[i, :n] = ix[order]
+            mask[i, :n] = 1.0
+        shape = (C, num_batches, batch_size)
+        return ClientIndexBatches(
+            idx=idx.reshape(shape),
+            mask=mask.reshape(shape),
+            num_samples=np.minimum(sizes, cap).astype(np.int32),
+        )
+
     def pack_clients(
         self,
         client_ids: Sequence[int],
@@ -108,8 +159,14 @@ class FederatedData:
             perms = [rng.permutation(len(p)) for p in pairs]
 
         # fast path: fused native shuffle+gather+pad over the global arrays
-        # (fedml_tpu/native); falls back to the numpy loop below
-        if self._global_index is not None and pairs[0].x.dtype == np.float32:
+        # (fedml_tpu/native); falls back to the numpy loop below. The native
+        # codec carries labels as int32, so float (regression) labels must
+        # take the numpy path or they'd be silently truncated.
+        if (
+            self._global_index is not None
+            and pairs[0].x.dtype == np.float32
+            and np.issubdtype(pairs[0].y.dtype, np.integer)
+        ):
             from .. import native
 
             if native.native_available():

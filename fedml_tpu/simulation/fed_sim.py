@@ -128,7 +128,7 @@ class FedSimulator:
     def _build_round_step(self) -> Callable:
         alg = self.alg
 
-        def round_step(params, server_state, cohort, client_states, rng):
+        def round_body(params, server_state, cohort, client_states, rng):
             C = cohort["num_samples"].shape[0]
             rngs = jax.random.split(rng, C)
             outs = jax.vmap(alg.local_update, in_axes=(None, 0, 0, 0))(
@@ -150,15 +150,39 @@ class FedSimulator:
             metrics = {k: v for k, v in outs.metrics.items()}
             return new_params, new_server_state, outs.state, metrics
 
+        if self._use_device_data:
+            # device-resident path: the cohort carries only an index rectangle;
+            # x/y are gathered from the HBM-resident global arrays inside the
+            # compiled step (host->device per round = a few KB of indices)
+            def round_step(params, server_state, cohort, client_states, rng,
+                           x_all, y_all):
+                data = dict(cohort)
+                idx = data.pop("idx")
+                m = data["mask"]
+
+                def _masked(gathered):
+                    # padded rows gather index 0; zero them so both packing
+                    # paths feed identical batches (BatchNorm statistics see
+                    # every row, masked or not)
+                    mb = m.reshape(m.shape + (1,) * (gathered.ndim - m.ndim))
+                    return gathered * mb.astype(gathered.dtype)
+
+                data["x"] = _masked(x_all[idx])
+                data["y"] = _masked(y_all[idx])
+                return round_body(params, server_state, data, client_states, rng)
+        else:
+            round_step = round_body
+
         # donate params/server_state: the old round's buffers are dead the
         # moment the new ones exist — saves an HBM copy of the model per round
+        n_extra = 2 if self._use_device_data else 0
         if self.mesh is not None:
             mesh = self.mesh
             cohort_sh = shard_along(mesh, AXIS_CLIENT, 0)
             rep = replicated(mesh)
             return jax.jit(
                 round_step,
-                in_shardings=(rep, rep, cohort_sh, cohort_sh, rep),
+                in_shardings=(rep, rep, cohort_sh, cohort_sh, rep) + (rep,) * n_extra,
                 out_shardings=(rep, rep, cohort_sh, rep),
                 donate_argnums=(0, 1),
             )
@@ -167,14 +191,14 @@ class FedSimulator:
     def _build_eval(self, apply_fn):
         eval_fn = make_eval_fn(apply_fn)
 
-        def eval_batches(params, xs, ys):
+        def eval_batches(params, xs, ys, ms):
             def body(carry, batch):
-                x, y = batch
-                loss_sum, correct, valid = eval_fn(params, x, y)
+                x, y, m = batch
+                loss_sum, correct, valid = eval_fn(params, x, y, m)
                 l, c, n = carry
                 return (l + loss_sum, c + correct, n + valid), None
 
-            (l, c, n), _ = jax.lax.scan(body, (0.0, 0.0, 0.0), (xs, ys))
+            (l, c, n), _ = jax.lax.scan(body, (0.0, 0.0, 0.0), (xs, ys, ms))
             return l, c, n
 
         return jax.jit(eval_batches)
@@ -222,26 +246,33 @@ class FedSimulator:
             # round-indexed RNG streams: resume at round k reproduces an
             # uninterrupted run exactly
             pack_rng = np.random.default_rng([cfg.seed, round_idx])
-            batches = self.fed.pack_clients(
-                client_ids, cfg.batch_size, self.num_local_batches, rng=pack_rng
-            )
-            mask_np, samples_np = batches.mask, batches.num_samples
+            if self._use_device_data:
+                packed = self.fed.pack_client_index(
+                    client_ids, cfg.batch_size, self.num_local_batches, rng=pack_rng
+                )
+                payload = {"idx": packed.idx}
+            else:
+                packed = self.fed.pack_clients(
+                    client_ids, cfg.batch_size, self.num_local_batches, rng=pack_rng
+                )
+                payload = {"x": packed.x, "y": packed.y}
+            mask_np, samples_np = packed.mask, packed.num_samples
             if cfg.client_dropout_rate > 0.0:
                 drop = pack_rng.random(len(client_ids)) < cfg.client_dropout_rate
                 if drop.all():
                     drop[0] = False  # a round needs at least one survivor
                 mask_np = mask_np * (~drop)[:, None, None]
                 samples_np = samples_np * (~drop)
-            cohort = {
-                "x": jnp.asarray(batches.x),
-                "y": jnp.asarray(batches.y),
-                "mask": jnp.asarray(mask_np),
-                "num_samples": jnp.asarray(samples_np),
-            }
+            cohort = {k: jnp.asarray(v) for k, v in payload.items()}
+            cohort["mask"] = jnp.asarray(mask_np)
+            cohort["num_samples"] = jnp.asarray(samples_np)
             states = self._cohort_states(client_ids)
             step_rng = jax.random.fold_in(base_rng, round_idx)
+            step_args = (self.params, self.server_state, cohort, states, step_rng)
+            if self._use_device_data:
+                step_args += (self._x_dev, self._y_dev)
             self.params, self.server_state, new_states, metrics = self._round_step(
-                self.params, self.server_state, cohort, states, step_rng
+                *step_args
             )
             self._store_states(client_ids, new_states)
             rec = {
@@ -276,12 +307,23 @@ class FedSimulator:
             self._eval_fn = self._build_eval(apply_fn)
         test = self.fed.test_data_global
         n = len(test.x)
+        if n == 0:  # train-only dataset (e.g. LEAF users without test splits)
+            return {}
         bs = min(self.cfg.eval_batch_size, n)
-        n_keep = (n // bs) * bs  # truncate tail for a static shape
-        xs = jnp.asarray(test.x[:n_keep]).reshape((-1, bs) + test.x.shape[1:])
+        # pad the tail batch to full size and mask it out — eval covers every
+        # sample exactly (a truncated tail would bias parity numbers)
+        n_pad = (-n) % bs
+        x = test.x if n_pad == 0 else np.concatenate(
+            [test.x, np.zeros((n_pad,) + test.x.shape[1:], test.x.dtype)])
+        y = test.y if n_pad == 0 else np.concatenate(
+            [test.y, np.zeros((n_pad,) + test.y.shape[1:], test.y.dtype)])
+        m = np.ones(n + n_pad, np.float32)
+        m[n:] = 0.0
+        xs = jnp.asarray(x).reshape((-1, bs) + test.x.shape[1:])
         # keep trailing label dims (per-token/per-pixel targets)
-        ys = jnp.asarray(test.y[:n_keep]).reshape((-1, bs) + test.y.shape[1:])
-        l, c, cnt = self._eval_fn(self.params, xs, ys)
+        ys = jnp.asarray(y).reshape((-1, bs) + test.y.shape[1:])
+        ms = jnp.asarray(m).reshape((-1, bs))
+        l, c, cnt = self._eval_fn(self.params, xs, ys, ms)
         return {
             "test_loss": float(l) / max(float(cnt), 1.0),
             "test_acc": float(c) / max(float(cnt), 1.0),

@@ -1,0 +1,89 @@
+"""Measure the reference framework's per-round wall-clock on THIS machine.
+
+The reference publishes no throughput numbers (SURVEY.md §6), so the bench's
+``vs_baseline`` denominator has to be produced locally. This script times the
+reference's actual hot loop — the per-client SGD epoch of
+``simulation/sp/fedavg/my_model_trainer_classification.py:15`` (forward, CE
+loss, backward, step) on its flagship CIFAR-10 ResNet-56
+(``model/cv/resnet.py:257``, imported from the reference tree at runtime, not
+copied) — and extrapolates to the bench workload: 10 clients/round x 500
+samples/client x batch 64 = 80 batches/round.
+
+Torch here is CPU-only, so this is a CPU-scaled denominator; the basis string
+recorded in BASELINE_LOCAL.json says so explicitly, and bench.py echoes it in
+its output line so the vs_baseline ratio is never mistaken for a same-hardware
+comparison.
+
+Usage: python scripts/measure_reference_baseline.py [n_batches]
+Writes BASELINE_LOCAL.json at the repo root.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+import time
+
+REF_RESNET = "/root/reference/python/fedml/model/cv/resnet.py"
+BATCHES_PER_ROUND = 80  # 10 clients x ceil(500/64) = 8 batches, bench workload
+BATCH_SIZE = 64
+
+
+def load_reference_resnet56():
+    spec = importlib.util.spec_from_file_location("ref_resnet", REF_RESNET)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod.resnet56(class_num=10)
+
+
+def main() -> None:
+    import torch
+    import torch.nn as nn
+
+    n_batches = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+    torch.manual_seed(0)
+    model = load_reference_resnet56()
+    model.train()
+    criterion = nn.CrossEntropyLoss()
+    optimizer = torch.optim.SGD(model.parameters(), lr=0.01)
+    x = torch.randn(BATCH_SIZE, 3, 32, 32)
+    y = torch.randint(0, 10, (BATCH_SIZE,))
+
+    # one warmup batch (allocator, thread pool spin-up)
+    optimizer.zero_grad(); criterion(model(x), y).backward(); optimizer.step()
+
+    t0 = time.perf_counter()
+    for _ in range(n_batches):
+        optimizer.zero_grad()
+        loss = criterion(model(x), y)
+        loss.backward()
+        optimizer.step()
+    per_batch = (time.perf_counter() - t0) / n_batches
+
+    seconds_per_round = per_batch * BATCHES_PER_ROUND
+    result = {
+        "rounds_per_sec": 1.0 / seconds_per_round,
+        "seconds_per_round": seconds_per_round,
+        "seconds_per_batch": per_batch,
+        "batches_timed": n_batches,
+        "basis": (
+            "reference torch hot loop (my_model_trainer_classification.py:15"
+            " semantics, resnet56 bs64) timed on this machine's CPU, "
+            f"extrapolated to {BATCHES_PER_ROUND} batches/round — CPU-scaled,"
+            " not same-hardware"
+        ),
+        "torch_version": torch.__version__,
+        "cpu_count": os.cpu_count(),
+    }
+    out = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                       "BASELINE_LOCAL.json")
+    with open(out, "w") as f:
+        json.dump(result, f, indent=2)
+        f.write("\n")
+    print(json.dumps(result, indent=2))
+
+
+if __name__ == "__main__":
+    main()

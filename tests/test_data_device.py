@@ -101,3 +101,46 @@ def test_fedgraphnn_gcn_learns():
     hist = sim.run(apply_fn, log_fn=None)
     assert hist[-1]["train_loss"] < hist[0]["train_loss"]
     assert hist[-1]["test_acc"] > 0.7  # structural label is easy for a GCN
+
+
+def test_pack_clients_preserves_float_labels():
+    """Float (regression) labels must not be truncated to ints by the native
+    int32 fast path (ADVICE r1: data/federated.py)."""
+    from fedml_tpu.data.federated import ArrayPair, build_federated_data
+
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(40, 3)).astype(np.float32)
+    y = rng.random(40).astype(np.float32)  # values in (0, 1)
+    fed = build_federated_data(
+        ArrayPair(x, y), ArrayPair(x[:8], y[:8]),
+        {0: list(range(20)), 1: list(range(20, 40))}, class_num=1,
+    )
+    batches = fed.pack_clients([0, 1], batch_size=8)
+    got = batches.y[batches.mask.astype(bool)]
+    assert got.dtype == np.float32
+    # all true labels present, none floored to 0.0/1.0
+    np.testing.assert_allclose(np.sort(got), np.sort(y), rtol=1e-6)
+
+
+def test_pack_client_index_matches_pack_clients():
+    """The index-only (device-resident) packer must reproduce pack_clients
+    bit-for-bit under the same rng stream."""
+    from fedml_tpu.data.federated import ArrayPair, build_federated_data
+
+    rng = np.random.default_rng(1)
+    x = rng.normal(size=(50, 4)).astype(np.float32)
+    y = rng.integers(0, 5, 50).astype(np.int32)
+    fed = build_federated_data(
+        ArrayPair(x, y), ArrayPair(x[:8], y[:8]),
+        {0: list(range(13)), 1: list(range(13, 50))}, class_num=5,
+    )
+    dense = fed.pack_clients([1, 0], batch_size=8, num_batches=5,
+                             rng=np.random.default_rng([7, 3]))
+    idx = fed.pack_client_index([1, 0], batch_size=8, num_batches=5,
+                                rng=np.random.default_rng([7, 3]))
+    np.testing.assert_array_equal(idx.mask, dense.mask)
+    np.testing.assert_array_equal(idx.num_samples, dense.num_samples)
+    gx = x[idx.idx] * idx.mask[..., None]
+    np.testing.assert_array_equal(gx, dense.x * dense.mask[..., None])
+    gy = y[idx.idx] * idx.mask.astype(np.int32)
+    np.testing.assert_array_equal(gy, dense.y * dense.mask.astype(np.int32))
