@@ -55,6 +55,7 @@ def get_algorithm(
     apply_fn: Callable,
     cfg: LocalTrainConfig,
     needs_dropout: bool = False,
+    has_batch_stats: bool = False,
     server_lr: float = 1.0,
     server_optimizer: str = "sgd",
     server_momentum: float = 0.9,
@@ -65,8 +66,25 @@ def get_algorithm(
     trim_ratio: float = 0.1,
     dp_seed: int = 0,
 ) -> FedAlgorithm:
-    """Build the named optimizer's FedAlgorithm bundle."""
+    """Build the named optimizer's FedAlgorithm bundle.
+
+    BatchNorm models (``has_batch_stats``): running-stat deltas must be
+    plainly weighted-averaged (reference ``fedavg_api.py:163-170``), never fed
+    through a server optimizer or defense — FedAvg/FedProx do that natively,
+    FedOpt splits the tree (optimizer on params, plain add on stats), and the
+    remaining algorithms reject the combination rather than corrupt stats.
+    """
     name_l = name.lower()
+    if has_batch_stats and name_l in (
+        FEDML_FEDERATED_OPTIMIZER_FEDNOVA.lower(),
+        FEDML_FEDERATED_OPTIMIZER_FEDAVG_ROBUST.lower(),
+        FEDML_FEDERATED_OPTIMIZER_SCAFFOLD.lower(),
+    ):
+        raise ValueError(
+            f"{name}: norm='batch' is unsupported (tau scaling / defenses / "
+            "control variates would treat BatchNorm running stats as "
+            "gradients); use norm='group', or FedAvg/FedProx/FedOpt"
+        )
 
     if name_l == FEDML_FEDERATED_OPTIMIZER_FEDAVG_ROBUST.lower():
         # Reference: simulation/mpi/fedavg_robust/FedAvgRobustAggregator.py:156
@@ -80,7 +98,7 @@ def get_algorithm(
             stddev=stddev,
             trim_ratio=trim_ratio,
         )
-        local_update = make_local_update(apply_fn, cfg, needs_dropout)
+        local_update = make_local_update(apply_fn, cfg, needs_dropout, has_batch_stats)
         noisy = ra.defense_type == "weak_dp"
         base_cfg = ra
         if noisy:
@@ -116,7 +134,7 @@ def get_algorithm(
     if name_l == FEDML_FEDERATED_OPTIMIZER_SCAFFOLD.lower():
         cfg = LocalTrainConfig(**{**cfg.__dict__, "use_scaffold": True})
 
-    local_update = make_local_update(apply_fn, cfg, needs_dropout)
+    local_update = make_local_update(apply_fn, cfg, needs_dropout, has_batch_stats)
 
     if name_l in (FEDML_FEDERATED_OPTIMIZER_FEDAVG.lower(), "fedavg_core", "fedavg"):
         # aggregated update = weighted-mean delta; w_{t+1} = w_t + delta_mean —
@@ -137,13 +155,28 @@ def get_algorithm(
         else:
             sopt = optax.sgd(server_lr, momentum=server_momentum or None)
 
+        def _split(tree):
+            # server optimizer sees params only; BatchNorm running stats are
+            # plainly averaged (adam/momentum on stats would corrupt them)
+            if has_batch_stats:
+                return tree["params"], tree["batch_stats"]
+            return tree, None
+
         def init_server_state(params):
-            return sopt.init(params)
+            return sopt.init(_split(params)[0])
 
         def server_update(params, agg_delta, opt_state):
-            pseudo_grad = tree_scale(agg_delta, -1.0)
-            updates, opt_state = sopt.update(pseudo_grad, opt_state, params)
-            return optax.apply_updates(params, updates), opt_state
+            p, stats = _split(params)
+            dp, dstats = _split(agg_delta)
+            pseudo_grad = tree_scale(dp, -1.0)
+            updates, opt_state = sopt.update(pseudo_grad, opt_state, p)
+            new_p = optax.apply_updates(p, updates)
+            if has_batch_stats:
+                return (
+                    {"params": new_p, "batch_stats": tree_add(stats, dstats)},
+                    opt_state,
+                )
+            return new_p, opt_state
 
         return FedAlgorithm(
             name=name, init_server_state=init_server_state,

@@ -31,8 +31,8 @@ def _assemble(args, mesh=None):
     rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
     variables = models_mod.init_params(model, rng, sample)
 
-    def apply_fn(vars_, x, train=False, rngs=None):
-        return model.apply(vars_, x, train=train, rngs=rngs)
+    def apply_fn(vars_, x, train=False, rngs=None, mutable=False):
+        return model.apply(vars_, x, train=train, rngs=rngs, mutable=mutable)
 
     cfg = LocalTrainConfig(
         lr=float(getattr(args, "learning_rate", 0.03)),
@@ -41,7 +41,9 @@ def _assemble(args, mesh=None):
         momentum=float(getattr(args, "momentum", 0.0)),
         weight_decay=float(getattr(args, "weight_decay", 0.0)),
     )
-    local_update = make_local_update(apply_fn, cfg)
+    local_update = make_local_update(
+        apply_fn, cfg, has_batch_stats="batch_stats" in variables
+    )
     return fed_data, variables, apply_fn, local_update
 
 

@@ -57,13 +57,10 @@ def create(args, output_dim: int):
         return ResNet18(num_classes=output_dim, norm_kind="group", dtype=dtype)
     if model_name in ("resnet56", "resnet20"):
         depth = int(model_name.replace("resnet", ""))
+        # 'batch' matches the reference flagship resnet56 (model/cv/resnet.py:303);
+        # batch_stats thread through training via make_local_update and are
+        # federated-averaged like every other key (fedavg_api.py:163-170).
         norm = getattr(args, "norm", "group")
-        if norm == "batch":
-            raise NotImplementedError(
-                "norm='batch' needs mutable batch_stats threading through the "
-                "train step, which is not wired yet — use norm='group' "
-                "(the FL-standard choice; see models/resnet.py docstring)"
-            )
         return CifarResNet(depth=depth, num_classes=output_dim,
                            norm_kind=norm, dtype=dtype)
     if model_name == "mobilenet":

@@ -4,14 +4,17 @@ Parity targets: reference ``model/cv/resnet.py:303`` (CIFAR ResNet-56, the
 BENCHMARK_MPI.md flagship) and ``model/cv/resnet_gn.py:239`` (ResNet-18 with
 GroupNorm, the fed_CIFAR100 baseline).
 
-Normalization: GroupNorm everywhere by default. The reference's ResNet-56
-uses BatchNorm and FedAvg then averages running stats across clients
-(``fedavg_api.py:163-170`` iterates *all* state_dict keys); BN's
-batch-statistics dependence is exactly what breaks under client vmap, and GN
-is the standard FL fix (Hsieh et al.; the reference itself ships resnet18_gn
-for this reason). The modules accept ``norm='batch'`` structurally, but the
-training path doesn't yet thread the mutable ``batch_stats`` collection, so
-the model factory rejects it with NotImplementedError until that lands.
+Normalization: GroupNorm by default (the standard FL fix for BN's
+batch-statistics dependence — Hsieh et al.; the reference itself ships
+resnet18_gn for this reason). ``norm='batch'`` matches the reference
+flagship: its ResNet-56 uses BatchNorm and FedAvg averages the running stats
+across clients (``fedavg_api.py:163-170`` iterates *all* state_dict keys) —
+our training path threads the mutable ``batch_stats`` collection through the
+local-update scan (``algorithms/local_sgd.py:_make_bn_local_update``) and the
+shipped delta covers both collections, reproducing that behavior. Note: the
+tail batch of a client is zero-padded, which slightly biases BN batch
+statistics versus the reference's ragged final batch; running stats still
+converge since most batches are full.
 """
 
 from __future__ import annotations

@@ -48,8 +48,10 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
     rng = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
     variables = models_mod.init_params(model, rng, sample)
 
-    def apply_fn(vars_, x, train=False, rngs=None):
-        return model.apply(vars_, x, train=train, rngs=rngs)
+    def apply_fn(vars_, x, train=False, rngs=None, mutable=False):
+        return model.apply(vars_, x, train=train, rngs=rngs, mutable=mutable)
+
+    has_batch_stats = "batch_stats" in variables
 
     cfg = LocalTrainConfig(
         lr=float(getattr(args, "learning_rate", 0.03)),
@@ -82,7 +84,9 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
         from ..algorithms import make_local_update
 
         sim = HierarchicalFedSimulator(
-            fed_data, make_local_update(apply_fn, cfg, needs_dropout), variables,
+            fed_data,
+            make_local_update(apply_fn, cfg, needs_dropout, has_batch_stats),
+            variables,
             sim_cfg,
             group_num=int(getattr(args, "group_num", 2)),
             group_comm_round=int(getattr(args, "group_comm_round", 2)),
@@ -100,7 +104,9 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
         )
         tm.generate_topology()
         sim = DecentralizedSimulator(
-            fed_data, make_local_update(apply_fn, cfg, needs_dropout), variables,
+            fed_data,
+            make_local_update(apply_fn, cfg, needs_dropout, has_batch_stats),
+            variables,
             sim_cfg, mixing_matrix=tm.topology,
             mode=str(getattr(args, "decentralized_mode", "dsgd")),
             mesh=mesh,
@@ -112,6 +118,7 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
         apply_fn,
         cfg,
         needs_dropout=needs_dropout,
+        has_batch_stats=has_batch_stats,
         server_lr=float(getattr(args, "server_lr", 1.0)),
         server_optimizer=str(getattr(args, "server_optimizer", "sgd")),
         server_momentum=float(getattr(args, "server_momentum", 0.9)),

@@ -47,3 +47,68 @@ def test_sp_optimizer_variants_run(opt):
     hist = sim.run(apply_fn, log_fn=None)
     assert len(hist) == 2
     assert np.isfinite(hist[-1]["train_loss"])
+
+
+def test_batchnorm_resnet_trains_and_averages_stats():
+    """norm='batch' resnet20: batch_stats thread through the local update and
+    are federated-averaged in the delta (reference fedavg_api.py:163-170)."""
+    import jax
+
+    args = fedml_tpu.init(config=dict(
+        dataset="cifar10", model="resnet20", norm="batch",
+        debug_small_data=True, client_num_in_total=4, client_num_per_round=2,
+        comm_round=2, learning_rate=0.05, epochs=1, batch_size=8,
+        frequency_of_the_test=1, random_seed=0,
+    ))
+    sim, apply_fn = build_simulator(args)
+    assert "batch_stats" in sim.params
+    stats_before = jax.tree.map(lambda x: np.asarray(x).copy(),
+                                sim.params["batch_stats"])
+    hist = sim.run(apply_fn, log_fn=None)
+    assert len(hist) == 2 and np.isfinite(hist[-1]["train_loss"])
+    # running stats must have moved off their init values
+    moved = jax.tree.leaves(jax.tree.map(
+        lambda a, b: float(np.abs(np.asarray(a) - b).max()),
+        sim.params["batch_stats"], stats_before,
+    ))
+    assert max(moved) > 1e-6
+    finite = jax.tree.leaves(jax.tree.map(
+        lambda a: bool(np.isfinite(np.asarray(a)).all()),
+        sim.params["batch_stats"],
+    ))
+    assert all(finite)
+
+
+def test_batchnorm_fedopt_splits_server_update():
+    """FedOpt + norm='batch': server optimizer touches params only; running
+    stats are plainly averaged and stay finite/positive-variance."""
+    import jax
+
+    args = fedml_tpu.init(config=dict(
+        dataset="cifar10", model="resnet20", norm="batch",
+        federated_optimizer="FedOpt", server_optimizer="adam", server_lr=0.1,
+        debug_small_data=True, client_num_in_total=4, client_num_per_round=2,
+        comm_round=3, learning_rate=0.05, epochs=1, batch_size=8,
+        frequency_of_the_test=10, random_seed=0,
+    ))
+    sim, apply_fn = build_simulator(args)
+    hist = sim.run(apply_fn, log_fn=None)
+    assert np.isfinite(hist[-1]["train_loss"])
+    for path, leaf in jax.tree_util.tree_leaves_with_path(
+        sim.params["batch_stats"]
+    ):
+        arr = np.asarray(leaf)
+        assert np.isfinite(arr).all()
+        if "var" in str(path):
+            assert (arr > 0).all(), f"negative running variance at {path}"
+
+
+def test_batchnorm_rejected_for_stats_corrupting_optimizers():
+    args = fedml_tpu.init(config=dict(
+        dataset="cifar10", model="resnet20", norm="batch",
+        federated_optimizer="FedNova", debug_small_data=True,
+        client_num_in_total=2, client_num_per_round=2, comm_round=1,
+        learning_rate=0.05, batch_size=8, random_seed=0,
+    ))
+    with pytest.raises(ValueError, match="norm='batch'"):
+        build_simulator(args)
