@@ -47,6 +47,15 @@ def load(args):
         client_num=client_num,
         small=bool(getattr(args, "debug_small_data", False)),
     )
+    if not centralized and fed.client_num != client_num:
+        # natural per-user partition (LEAF/TFF real files): the data dictates
+        # the client population — reconcile the args so cohort sampling never
+        # indexes a nonexistent client (the reference's MLOps path rewrites
+        # client_id_list at runtime the same way, arguments.py:163-203)
+        args.client_num_in_total = fed.client_num
+        per_round = int(getattr(args, "client_num_per_round", fed.client_num))
+        if per_round > fed.client_num:
+            args.client_num_per_round = fed.client_num
     poison_ratio = float(getattr(args, "poison_ratio", 0.0))
     if poison_ratio > 0.0:
         fed = poison_clients(

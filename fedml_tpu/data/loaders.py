@@ -146,11 +146,35 @@ def load_partition_data(
     """
     scale = 0.02 if small else 1.0
     if dataset in ("mnist", "femnist"):
+        from . import leaf
+
+        # real-file paths first, with their NATURAL per-user partitions
+        if dataset == "mnist" and leaf.leaf_json_dirs(data_cache_dir):
+            return leaf.load_leaf_json(data_cache_dir, kind="dense", class_num=10)
+        if (
+            dataset == "femnist"
+            and data_cache_dir
+            and os.path.exists(os.path.join(data_cache_dir, "fed_emnist_train.h5"))
+        ):
+            return leaf.load_femnist_h5(data_cache_dir)
         n_tr, n_te = (int(s * scale) for s in _SIZES[dataset])
         train, test = _load_mnist_arrays(data_cache_dir, n_tr, n_te)
         class_num = 62 if dataset == "femnist" else 10
         if dataset == "femnist" and train.y.max() < 11:
             class_num = 10
+    elif dataset == "digits":
+        # sklearn's bundled real handwritten digits (1797 8x8 images) — the
+        # one genuinely real vision dataset available in a zero-egress image;
+        # used by the real-data accuracy tests
+        from sklearn.datasets import load_digits
+
+        d = load_digits()
+        x = (d.data.astype(np.float32) / 16.0).reshape(-1, 8, 8, 1)
+        y = d.target.astype(np.int32)
+        n_te = len(x) // 5
+        train = ArrayPair(x[:-n_te], y[:-n_te])
+        test = ArrayPair(x[-n_te:], y[-n_te:])
+        class_num = 10
     elif dataset in ("cifar10", "cifar100", "cinic10", "fed_cifar100"):
         n_tr, n_te = (int(s * scale) for s in _SIZES[dataset])
         base = "cifar100" if dataset in ("cifar100", "fed_cifar100") else "cifar10"
@@ -309,6 +333,20 @@ def load_partition_data(
         train, test = gen_seg(n_tr, rng), gen_seg(n_te, rng)
         class_num = 2
     elif dataset in ("shakespeare", "fed_shakespeare", "stackoverflow_nwp"):
+        from . import leaf
+
+        # real TFF h5 / LEAF json with natural per-author partitions first
+        if data_cache_dir:
+            if "shakespeare" in dataset and os.path.exists(
+                os.path.join(data_cache_dir, "shakespeare_train.h5")
+            ):
+                return leaf.load_fed_shakespeare_h5(data_cache_dir)
+            if dataset == "shakespeare" and leaf.leaf_json_dirs(data_cache_dir):
+                return leaf.load_leaf_json(data_cache_dir, kind="shakespeare")
+            if dataset == "stackoverflow_nwp" and os.path.exists(
+                os.path.join(data_cache_dir, "stackoverflow_train.h5")
+            ):
+                return leaf.load_stackoverflow_nwp_h5(data_cache_dir)
         vocab = 90 if "shakespeare" in dataset else 10000
         seq_len = 80 if "shakespeare" in dataset else 20
         n_tr = int(16000 * scale) if "shakespeare" in dataset else int(40000 * scale)
