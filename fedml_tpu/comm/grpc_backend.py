@@ -36,8 +36,11 @@ _GRPC_OPTIONS = [
 
 
 def build_ip_table(path_or_map: Union[str, Dict[int, str], None], size: int) -> Dict[int, str]:
-    """rank → host. CSV format parity with the reference (``_build_ip_table:131``):
-    ``receiver_id,ip`` rows. A dict passes through; None = all-localhost."""
+    """rank → host or ``host:port``. CSV format parity with the reference
+    (``_build_ip_table:131``): ``receiver_id,ip`` rows. A dict passes through;
+    None = all-localhost. Entries without an explicit port dial
+    ``base_port + rank`` — a peer listening on a non-default port must appear
+    here as ``host:port`` or no sender will ever reach it."""
     if path_or_map is None:
         return {rank: "127.0.0.1" for rank in range(size)}
     if isinstance(path_or_map, dict):
@@ -66,6 +69,18 @@ class GRPCCommManager(BaseCommunicationManager):
         self.base_port = int(base_port)
         self.port = int(port) if port is not None else self.base_port + self.rank
         self.ip_table = build_ip_table(ip_config, size)
+        if self.port != self.base_port + self.rank:
+            # listener moved off the default scheme: senders only find it via
+            # an explicit host:port table entry — make the contract loud
+            entry = self.ip_table.get(self.rank, "")
+            if ":" not in entry:
+                logging.warning(
+                    "grpc rank %d listens on non-default port %d but its ip "
+                    "table entry %r has no port — peers using the same table "
+                    "will dial %d and never reach it; use 'host:%d'",
+                    self.rank, self.port, entry, self.base_port + self.rank,
+                    self.port,
+                )
         self._observers: List[Observer] = []
         self._channels: Dict[int, grpc.Channel] = {}
         # Inbound messages buffer here until handle_receive_message drains
@@ -97,7 +112,8 @@ class GRPCCommManager(BaseCommunicationManager):
 
     def _stub(self, receiver_id: int):
         if receiver_id not in self._channels:
-            target = f"{self.ip_table[receiver_id]}:{self.base_port + receiver_id}"
+            entry = self.ip_table[receiver_id]
+            target = entry if ":" in entry else f"{entry}:{self.base_port + receiver_id}"
             self._channels[receiver_id] = grpc.insecure_channel(target, options=_GRPC_OPTIONS)
         return self._channels[receiver_id].unary_unary(
             f"/{SERVICE_NAME}/{METHOD_SEND}",

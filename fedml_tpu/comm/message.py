@@ -20,10 +20,27 @@ import numpy as np
 _EXT_NDARRAY = 42
 
 
+def _dtype_token(dtype: np.dtype) -> str:
+    # ml_dtypes types (bfloat16, float8_*) stringify as opaque void ('|V2');
+    # ship the registered name instead so the receiver gets a usable dtype
+    if dtype.kind == "V" and dtype.names is None:
+        return dtype.name
+    return dtype.str
+
+
+def _resolve_dtype(token: str) -> np.dtype:
+    try:
+        return np.dtype(token)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, token))
+
+
 def _encode_hook(obj):
     if isinstance(obj, np.ndarray):
         arr = np.ascontiguousarray(obj)
-        header = msgpack.packb((arr.dtype.str, arr.shape))
+        header = msgpack.packb((_dtype_token(arr.dtype), arr.shape))
         return msgpack.ExtType(_EXT_NDARRAY, header + arr.tobytes())
     # JAX arrays (and scalars) degrade to numpy without import-time jax dep
     if hasattr(obj, "__array__"):
@@ -38,7 +55,7 @@ def _ext_hook(code, data):
     unpacker.feed(data)
     dtype_str, shape = unpacker.unpack()
     offset = unpacker.tell()
-    arr = np.frombuffer(data, dtype=np.dtype(dtype_str), offset=offset).reshape(shape)
+    arr = np.frombuffer(data, dtype=_resolve_dtype(dtype_str), offset=offset).reshape(shape)
     # frombuffer views are read-only; handlers mutate received params in place
     # (aggregation accumulators), so pay one copy for a writable array
     return arr.copy()

@@ -111,6 +111,10 @@ class FedMLAggregator:
         param mean, with defenses applied to the deltas (where clipping is
         actually meaningful)."""
         idx = sorted(self.model_dict)
+        if not idx:
+            # zero uploads (a fully-dead round closed by the straggler
+            # timeout with min_clients=0): keep the global model unchanged
+            return self.model_params
         stacked = jax.tree.map(
             lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
             *[self.model_dict[i] for i in idx],

@@ -49,7 +49,9 @@ class FedMLServerManager(ServerManager):
         self.history: List[Dict[str, float]] = []
         # straggler tolerance (ours; the reference barrier waits forever —
         # SURVEY.md §5.3): if set, a round closes round_timeout seconds after
-        # its first upload with whatever subset arrived (>= min_clients)
+        # it STARTS (init/sync broadcast) with whatever subset arrived
+        # (>= min_clients) — so size it to cover full local training, not
+        # just the straggler spread
         self.round_timeout: Optional[float] = (
             float(getattr(args, "round_timeout", 0)) or None
         )
@@ -77,6 +79,7 @@ class FedMLServerManager(ServerManager):
         self.start_running_time = time.time()
         self.aggregator.set_expected_this_round(len(self.client_id_list_in_this_round))
         global_model_params = self.aggregator.get_global_model_params()
+        round_gen = self._round_gen
         for idx, client_id in enumerate(self.client_id_list_in_this_round):
             msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank, client_id)
             msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model_params)
@@ -84,6 +87,27 @@ class FedMLServerManager(ServerManager):
                 MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(self.data_silo_index_list[idx])
             )
             self.send_message(msg)
+        # arm at round start: a round where every client dies before its first
+        # upload must still time out
+        self._arm_round_timer(round_gen)
+
+    def _arm_round_timer(self, expected_gen: int) -> None:
+        """Arm the straggler timer for the round that started at generation
+        ``expected_gen``. If the round already completed (or the run finished)
+        by the time we get here, skip — arming then would create a phantom
+        timer no completion will ever cancel."""
+        if not self.round_timeout:
+            return
+        with self._round_lock:
+            if expected_gen != self._round_gen:
+                return
+            if self._timer is not None:
+                self._timer.cancel()
+            self._timer = threading.Timer(
+                self.round_timeout, self._on_round_timeout, args=(expected_gen,)
+            )
+            self._timer.daemon = True
+            self._timer.start()
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -127,6 +151,7 @@ class FedMLServerManager(ServerManager):
     def _on_model_from_client(self, msg: Message) -> None:
         model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         local_sample_num = msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
+        outcome = None
         with self._round_lock:
             msg_round = msg.get(MyMessage.MSG_ARG_KEY_ROUND_INDEX)
             stale = msg_round is not None and int(msg_round) != self.round_idx
@@ -139,18 +164,12 @@ class FedMLServerManager(ServerManager):
             # map real edge id -> dense slot index for the barrier bookkeeping
             slot = self.client_id_list_in_this_round.index(msg.get_sender_id())
             self.aggregator.add_local_trained_result(slot, model_params, local_sample_num)
-            if self.round_timeout and self._timer is None:
-                gen = self._round_gen
-                self._timer = threading.Timer(
-                    self.round_timeout, self._on_round_timeout, args=(gen,)
-                )
-                self._timer.daemon = True
-                self._timer.start()
-            if not self.aggregator.check_whether_all_receive():
-                return
-            self._complete_round()
+            if self.aggregator.check_whether_all_receive():
+                outcome = self._complete_round_locked()
+        self._dispatch_round_end(outcome)
 
     def _on_round_timeout(self, gen: int) -> None:
+        outcome = None
         with self._round_lock:
             if gen != self._round_gen:
                 return  # round already completed normally
@@ -177,11 +196,14 @@ class FedMLServerManager(ServerManager):
                 len(self.client_id_list_in_this_round), missing,
             )
             self.aggregator.reset_flags()
-            self._complete_round()
+            outcome = self._complete_round_locked()
+        self._dispatch_round_end(outcome)
 
-    def _complete_round(self) -> None:
-        """Aggregate whatever the round collected and start the next one.
-        Caller holds the round lock."""
+    def _complete_round_locked(self):
+        """Aggregate the round's uploads and prepare the next round's
+        messages. Caller holds the round lock; returns (messages, finished)
+        for the caller to send *outside* the lock — a blocking send to a dead
+        client must not freeze the round FSM."""
         self._round_gen += 1
         if self._timer is not None:
             self._timer.cancel()
@@ -198,8 +220,11 @@ class FedMLServerManager(ServerManager):
 
         self.round_idx += 1
         if self.round_idx >= self.round_num:
-            self._finish_all()
-            return
+            msgs = [
+                Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank, client_id)
+                for client_id in self.client_real_ids
+            ]
+            return msgs, True, self._round_gen
         # next cohort
         self.client_id_list_in_this_round = self.aggregator.client_selection(
             self.round_idx, self.client_real_ids,
@@ -212,6 +237,7 @@ class FedMLServerManager(ServerManager):
         )
         self.aggregator.set_expected_this_round(len(self.client_id_list_in_this_round))
         global_model_params = self.aggregator.get_global_model_params()
+        msgs = []
         for idx, client_id in enumerate(self.client_id_list_in_this_round):
             sync = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, client_id)
             sync.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model_params)
@@ -219,12 +245,22 @@ class FedMLServerManager(ServerManager):
                 MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(self.data_silo_index_list[idx])
             )
             sync.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
-            self.send_message(sync)
+            msgs.append(sync)
+        return msgs, False, self._round_gen
 
-    def _finish_all(self) -> None:
-        for client_id in self.client_real_ids:
-            self.send_message(Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank, client_id))
-        logging.info(
-            "server: training finished in %.1fs", time.time() - self.start_running_time
-        )
-        self.finish()
+    def _dispatch_round_end(self, outcome) -> None:
+        """Send the round-end messages prepared under the lock, then either
+        finish or arm the next round's straggler timer."""
+        if outcome is None:
+            return
+        msgs, finished, gen = outcome
+        for m in msgs:
+            self.send_message(m)
+        if finished:
+            logging.info(
+                "server: training finished in %.1fs",
+                time.time() - self.start_running_time,
+            )
+            self.finish()
+        else:
+            self._arm_round_timer(gen)

@@ -22,16 +22,48 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _build() -> bool:
+def _fresh() -> bool:
+    """True when the existing .so is at least as new as its inputs."""
+    if not os.path.exists(_SO):
+        return False
+    so_mtime = os.path.getmtime(_SO)
+    for src in ("fedml_native.cpp", "Makefile"):
+        p = os.path.join(_HERE, src)
+        if os.path.exists(p) and os.path.getmtime(p) > so_mtime:
+            return False
+    return True
+
+
+def _build() -> str:
+    """'ok' | 'no-toolchain' | 'failed' — callers must not load a stale .so
+    after a *failed* rebuild (the source no longer matches the binary).
+    Serialized across processes with a lock file so concurrent first imports
+    never compile/link the same output simultaneously."""
+    lock_path = os.path.join(_HERE, ".build.lock")
     try:
+        import fcntl
+
+        lock = open(lock_path, "w")
+        fcntl.flock(lock, fcntl.LOCK_EX)
+    except Exception:
+        lock = None
+    try:
+        if _fresh():  # another process built it while we waited on the lock
+            return "ok"
         subprocess.run(
             ["make", "-s", "-C", _HERE, "libfedml_native.so"],
             check=True, capture_output=True, timeout=120,
         )
-        return os.path.exists(_SO)
-    except Exception as e:  # no toolchain / build failure -> numpy fallback
-        logging.debug("native build failed: %s", e)
-        return False
+        return "ok" if os.path.exists(_SO) else "failed"
+    except FileNotFoundError as e:  # make itself missing
+        logging.debug("native toolchain unavailable: %s", e)
+        return "no-toolchain"
+    except Exception as e:
+        logging.warning("native build failed (numpy fallback engaged): %s", e)
+        return "failed"
+    finally:
+        if lock is not None:
+            lock.close()
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
@@ -39,8 +71,17 @@ def get_lib() -> Optional[ctypes.CDLL]:
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if not os.path.exists(_SO) and not _build():
-        return None
+    # fast path: a .so at least as new as the sources loads without touching
+    # make (also what keeps concurrent processes from racing a rebuild);
+    # otherwise rebuild under a lock — and never load a binary STALER than
+    # the source after a failed rebuild
+    if not _fresh():
+        status = _build()
+        if status == "failed":
+            return None  # stale .so would shadow the (broken/newer) source
+        if status == "no-toolchain" and not os.path.exists(_SO):
+            return None
+        # no-toolchain with a prebuilt .so present: best available option
     try:
         lib = ctypes.CDLL(_SO)
         lib.pack_cohort_f32.argtypes = [

@@ -36,11 +36,18 @@ def test_message_codec_roundtrip_arrays():
 
 def test_message_codec_bf16_via_jax():
     import jax.numpy as jnp
+    import ml_dtypes
 
     msg = Message(type=1)
-    msg.add_params("w", np.asarray(jnp.ones((2, 2), jnp.bfloat16)))
+    msg.add_params("w", np.asarray(jnp.full((2, 2), 1.5, jnp.bfloat16)))
     out = Message.from_bytes(msg.to_bytes())
-    assert out.get("w").shape == (2, 2)
+    got = out.get("w")
+    assert got.shape == (2, 2)
+    # dtype must survive as a real bfloat16, usable in arithmetic — not an
+    # opaque void ('|V2') view (ADVICE r1: bf16 params over loopback/gRPC)
+    assert got.dtype == np.dtype(ml_dtypes.bfloat16)
+    np.testing.assert_allclose(got.astype(np.float32), 1.5)
+    assert (got + got).astype(np.float32).sum() == 12.0
 
 
 MSG_INIT, MSG_MODEL, MSG_DONE = 1, 3, 99

@@ -123,3 +123,51 @@ def test_straggler_timeout_closes_round():
         t.join(timeout=30)
     assert len(server.history) == 2
     assert np.isfinite(server.history[-1]["test_acc"])
+
+
+def test_round_times_out_with_zero_uploads():
+    """ADVICE r1: the round timer must arm at round *start* — a round where
+    every selected client dies before its first upload still times out
+    (min_clients=0 lets it close with the model unchanged)."""
+    from fedml_tpu.comm import LoopbackHub, Message
+    from fedml_tpu.comm.loopback import LoopbackCommManager
+    from fedml_tpu.cross_silo import FedML_Horizontal, MyMessage
+
+    args = fedml_tpu.init(config=dict(
+        dataset="mnist", model="lr", debug_small_data=True,
+        client_num_in_total=2, client_num_per_round=2, comm_round=2,
+        learning_rate=0.1, batch_size=8, frequency_of_the_test=1,
+        random_seed=0, round_timeout=1.0, min_clients_per_round=0,
+    ))
+    hub = LoopbackHub()
+    server = FedML_Horizontal(args, 0, 2, backend="LOOPBACK", hub=hub)
+
+    class DeadClient:
+        """Reports ONLINE, then never uploads anything."""
+
+        def __init__(self, rank):
+            self.rank = rank
+            self.comm = LoopbackCommManager(rank=rank, size=3, hub=hub)
+            self.comm.add_observer(self)
+
+        def receive_message(self, t, msg):
+            if t == MyMessage.MSG_TYPE_S2C_CHECK_CLIENT_STATUS:
+                r = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, 0)
+                r.add_params(MyMessage.MSG_ARG_KEY_CLIENT_STATUS,
+                             MyMessage.MSG_CLIENT_STATUS_IDLE)
+                self.comm.send_message(r)
+            elif t == MyMessage.MSG_TYPE_S2C_FINISH:
+                self.comm.stop_receive_message()
+
+        def run(self):
+            self.comm.handle_receive_message()
+
+    dead = [DeadClient(1), DeadClient(2)]
+    threads = [threading.Thread(target=d.run, daemon=True) for d in dead]
+    for t in threads:
+        t.start()
+    server.start()
+    server.run()  # must NOT hang: timer armed at round start closes rounds
+    for t in threads:
+        t.join(timeout=30)
+    assert len(server.history) == 2
