@@ -112,6 +112,32 @@ def build(pkg_type, source_folder, entry_point, config_folder, dest_folder):
     click.echo(f"package built: {out}")
 
 
+@cli.command("agent", help="Run the edge agent daemon (serves MLOps jobs).")
+@click.option("--edge_id", "-e", default=0, type=int)
+@click.option("--broker_dir", "-b", default=None,
+              help="FileSystemBroker root shared with the server runner.")
+@click.option("--store_dir", "-s", default=None,
+              help="FileSystemBlobStore root for package distribution.")
+def agent(edge_id, broker_dir, store_dir):
+    """Reference ``fedml login`` spawns this daemon (cli.py:152); here it is
+    an explicit foreground command (daemonize with your supervisor)."""
+    from ..comm.pubsub import FileSystemBroker
+    from ..comm.store import FileSystemBlobStore
+    from .runner import FedMLEdgeRunner
+
+    broker = FileSystemBroker(root=broker_dir)
+    store = FileSystemBlobStore(root=store_dir)
+    runner = FedMLEdgeRunner(edge_id, broker, store=store, home_dir=STATE_DIR)
+    runner.start()
+    click.echo(f"edge agent {edge_id} serving jobs (broker: {broker.root})")
+    try:
+        while True:
+            time.sleep(1)
+    except KeyboardInterrupt:
+        runner.stop()
+        broker.close()
+
+
 @cli.command("run", help="Run a simulation from a YAML config.")
 @click.option("--cf", "config_file", required=True, type=click.Path(exists=True))
 @click.option("--backend", default=None, help="sp | TPU (overrides YAML)")

@@ -1,0 +1,294 @@
+"""MLOps agent daemons: edge (client) and server runners.
+
+Parity: reference ``cli/edge_deployment/client_runner.py:38``
+(``FedMLClientRunner``: package download ``retrieve_and_unzip_package:129``,
+config rewrite ``update_local_fedml_config:147``, train-process fork
+``callback_start_train:426``, stop ``callback_stop_train:445``, status FSM
+``callback_runner_id_status:619``) and ``cli/server_deployment/
+server_runner.py:42`` (``FedMLServerRunner``: fans the training request to
+edges ``send_training_request_to_edges:426``).
+
+Redesign: the daemons ride the same pluggable control plane as the MQTT_S3
+backend — a ``PubSubBroker`` for job dispatch (filesystem broker needs no
+hosted MQTT) and a ``BlobStore`` for package distribution (filesystem store
+replaces S3). The job lifecycle is identical: a start message names a built
+package; the edge daemon fetches + unzips it, rewrites its YAML config with
+the run's dynamic args, forks the training process, and reports the
+IDLE/RUNNING/FAILED/FINISHED FSM through MLOpsMetrics and a status file the
+CLI ``status`` command reads.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+import zipfile
+from typing import Any, Dict, Optional
+
+import yaml
+
+from ..comm.message import pack_payload, unpack_payload
+from ..comm.pubsub import PubSubBroker
+from ..comm.store import BlobStore
+from ..core.mlops import MetricsSink, MLOpsMetrics
+
+JOB_TOPIC_FMT = "mlops_job_{edge_id}"
+STATUS_TOPIC = "mlops_status"
+
+MSG_START_TRAIN = "start_train"
+MSG_STOP_TRAIN = "stop_train"
+
+
+class FedMLEdgeRunner:
+    """Edge agent daemon (reference ``FedMLClientRunner:38``)."""
+
+    def __init__(
+        self,
+        edge_id: int,
+        broker: PubSubBroker,
+        store: Optional[BlobStore] = None,
+        home_dir: Optional[str] = None,
+        sink: Optional[MetricsSink] = None,
+    ):
+        self.edge_id = int(edge_id)
+        self.broker = broker
+        self.store = store
+        self.home = home_dir or os.path.expanduser(
+            os.environ.get("FEDML_TPU_HOME", "~/.fedml_tpu")
+        )
+        os.makedirs(self.home, exist_ok=True)
+        self.metrics = MLOpsMetrics(sink=sink)
+        self.metrics.edge_id = self.edge_id
+        self._proc: Optional[subprocess.Popen] = None
+        self._proc_lock = threading.Lock()
+        self._running = True
+        self._done = threading.Event()
+        self._report_status(MLOpsMetrics.STATUS_IDLE)
+
+    # --- lifecycle ----------------------------------------------------------
+    def start(self) -> None:
+        """Subscribe to this edge's job topic and serve jobs until stop().
+        Brokers with history replay deliver jobs queued before the daemon
+        came up (the reference relies on MQTT retained sessions for this)."""
+        topic = JOB_TOPIC_FMT.format(edge_id=self.edge_id)
+        subscribe = getattr(self.broker, "subscribe_from_start", self.broker.subscribe)
+        subscribe(topic, self._on_job)
+
+    def stop(self) -> None:
+        self._running = False
+        self.broker.unsubscribe(JOB_TOPIC_FMT.format(edge_id=self.edge_id))
+        self._kill_train_process()
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        """Block until a job reaches a terminal state (test convenience)."""
+        return self._done.wait(timeout)
+
+    # --- job handling -------------------------------------------------------
+    def _on_job(self, _topic: str, payload: bytes) -> None:
+        if not self._running:
+            return
+        job = unpack_payload(payload)
+        kind = job.get("msg")
+        if kind == MSG_START_TRAIN:
+            self._callback_start_train(job)
+        elif kind == MSG_STOP_TRAIN:
+            self._callback_stop_train(job)
+
+    def _package_dirs(self, run_id) -> Dict[str, str]:
+        base = os.path.join(self.home, "fedml_run", f"run_{run_id}",
+                            f"edge_{self.edge_id}")
+        return {
+            "download": os.path.join(base, "download"),
+            "run": os.path.join(base, "package"),
+        }
+
+    def retrieve_and_unzip_package(self, run_id, package_ref: str) -> str:
+        """Fetch the built package (blob-store key or local path) and unzip
+        it into this run's directory (reference ``:129``)."""
+        dirs = self._package_dirs(run_id)
+        os.makedirs(dirs["download"], exist_ok=True)
+        local_zip = os.path.join(dirs["download"], os.path.basename(package_ref))
+        if self.store is not None and not os.path.exists(package_ref):
+            with open(local_zip, "wb") as f:
+                f.write(self.store.get(package_ref))
+        else:
+            shutil.copyfile(package_ref, local_zip)
+        shutil.rmtree(dirs["run"], ignore_errors=True)
+        with zipfile.ZipFile(local_zip) as z:
+            z.extractall(dirs["run"])
+        return dirs["run"]
+
+    def update_local_config(self, package_dir: str, dynamic_args: Dict[str, Any]) -> str:
+        """Rewrite the packaged YAML config with the run's dynamic args
+        (reference ``update_local_fedml_config:147``). Returns the rewritten
+        config path."""
+        cfg_dir = os.path.join(package_dir, "config")
+        cfg_path = None
+        for name in sorted(os.listdir(cfg_dir)):
+            if name.endswith((".yaml", ".yml")):
+                cfg_path = os.path.join(cfg_dir, name)
+                break
+        if cfg_path is None:
+            raise FileNotFoundError(f"no yaml config inside {cfg_dir}")
+        with open(cfg_path) as f:
+            cfg = yaml.safe_load(f) or {}
+        # dynamic args land in the common_args section family
+        common = cfg.setdefault("common_args", {})
+        for k, v in (dynamic_args or {}).items():
+            common[k] = v
+        with open(cfg_path, "w") as f:
+            yaml.safe_dump(cfg, f)
+        return cfg_path
+
+    def _callback_start_train(self, job: Dict[str, Any]) -> None:
+        """Reference ``callback_start_train:426``: package -> config -> fork."""
+        run_id = job.get("run_id", 0)
+        self.metrics.run_id = run_id
+        self._done.clear()
+        try:
+            package_dir = self.retrieve_and_unzip_package(run_id, job["package"])
+            cfg_path = self.update_local_config(
+                package_dir, job.get("dynamic_args", {})
+            )
+            with open(os.path.join(package_dir, "package.json")) as f:
+                entry_point = json.load(f)["entry_point"]
+            entry = os.path.join(package_dir, "source", entry_point)
+            env = dict(os.environ)
+            env.update({str(k): str(v) for k, v in (job.get("env") or {}).items()})
+            log_dir = os.path.join(self.home, "logs")
+            os.makedirs(log_dir, exist_ok=True)
+            log_path = os.path.join(log_dir, f"run_{run_id}_edge_{self.edge_id}.log")
+            self._report_status(MLOpsMetrics.STATUS_RUNNING)
+            with self._proc_lock:
+                self._proc = subprocess.Popen(
+                    [sys.executable, entry, "--cf", cfg_path],
+                    cwd=package_dir, env=env,
+                    stdout=open(log_path, "w"), stderr=subprocess.STDOUT,
+                )
+            threading.Thread(target=self._watch_train_process, daemon=True).start()
+        except Exception:
+            logging.exception("edge %d: start_train failed", self.edge_id)
+            self._report_status(MLOpsMetrics.STATUS_FAILED)
+            self._done.set()
+
+    def _watch_train_process(self) -> None:
+        with self._proc_lock:
+            proc = self._proc
+        if proc is None:
+            return
+        rc = proc.wait()
+        if rc == 0:
+            self._report_status(MLOpsMetrics.STATUS_FINISHED)
+        elif rc < 0:
+            self._report_status(MLOpsMetrics.STATUS_KILLED)
+        else:
+            self._report_status(MLOpsMetrics.STATUS_FAILED)
+        self._done.set()
+
+    def _callback_stop_train(self, job: Dict[str, Any]) -> None:
+        """Reference ``callback_stop_train:445``."""
+        self._kill_train_process()
+        self._report_status(MLOpsMetrics.STATUS_KILLED)
+        self._done.set()
+
+    def _kill_train_process(self) -> None:
+        with self._proc_lock:
+            if self._proc is not None and self._proc.poll() is None:
+                self._proc.terminate()
+                try:
+                    self._proc.wait(timeout=5)
+                except subprocess.TimeoutExpired:
+                    self._proc.kill()
+
+    # --- status FSM ---------------------------------------------------------
+    def _report_status(self, status: str) -> None:
+        """Reference ``callback_runner_id_status:619`` + CLI status file."""
+        self.status = status
+        self.metrics.report_client_training_status(self.edge_id, status)
+        with open(os.path.join(self.home, "status.json"), "w") as f:
+            json.dump({"status": status, "edge_id": self.edge_id,
+                       "time": time.time()}, f)
+        self.broker.publish(STATUS_TOPIC, pack_payload(
+            {"edge_id": self.edge_id, "status": status}
+        ))
+
+
+class FedMLServerRunner:
+    """Server agent (reference ``FedMLServerRunner:42``): receives a run
+    request and fans the training job out to the edges."""
+
+    def __init__(
+        self,
+        broker: PubSubBroker,
+        store: Optional[BlobStore] = None,
+        sink: Optional[MetricsSink] = None,
+    ):
+        self.broker = broker
+        self.store = store
+        self.metrics = MLOpsMetrics(sink=sink)
+        self.edge_status: Dict[int, str] = {}
+        self._status_lock = threading.Lock()
+        self.broker.subscribe(STATUS_TOPIC, self._on_edge_status)
+
+    def _on_edge_status(self, _topic: str, payload: bytes) -> None:
+        rec = unpack_payload(payload)
+        with self._status_lock:
+            self.edge_status[int(rec["edge_id"])] = rec["status"]
+
+    def upload_package(self, run_id, package_path: str) -> str:
+        """Publish the built package for edges to fetch. With a store, edges
+        pull by key; without one they read the local path directly."""
+        if self.store is None:
+            return package_path
+        key = f"package_run{run_id}_{os.path.basename(package_path)}"
+        with open(package_path, "rb") as f:
+            self.store.put(key, f.read())
+        return key
+
+    def send_training_request_to_edges(
+        self,
+        run_id,
+        edge_ids,
+        package_path: str,
+        dynamic_args: Optional[Dict[str, Any]] = None,
+        env: Optional[Dict[str, str]] = None,
+    ) -> None:
+        """Reference ``send_training_request_to_edges:426``."""
+        package_ref = self.upload_package(run_id, package_path)
+        self.metrics.report_server_training_status(
+            run_id, MLOpsMetrics.STATUS_RUNNING)
+        for edge_id in edge_ids:
+            job = {
+                "msg": MSG_START_TRAIN,
+                "run_id": run_id,
+                "package": package_ref,
+                "dynamic_args": dict(dynamic_args or {}, rank=edge_id),
+                "env": env or {},
+            }
+            self.broker.publish(
+                JOB_TOPIC_FMT.format(edge_id=edge_id), pack_payload(job)
+            )
+
+    def send_stop_request_to_edges(self, run_id, edge_ids) -> None:
+        for edge_id in edge_ids:
+            self.broker.publish(
+                JOB_TOPIC_FMT.format(edge_id=edge_id),
+                pack_payload({"msg": MSG_STOP_TRAIN, "run_id": run_id}),
+            )
+
+    def wait_for_edges(self, edge_ids, terminal=("FINISHED", "FAILED", "KILLED"),
+                       timeout: float = 300.0) -> Dict[int, str]:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            with self._status_lock:
+                if all(self.edge_status.get(e) in terminal for e in edge_ids):
+                    break
+            time.sleep(0.05)
+        with self._status_lock:
+            return dict(self.edge_status)

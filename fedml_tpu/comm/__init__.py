@@ -18,6 +18,9 @@ from .message import (
 )
 from .loopback import LoopbackCommManager, LoopbackHub, get_default_hub
 from .managers import ClientManager, FedMLCommManager, ServerManager, create_comm_backend
+from .mqtt_s3 import MqttS3CommManager
+from .pubsub import FileSystemBroker, InProcessBroker, PubSubBroker
+from .store import BlobStore, FileSystemBlobStore, InMemoryBlobStore
 from .topology import (
     AsymmetricTopologyManager,
     BaseTopologyManager,
@@ -31,6 +34,8 @@ __all__ = [
     "compress_tree", "decompress_tree", "is_compressed",
     "LoopbackCommManager", "LoopbackHub", "get_default_hub",
     "ClientManager", "FedMLCommManager", "ServerManager", "create_comm_backend",
+    "MqttS3CommManager", "PubSubBroker", "InProcessBroker", "FileSystemBroker",
+    "BlobStore", "FileSystemBlobStore", "InMemoryBlobStore",
     "BaseTopologyManager", "SymmetricTopologyManager", "AsymmetricTopologyManager",
     "ring_mixing_matrix",
 ]

@@ -35,9 +35,23 @@ def create_comm_backend(backend: str, rank: int, size: int, args=None, **kw) -> 
             base_port=int(kw.get("base_port") or getattr(args, "grpc_base_port", 8890)),
         )
     if backend == constants.COMM_BACKEND_MQTT_S3:
-        raise NotImplementedError(
-            "MQTT_S3 backend requires paho-mqtt/boto3 (not in this image); "
-            "use GRPC for WAN or LOOPBACK for tests"
+        from .mqtt_s3 import MqttS3CommManager
+        from .pubsub import FileSystemBroker
+        from .store import FileSystemBlobStore
+
+        broker = kw.get("broker")
+        store = kw.get("store")
+        if broker is None:
+            broker = FileSystemBroker(
+                root=getattr(args, "mqtt_broker_dir", None) or kw.get("broker_dir")
+            )
+        if store is None:
+            store = FileSystemBlobStore(
+                root=getattr(args, "blob_store_dir", None) or kw.get("store_dir")
+            )
+        return MqttS3CommManager(
+            broker, store, rank=rank, size=size,
+            run_id=str(getattr(args, "run_id", 0)),
         )
     raise ValueError(f"unknown comm backend '{backend}'")
 

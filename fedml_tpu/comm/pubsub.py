@@ -1,0 +1,167 @@
+"""Topic-based pub/sub control plane for WAN deployments.
+
+Parity: reference MQTT usage (``core/distributed/communication/mqtt/
+mqtt_comm_manager.py:14`` and the MQTT half of ``mqtt_s3``): actors publish
+small control messages on topics and subscribe with callbacks. Redesign: a
+broker *interface* so the transport is pluggable — an in-process broker for
+tests, a filesystem broker that works across processes on one host (or an
+NFS mount) with zero extra dependencies, and paho-mqtt as a drop-in driver
+whenever it exists (same publish/subscribe surface).
+"""
+
+from __future__ import annotations
+
+import abc
+import os
+import tempfile
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+Callback = Callable[[str, bytes], None]  # (topic, payload)
+
+
+class PubSubBroker(abc.ABC):
+    @abc.abstractmethod
+    def publish(self, topic: str, payload: bytes) -> None:
+        ...
+
+    @abc.abstractmethod
+    def subscribe(self, topic: str, callback: Callback) -> None:
+        ...
+
+    @abc.abstractmethod
+    def unsubscribe(self, topic: str) -> None:
+        ...
+
+    def close(self) -> None:
+        pass
+
+
+class InProcessBroker(PubSubBroker):
+    """Thread-safe broker for single-process deployments/tests; publish
+    dispatches synchronously on the publisher's thread."""
+
+    def __init__(self):
+        self._subs: Dict[str, List[Callback]] = {}
+        self._lock = threading.Lock()
+
+    def publish(self, topic: str, payload: bytes) -> None:
+        with self._lock:
+            cbs = list(self._subs.get(topic, ()))
+        for cb in cbs:
+            cb(topic, payload)
+
+    def subscribe(self, topic: str, callback: Callback) -> None:
+        with self._lock:
+            self._subs.setdefault(topic, []).append(callback)
+
+    def unsubscribe(self, topic: str) -> None:
+        with self._lock:
+            self._subs.pop(topic, None)
+
+
+class FileSystemBroker(PubSubBroker):
+    """Cross-process broker over a shared directory.
+
+    Each topic is a directory; messages are monotonically numbered files
+    (atomic tmp+rename). Every broker instance runs one poller thread that
+    dispatches new files for its subscribed topics in sequence order. Good
+    for multi-process single-host deployments (the reference needs a live
+    MQTT broker for the same job).
+    """
+
+    POLL_INTERVAL = 0.02
+
+    def __init__(self, root: Optional[str] = None):
+        self.root = root or os.path.join(tempfile.gettempdir(), "fedml_tpu_broker")
+        os.makedirs(self.root, exist_ok=True)
+        self._subs: Dict[str, Callback] = {}
+        self._cursor: Dict[str, int] = {}  # topic -> next seq to dispatch
+        self._lock = threading.Lock()
+        self._seq_lock = threading.Lock()
+        self._running = True
+        self._thread = threading.Thread(target=self._poll_loop, daemon=True)
+        self._thread.start()
+
+    def _topic_dir(self, topic: str) -> str:
+        d = os.path.join(self.root, topic.replace("/", "_"))
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def publish(self, topic: str, payload: bytes) -> None:
+        d = self._topic_dir(topic)
+        with self._seq_lock:
+            # claim the next sequence number atomically via exclusive create;
+            # O_EXCL makes concurrent publishers (even cross-process) retry
+            # rather than overwrite
+            seq = len([f for f in os.listdir(d) if f.endswith(".msg")])
+            while True:
+                path = os.path.join(d, f"{seq:012d}.msg")
+                try:
+                    fd = os.open(path + ".tmp", os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+                    break
+                except FileExistsError:
+                    seq += 1
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(payload)
+            os.replace(path + ".tmp", path)
+        finally:
+            if os.path.exists(path + ".tmp"):
+                os.unlink(path + ".tmp")
+
+    def subscribe(self, topic: str, callback: Callback) -> None:
+        with self._lock:
+            self._subs[topic] = callback
+            # new subscribers start at the topic's current head (MQTT
+            # semantics: no replay of history)
+            d = self._topic_dir(topic)
+            self._cursor[topic] = len(
+                [f for f in os.listdir(d) if f.endswith(".msg")]
+            )
+
+    def subscribe_from_start(self, topic: str, callback: Callback) -> None:
+        """Like subscribe, but replays everything already published — used by
+        late-joining actors (job queues)."""
+        with self._lock:
+            self._subs[topic] = callback
+            self._cursor[topic] = 0
+
+    def unsubscribe(self, topic: str) -> None:
+        with self._lock:
+            self._subs.pop(topic, None)
+            self._cursor.pop(topic, None)
+
+    def _poll_loop(self) -> None:
+        while self._running:
+            with self._lock:
+                subs = dict(self._subs)
+                cursors = dict(self._cursor)
+            dispatched = False
+            for topic, cb in subs.items():
+                d = self._topic_dir(topic)
+                seq = cursors.get(topic, 0)
+                while True:
+                    path = os.path.join(d, f"{seq:012d}.msg")
+                    if not os.path.exists(path):
+                        break
+                    with open(path, "rb") as f:
+                        payload = f.read()
+                    try:
+                        cb(topic, payload)
+                    except Exception:  # subscriber errors must not kill the loop
+                        import logging
+
+                        logging.exception("pubsub callback failed on %s", topic)
+                    seq += 1
+                    dispatched = True
+                with self._lock:
+                    if topic in self._cursor:
+                        self._cursor[topic] = max(self._cursor[topic], seq)
+            if not dispatched:
+                time.sleep(self.POLL_INTERVAL)
+
+    def close(self) -> None:
+        self._running = False
+        self._thread.join(timeout=2)

@@ -1,0 +1,107 @@
+"""Object-store abstraction for WAN weight shipping.
+
+Parity: reference ``core/distributed/communication/mqtt_s3/remote_storage.py``
+(``S3Storage:11`` — ``write_model:39`` pickles a state_dict into S3,
+``read_model:59`` fetches it back). Redesign: a minimal ``BlobStore``
+interface (put/get/delete by key) that any driver can implement; payloads are
+already bytes (the msgpack codec, no pickle). The filesystem driver works in
+zero-egress environments and doubles as a shared store for multi-process
+deployments on one host / an NFS mount; an S3 driver is a drop-in whenever
+boto3 exists (same three methods).
+"""
+
+from __future__ import annotations
+
+import abc
+import os
+import tempfile
+from typing import List, Optional
+
+
+class BlobStore(abc.ABC):
+    """put/get/delete blobs by key; ``url_for`` gives a locator string that
+    rides in control messages (``model_params_url`` key parity)."""
+
+    @abc.abstractmethod
+    def put(self, key: str, data: bytes) -> str:
+        """Store ``data`` under ``key``; returns the blob's URL."""
+
+    @abc.abstractmethod
+    def get(self, key: str) -> bytes:
+        ...
+
+    @abc.abstractmethod
+    def delete(self, key: str) -> None:
+        ...
+
+    @abc.abstractmethod
+    def list_keys(self, prefix: str = "") -> List[str]:
+        ...
+
+    def url_for(self, key: str) -> str:
+        return key
+
+
+class FileSystemBlobStore(BlobStore):
+    """Blobs as files under a root directory (atomic tmp+rename writes, so a
+    concurrent reader never sees a half-written model)."""
+
+    def __init__(self, root: Optional[str] = None):
+        self.root = root or os.path.join(tempfile.gettempdir(), "fedml_tpu_blobs")
+        os.makedirs(self.root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        safe = key.replace("/", "_")
+        return os.path.join(self.root, safe)
+
+    def put(self, key: str, data: bytes) -> str:
+        path = self._path(key)
+        fd, tmp = tempfile.mkstemp(dir=self.root)
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        return self.url_for(key)
+
+    def get(self, key: str) -> bytes:
+        with open(self._path(key), "rb") as f:
+            return f.read()
+
+    def delete(self, key: str) -> None:
+        try:
+            os.unlink(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def list_keys(self, prefix: str = "") -> List[str]:
+        safe = prefix.replace("/", "_")
+        return sorted(k for k in os.listdir(self.root) if k.startswith(safe))
+
+    def url_for(self, key: str) -> str:
+        return "file://" + self._path(key)
+
+
+class InMemoryBlobStore(BlobStore):
+    """Dict-backed store for single-process tests."""
+
+    def __init__(self):
+        self._blobs = {}
+
+    def put(self, key: str, data: bytes) -> str:
+        self._blobs[key] = bytes(data)
+        return self.url_for(key)
+
+    def get(self, key: str) -> bytes:
+        return self._blobs[key]
+
+    def delete(self, key: str) -> None:
+        self._blobs.pop(key, None)
+
+    def list_keys(self, prefix: str = "") -> List[str]:
+        return sorted(k for k in self._blobs if k.startswith(prefix))
+
+    def url_for(self, key: str) -> str:
+        return "mem://" + key

@@ -1,0 +1,126 @@
+"""Agent daemon e2e: cli build -> server runner fan-out -> edge daemon
+fetch/rewrite/fork -> status FSM reaches FINISHED.
+
+Reference lifecycle: client_runner.py:129 (package), :147 (config rewrite),
+:426 (fork), :619 (status FSM); server_runner.py:426 (fan-out).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import zipfile
+
+import yaml
+
+from fedml_tpu.cli.runner import FedMLEdgeRunner, FedMLServerRunner
+from fedml_tpu.comm.pubsub import FileSystemBroker
+from fedml_tpu.comm.store import FileSystemBlobStore
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ENTRY = textwrap.dedent(
+    """
+    import argparse, json, os
+    import fedml_tpu
+    from fedml_tpu.arguments import load_arguments
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--cf", required=True)
+    opts = p.parse_args()
+    args = load_arguments(args_list=["--cf", opts.cf])
+    fedml_tpu.init(args=args)
+    history = fedml_tpu.run_simulation(args=args)
+    with open("result.json", "w") as f:
+        json.dump({"rounds": len(history), "rank": int(getattr(args, "rank", -1))}, f)
+    """
+)
+
+CONFIG = {
+    "common_args": {"random_seed": 0, "run_id": "agent_e2e"},
+    "data_args": {"dataset": "mnist", "debug_small_data": True},
+    "model_args": {"model": "lr"},
+    "train_args": {
+        "federated_optimizer": "FedAvg", "client_num_in_total": 4,
+        "client_num_per_round": 4, "comm_round": 2, "epochs": 1,
+        "batch_size": 8, "learning_rate": 0.1,
+    },
+    "validation_args": {"frequency_of_the_test": 1},
+}
+
+
+def _build_package(tmp_path) -> str:
+    src = tmp_path / "src"
+    cfg = tmp_path / "cfg"
+    dist = tmp_path / "dist"
+    src.mkdir(); cfg.mkdir()
+    (src / "main.py").write_text(ENTRY)
+    (cfg / "fedml_config.yaml").write_text(yaml.safe_dump(CONFIG))
+    r = subprocess.run(
+        [sys.executable, "-m", "fedml_tpu.cli", "build", "-t", "client",
+         "-sf", str(src), "-ep", "main.py", "-cf", str(cfg), "-df", str(dist)],
+        capture_output=True, text=True, cwd=REPO_ROOT,
+        env=dict(os.environ, PYTHONPATH=REPO_ROOT),
+    )
+    assert r.returncode == 0, r.stderr
+    pkg = dist / "fedml_tpu-client-package.zip"
+    assert pkg.exists()
+    with zipfile.ZipFile(pkg) as z:
+        names = z.namelist()
+    assert "package.json" in names and "source/main.py" in names
+    return str(pkg)
+
+
+def test_agent_daemon_end_to_end(tmp_path):
+    pkg = _build_package(tmp_path)
+    broker = FileSystemBroker(root=str(tmp_path / "broker"))
+    store = FileSystemBlobStore(root=str(tmp_path / "blobs"))
+
+    server = FedMLServerRunner(broker, store=store)
+    edge = FedMLEdgeRunner(
+        7, broker, store=store, home_dir=str(tmp_path / "edge_home")
+    )
+    edge.start()
+    assert edge.status == "IDLE"
+
+    # the child is a fresh interpreter: force the virtual CPU platform so it
+    # never dials the TPU tunnel from inside a test
+    child_env = {
+        "PYTHONPATH": REPO_ROOT,
+        "JAX_PLATFORMS": "cpu",
+        "PALLAS_AXON_POOL_IPS": "",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+    }
+    server.send_training_request_to_edges(
+        run_id="r42", edge_ids=[7], package_path=pkg,
+        dynamic_args={"comm_round": 2}, env=child_env,
+    )
+    assert edge.wait(timeout=240), "edge daemon never reached a terminal state"
+    statuses = server.wait_for_edges([7], timeout=30)
+    assert statuses[7] == "FINISHED", statuses
+
+    # the forked run really executed inside the unzipped package dir
+    run_dir = tmp_path / "edge_home" / "fedml_run" / "run_r42" / "edge_7" / "package"
+    result = json.loads((run_dir / "result.json").read_text())
+    assert result["rounds"] == 2
+    assert result["rank"] == 7  # dynamic_args rewrote the packaged config
+    # status file for the CLI
+    status = json.loads((tmp_path / "edge_home" / "status.json").read_text())
+    assert status["status"] == "FINISHED"
+    edge.stop()
+    broker.close()
+
+
+def test_edge_daemon_reports_failure(tmp_path):
+    broker = FileSystemBroker(root=str(tmp_path / "broker"))
+    edge = FedMLEdgeRunner(3, broker, home_dir=str(tmp_path / "home"))
+    edge.start()
+    server = FedMLServerRunner(broker)
+    server.send_training_request_to_edges(
+        run_id="bad", edge_ids=[3], package_path=str(tmp_path / "missing.zip"),
+    )
+    assert edge.wait(timeout=30)
+    assert server.wait_for_edges([3], timeout=10)[3] == "FAILED"
+    edge.stop()
+    broker.close()

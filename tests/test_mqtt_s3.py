@@ -1,0 +1,151 @@
+"""WAN plane: blob store, pub/sub brokers, MQTT_S3 backend, cross-silo e2e."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.comm import (
+    FileSystemBlobStore,
+    FileSystemBroker,
+    InMemoryBlobStore,
+    InProcessBroker,
+    Message,
+    MqttS3CommManager,
+)
+
+
+def test_filesystem_blob_store_roundtrip(tmp_path):
+    store = FileSystemBlobStore(root=str(tmp_path))
+    url = store.put("topic_abc/key1", b"\x00\x01weights")
+    assert url.startswith("file://")
+    assert store.get("topic_abc/key1") == b"\x00\x01weights"
+    assert store.list_keys("topic_abc") == ["topic_abc_key1"]
+    store.delete("topic_abc/key1")
+    assert store.list_keys() == []
+    store.delete("topic_abc/key1")  # idempotent
+
+
+def test_filesystem_broker_order_and_isolation(tmp_path):
+    broker = FileSystemBroker(root=str(tmp_path))
+    got_a, got_b = [], []
+    broker.subscribe("alpha", lambda t, p: got_a.append(p))
+    broker.subscribe("beta", lambda t, p: got_b.append(p))
+    for i in range(5):
+        broker.publish("alpha", f"a{i}".encode())
+    broker.publish("beta", b"b0")
+    deadline = time.time() + 5
+    while (len(got_a), len(got_b)) != (5, 1) and time.time() < deadline:
+        time.sleep(0.01)
+    assert got_a == [f"a{i}".encode() for i in range(5)]  # in publish order
+    assert got_b == [b"b0"]
+    broker.close()
+
+
+def test_filesystem_broker_no_history_replay(tmp_path):
+    broker = FileSystemBroker(root=str(tmp_path))
+    broker.publish("t", b"old")
+    got = []
+    broker.subscribe("t", lambda t, p: got.append(p))  # subscribes at head
+    broker.publish("t", b"new")
+    deadline = time.time() + 5
+    while not got and time.time() < deadline:
+        time.sleep(0.01)
+    assert got == [b"new"]  # MQTT semantics: no replay
+    got2 = []
+    broker.subscribe_from_start("t", lambda t, p: got2.append(p))
+    deadline = time.time() + 5
+    while len(got2) < 2 and time.time() < deadline:
+        time.sleep(0.01)
+    assert got2 == [b"old", b"new"]  # job-queue semantics: full replay
+    broker.close()
+
+
+def test_mqtt_s3_payload_rides_the_store():
+    """Large model params must be replaced by key+URL in the control message
+    and transparently restored on receive (reference
+    mqtt_s3_multi_clients_comm_manager.py:233-327 semantics)."""
+    broker = InProcessBroker()
+    store = InMemoryBlobStore()
+    server = MqttS3CommManager(broker, store, rank=0, size=2, run_id="run7")
+
+    received = []
+
+    class Obs:
+        def receive_message(self, t, msg):
+            received.append(msg)
+            server.stop_receive_message()
+
+    server.add_observer(Obs())
+    client = MqttS3CommManager(broker, store, rank=1, size=2, run_id="run7")
+
+    big = {"w": np.arange(10_000, dtype=np.float32)}
+    msg = Message(type=3, sender_id=1, receiver_id=0)
+    msg.add_params(Message.MSG_ARG_KEY_MODEL_PARAMS, big)
+    client.send_message(msg)
+    server.handle_receive_message()
+
+    assert len(received) == 1
+    got = received[0].get(Message.MSG_ARG_KEY_MODEL_PARAMS)
+    np.testing.assert_array_equal(got["w"], big["w"])
+    # the blob really went through the store, and the control message carried
+    # the locator
+    assert len(store.list_keys()) == 1
+    assert received[0].get(Message.MSG_ARG_KEY_MODEL_PARAMS_URL, "").startswith("mem://")
+
+
+def test_mqtt_s3_small_payload_inline():
+    broker = InProcessBroker()
+    store = InMemoryBlobStore()
+    server = MqttS3CommManager(broker, store, rank=0, size=2)
+    got = []
+
+    class Obs:
+        def receive_message(self, t, msg):
+            got.append(msg)
+            server.stop_receive_message()
+
+    server.add_observer(Obs())
+    client = MqttS3CommManager(broker, store, rank=1, size=2)
+    msg = Message(type=4, sender_id=1, receiver_id=0)
+    msg.add_params(Message.MSG_ARG_KEY_MODEL_PARAMS, {"b": np.zeros(4, np.float32)})
+    client.send_message(msg)
+    server.handle_receive_message()
+    assert store.list_keys() == []  # tiny payload stays inline
+    np.testing.assert_array_equal(
+        got[0].get(Message.MSG_ARG_KEY_MODEL_PARAMS)["b"], np.zeros(4))
+
+
+def test_cross_silo_e2e_over_mqtt_s3(tmp_path):
+    """Full cross-silo round protocol over the filesystem broker + store —
+    the MLOps-default transport path, no paho/boto3 required."""
+    from fedml_tpu.cross_silo import FedML_Horizontal
+
+    broker_dir = str(tmp_path / "broker")
+    store_dir = str(tmp_path / "blobs")
+    args = fedml_tpu.init(config=dict(
+        dataset="mnist", model="lr", debug_small_data=True,
+        client_num_in_total=2, client_num_per_round=2, comm_round=2,
+        learning_rate=0.1, batch_size=8, frequency_of_the_test=1,
+        random_seed=0, run_id="e2e1",
+        mqtt_broker_dir=broker_dir, blob_store_dir=store_dir,
+    ))
+    managers = [
+        FedML_Horizontal(args, rank, 2, backend="MQTT_S3")
+        for rank in range(3)
+    ]
+    server, clients = managers[0], managers[1:]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    server.start()
+    server.run()
+    for t in threads:
+        t.join(timeout=60)
+    assert len(server.history) == 2
+    assert server.history[-1]["test_acc"] > 0.4
+    # model weights rode the blob store, not the control plane
+    assert len(os.listdir(store_dir)) > 0
