@@ -7,12 +7,14 @@ max + normalizer) so the (T, T) score matrix never materializes in HBM —
 memory O(T * Dh) instead of O(T^2), and the matmuls hit the MXU at
 (BLOCK_Q x Dh) x (Dh x BLOCK_K) granularity.
 
-Gradients: ``flash_attention`` carries a custom VJP whose backward
-recomputes attention with the dense XLA path — forward-pass memory/speed
-wins (the usual bottleneck for long-context eval/serving), exact gradients,
-~1 extra forward of FLOPs in training (the standard recompute trade).
+Gradients: ``flash_attention`` carries a custom VJP with *blockwise pallas
+backward kernels* (FlashAttention-2 scheme). The forward saves the per-row
+logsumexp; the backward recomputes probabilities block-by-block from
+(q, k, lse) and accumulates dq in a q-block-parallel kernel and dk/dv in a
+k-block-parallel kernel — so the backward, like the forward, never builds
+the (T, T) matrix. Cost is the standard ~one extra forward of FLOPs.
 
-On non-TPU backends the kernel runs in interpret mode so tests validate
+On non-TPU backends the kernels run in interpret mode so tests validate
 numerics everywhere; the compiled path engages on real TPU.
 """
 
@@ -23,16 +25,16 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
-from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, scale: float):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                  block_k: int, causal: bool, scale: float):
     """Grid: (B*H, T // block_q). Refs (leading grid-block dim of 1):
-    q (1, block_q, Dh), k/v (1, T, Dh), o (1, block_q, Dh)."""
+    q (1, block_q, Dh), k/v (1, T, Dh), o (1, block_q, Dh), lse (1, block_q)."""
     block_q = q_ref.shape[1]
     Dh = q_ref.shape[2]
     T = k_ref.shape[1]
@@ -75,33 +77,174 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool, sca
         m, l, acc = jax.lax.fori_loop(0, n_iter, body, (m0, l0, acc0))
     else:
         m, l, acc = jax.lax.fori_loop(0, n_kblocks, body, (m0, l0, acc0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l_safe))[:, 0]
+
+
+def _bh_layout(t):
+    """(B, T, H, Dh) -> (B*H, T, Dh)."""
+    B, T, H, Dh = t.shape
+    return t.transpose(0, 2, 1, 3).reshape(B * H, T, Dh)
 
 
 def _flash_forward(
     q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
     block_q: int, block_k: int, interpret: bool,
-) -> jax.Array:
-    """q/k/v: (B, T, H, Dh) -> (B, T, H, Dh)."""
+):
+    """q/k/v: (B, T, H, Dh) -> (out (B, T, H, Dh), lse (B*H, T) f32)."""
     B, T, H, Dh = q.shape
     scale = 1.0 / (Dh ** 0.5)
-    # fold (B, H) into the grid's first axis; layout (BH, T, Dh)
-    to_bh = lambda t: t.transpose(0, 2, 1, 3).reshape(B * H, T, Dh)  # noqa: E731
-    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    qb, kb, vb = _bh_layout(q), _bh_layout(k), _bh_layout(v)
     grid = (B * H, T // block_q)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(_flash_kernel, block_k=block_k, causal=causal, scale=scale),
-        out_shape=jax.ShapeDtypeStruct((B * H, T, Dh), q.dtype),
+        out_shape=(
+            jax.ShapeDtypeStruct((B * H, T, Dh), q.dtype),
+            jax.ShapeDtypeStruct((B * H, T), jnp.float32),
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, Dh), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, T, Dh), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, T, Dh), lambda bh, qi: (bh, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, Dh), lambda bh, qi: (bh, qi, 0)),
+        out_specs=(
+            pl.BlockSpec((1, block_q, Dh), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
+        ),
         interpret=interpret,
     )(qb, kb, vb)
-    return out.reshape(B, H, T, Dh).transpose(0, 2, 1, 3)
+    return out.reshape(B, H, T, Dh).transpose(0, 2, 1, 3), lse
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               block_k: int, causal: bool, scale: float):
+    """Grid (B*H, T // block_q): one q block accumulates its dq over all
+    (causal: non-masked) key blocks. p is recomputed from (q, k, lse)."""
+    block_q = q_ref.shape[1]
+    Dh = q_ref.shape[2]
+    T = k_ref.shape[1]
+    qi = pl.program_id(1)
+    q_start = qi * block_q
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0][:, None]          # (block_q, 1)
+    delta = delta_ref[0][:, None]      # (block_q, 1)
+    n_kblocks = T // block_k
+
+    def body(kb, dq):
+        k_start = kb * block_k
+        k_blk = k_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
+        s = scale * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                       # (block_q, block_k)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + scale * jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros((block_q, Dh), jnp.float32)
+    if causal:
+        n_iter = jnp.minimum((q_start + block_q + block_k - 1) // block_k, n_kblocks)
+        dq = jax.lax.fori_loop(0, n_iter, body, dq0)
+    else:
+        dq = jax.lax.fori_loop(0, n_kblocks, body, dq0)
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, block_q: int, causal: bool, scale: float):
+    """Grid (B*H, T // block_k): one key block accumulates its dk/dv over all
+    (causal: at-or-after-diagonal) query blocks."""
+    block_k = k_ref.shape[1]
+    Dh = k_ref.shape[2]
+    T = q_ref.shape[1]
+    ki = pl.program_id(1)
+    k_start = ki * block_k
+    k_blk = k_ref[0].astype(jnp.float32)
+    v_blk = v_ref[0].astype(jnp.float32)
+    n_qblocks = T // block_q
+
+    def body(qb, carry):
+        dk, dv = carry
+        q_start = qb * block_q
+        q = q_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(q_start, block_q)][:, None]
+        delta = delta_ref[0, pl.ds(q_start, block_q)][:, None]
+        s = scale * jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        if causal:
+            rows = q_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                       # (block_q, block_k)
+        dv = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk = dk + scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        return dk, dv
+
+    dk0 = jnp.zeros((block_k, Dh), jnp.float32)
+    dv0 = jnp.zeros((block_k, Dh), jnp.float32)
+    if causal:
+        # first q block whose rows can reach this key block: rows >= cols
+        # needs q_start + block_q - 1 >= k_start  =>  qb >= k_start // block_q
+        dk, dv = jax.lax.fori_loop(k_start // block_q, n_qblocks, body, (dk0, dv0))
+    else:
+        dk, dv = jax.lax.fori_loop(0, n_qblocks, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
+    """Blockwise dq/dk/dv; q/k/v/out/g (B, T, H, Dh), lse (B*H, T)."""
+    B, T, H, Dh = q.shape
+    scale = 1.0 / (Dh ** 0.5)
+    qb, kb, vb = _bh_layout(q), _bh_layout(k), _bh_layout(v)
+    dob = _bh_layout(g)
+    # delta_i = sum_d dO_id * O_id — O(T*Dh), plain XLA (fuses into one pass)
+    delta = jnp.sum(dob.astype(jnp.float32) * _bh_layout(out).astype(jnp.float32),
+                    axis=-1)  # (B*H, T)
+
+    qkv_spec = lambda blk: pl.BlockSpec((1, blk, Dh), lambda bh, i: (bh, i, 0))  # noqa: E731
+    full_spec = pl.BlockSpec((1, T, Dh), lambda bh, i: (bh, 0, 0))
+    row_spec = lambda blk: pl.BlockSpec((1, blk), lambda bh, i: (bh, i))  # noqa: E731
+    full_row = pl.BlockSpec((1, T), lambda bh, i: (bh, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, block_k=block_k, causal=causal, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, Dh), q.dtype),
+        grid=(B * H, T // block_q),
+        in_specs=[qkv_spec(block_q), full_spec, full_spec, qkv_spec(block_q),
+                  row_spec(block_q), row_spec(block_q)],
+        out_specs=qkv_spec(block_q),
+        interpret=interpret,
+    )(qb, kb, vb, dob, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, block_q=block_q, causal=causal, scale=scale),
+        out_shape=(jax.ShapeDtypeStruct((B * H, T, Dh), k.dtype),
+                   jax.ShapeDtypeStruct((B * H, T, Dh), v.dtype)),
+        grid=(B * H, T // block_k),
+        in_specs=[full_spec, qkv_spec(block_k), qkv_spec(block_k), full_spec,
+                  full_row, full_row],
+        out_specs=(qkv_spec(block_k), qkv_spec(block_k)),
+        interpret=interpret,
+    )(qb, kb, vb, dob, lse, delta)
+
+    from_bh = lambda t: t.reshape(B, H, T, Dh).transpose(0, 2, 1, 3)  # noqa: E731
+    return from_bh(dq), from_bh(dk), from_bh(dv)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
@@ -111,10 +254,12 @@ def flash_attention(
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: int = DEFAULT_BLOCK_K,
 ) -> jax.Array:
-    """Flash attention with dense-recompute backward. q/k/v (B, T, H, Dh);
-    requires T % block sizes == 0 (callers fall back to dense otherwise)."""
+    """Flash attention with blockwise pallas forward AND backward.
+    q/k/v (B, T, H, Dh); requires T % block sizes == 0 (callers fall back
+    to dense otherwise)."""
     interpret = jax.default_backend() != "tpu"
-    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out
 
 
 def _dense_attention(q, k, v, causal):
@@ -132,14 +277,15 @@ def _dense_attention(q, k, v, causal):
 
 
 def _fwd(q, k, v, causal, block_q, block_k):
-    out = flash_attention(q, k, v, causal, block_q, block_k)
-    return out, (q, k, v)
+    interpret = jax.default_backend() != "tpu"
+    out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, block_q, block_k, res, g):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda q, k, v: _dense_attention(q, k, v, causal), q, k, v)
-    return vjp(g)
+    q, k, v, out, lse = res
+    interpret = jax.default_backend() != "tpu"
+    return _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret)
 
 
 flash_attention.defvjp(_fwd, _bwd)
