@@ -59,12 +59,33 @@ def logout():
 
 @cli.command("status", help="Display training status.")
 def status():
-    p = _state_path("status.json")
-    if not os.path.exists(p):
+    def _read(name):
+        try:
+            with open(os.path.join(STATE_DIR, name)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    edge_recs = [
+        r for r in (
+            _read(f) for f in sorted(os.listdir(STATE_DIR))
+            if f.startswith("status_edge") and f.endswith(".json")
+        ) if r
+    ] if os.path.isdir(STATE_DIR) else []
+    local = _read("status.json")
+    # status.json without an edge_id came from the `run` command; with one
+    # it duplicates a per-edge file (agents write both). Show each source
+    # once so stale agent state never masks a live local run or vice versa.
+    show_local = local is not None and "edge_id" not in local
+    if not edge_recs and not show_local:
         click.echo("Client training status: IDLE")
         return
-    with open(p) as f:
-        click.echo("Client training status: " + json.load(f).get("status", "IDLE").upper())
+    if show_local:
+        click.echo("Client training status: "
+                   + local.get("status", "IDLE").upper())
+    for r in edge_recs:
+        click.echo(f"Edge {r.get('edge_id', '?')} training status: "
+                   + r.get("status", "IDLE").upper())
 
 
 @cli.command("logs", help="Display recent run logs.")

@@ -66,10 +66,36 @@ class FedMLEdgeRunner:
         self.metrics = MLOpsMetrics(sink=sink)
         self.metrics.edge_id = self.edge_id
         self._proc: Optional[subprocess.Popen] = None
+        self._current_run = None
         self._proc_lock = threading.Lock()
         self._running = True
         self._done = threading.Event()
+        # terminal job history persists across daemon restarts so replayed
+        # job-topic history (subscribe_from_start) never re-executes a run
+        # that already finished (reference relies on MQTT QoS for this)
+        self._history_path = os.path.join(
+            self.home, f"jobs_edge{self.edge_id}.json")
+        self._history_lock = threading.Lock()
+        self._job_history: Dict[str, str] = self._load_history()
         self._report_status(MLOpsMetrics.STATUS_IDLE)
+
+    def _load_history(self) -> Dict[str, str]:
+        try:
+            with open(self._history_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {}
+
+    def _record_terminal(self, run_id, status: str) -> None:
+        # watcher thread and poller thread can both reach terminal for the
+        # same run (stop racing process exit): lock + atomic replace so a
+        # torn write can never wipe the whole replay-protection history
+        with self._history_lock:
+            self._job_history[str(run_id)] = status
+            tmp = self._history_path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(self._job_history, f)
+            os.replace(tmp, self._history_path)
 
     # --- lifecycle ----------------------------------------------------------
     def start(self) -> None:
@@ -149,6 +175,24 @@ class FedMLEdgeRunner:
     def _callback_start_train(self, job: Dict[str, Any]) -> None:
         """Reference ``callback_start_train:426``: package -> config -> fork."""
         run_id = job.get("run_id", 0)
+        if str(run_id) in self._job_history:
+            logging.info("edge %d: run %s already terminal (%s), skipping",
+                         self.edge_id, run_id, self._job_history[str(run_id)])
+            return
+        with self._proc_lock:
+            if (self._proc is not None and self._proc.poll() is None
+                    and self._current_run == run_id):
+                logging.info("edge %d: run %s already running, ignoring "
+                             "duplicate start", self.edge_id, run_id)
+                return
+            superseded = (self._current_run if self._proc is not None
+                          and self._proc.poll() is None else None)
+        # a different run supersedes the current one (reference restarts the
+        # training process on every start message); record the loser as
+        # KILLED here — its watcher bows out once self._proc is reassigned
+        if superseded is not None and str(superseded) not in self._job_history:
+            self._record_terminal(superseded, MLOpsMetrics.STATUS_KILLED)
+        self._kill_train_process()
         self.metrics.run_id = run_id
         self._done.clear()
         try:
@@ -166,34 +210,50 @@ class FedMLEdgeRunner:
             log_path = os.path.join(log_dir, f"run_{run_id}_edge_{self.edge_id}.log")
             self._report_status(MLOpsMetrics.STATUS_RUNNING)
             with self._proc_lock:
-                self._proc = subprocess.Popen(
-                    [sys.executable, entry, "--cf", cfg_path],
-                    cwd=package_dir, env=env,
-                    stdout=open(log_path, "w"), stderr=subprocess.STDOUT,
-                )
-            threading.Thread(target=self._watch_train_process, daemon=True).start()
+                # the child duplicates the log fd; close the parent's copy
+                with open(log_path, "w") as log:
+                    self._proc = subprocess.Popen(
+                        [sys.executable, entry, "--cf", cfg_path],
+                        cwd=package_dir, env=env,
+                        stdout=log, stderr=subprocess.STDOUT,
+                    )
+                self._current_run = run_id
+                proc = self._proc
+            threading.Thread(target=self._watch_train_process,
+                             args=(proc, run_id), daemon=True).start()
         except Exception:
             logging.exception("edge %d: start_train failed", self.edge_id)
+            self._record_terminal(run_id, MLOpsMetrics.STATUS_FAILED)
             self._report_status(MLOpsMetrics.STATUS_FAILED)
             self._done.set()
 
-    def _watch_train_process(self) -> None:
-        with self._proc_lock:
-            proc = self._proc
-        if proc is None:
-            return
+    def _watch_train_process(self, proc: subprocess.Popen, run_id) -> None:
         rc = proc.wait()
+        with self._proc_lock:
+            if self._proc is not proc:
+                return  # superseded by a newer run; its watcher owns status
         if rc == 0:
-            self._report_status(MLOpsMetrics.STATUS_FINISHED)
+            status = MLOpsMetrics.STATUS_FINISHED
         elif rc < 0:
-            self._report_status(MLOpsMetrics.STATUS_KILLED)
+            status = MLOpsMetrics.STATUS_KILLED
         else:
-            self._report_status(MLOpsMetrics.STATUS_FAILED)
+            status = MLOpsMetrics.STATUS_FAILED
+        self._record_terminal(run_id, status)
+        self._report_status(status)
         self._done.set()
 
     def _callback_stop_train(self, job: Dict[str, Any]) -> None:
         """Reference ``callback_stop_train:445``."""
+        run_id = job.get("run_id", self._current_run)
+        if run_id is not None and str(run_id) in self._job_history:
+            # replayed stop for an already-terminal run: no spurious KILLED
+            return
+        if run_id is not None and self._current_run is not None \
+                and run_id != self._current_run:
+            return  # stop for a run this daemon never started
         self._kill_train_process()
+        if run_id is not None:
+            self._record_terminal(run_id, MLOpsMetrics.STATUS_KILLED)
         self._report_status(MLOpsMetrics.STATUS_KILLED)
         self._done.set()
 
@@ -211,9 +271,15 @@ class FedMLEdgeRunner:
         """Reference ``callback_runner_id_status:619`` + CLI status file."""
         self.status = status
         self.metrics.report_client_training_status(self.edge_id, status)
+        # per-edge file: multiple agents sharing one home dir must not
+        # clobber each other's state (plus the legacy shared file the CLI
+        # `status` command falls back to)
+        rec = {"status": status, "edge_id": self.edge_id, "time": time.time()}
+        with open(os.path.join(self.home,
+                               f"status_edge{self.edge_id}.json"), "w") as f:
+            json.dump(rec, f)
         with open(os.path.join(self.home, "status.json"), "w") as f:
-            json.dump({"status": status, "edge_id": self.edge_id,
-                       "time": time.time()}, f)
+            json.dump(rec, f)
         self.broker.publish(STATUS_TOPIC, pack_payload(
             {"edge_id": self.edge_id, "status": status}
         ))

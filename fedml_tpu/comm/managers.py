@@ -41,6 +41,7 @@ def create_comm_backend(backend: str, rank: int, size: int, args=None, **kw) -> 
 
         broker = kw.get("broker")
         store = kw.get("store")
+        owns_broker = broker is None
         if broker is None:
             broker = FileSystemBroker(
                 root=getattr(args, "mqtt_broker_dir", None) or kw.get("broker_dir")
@@ -52,6 +53,7 @@ def create_comm_backend(backend: str, rank: int, size: int, args=None, **kw) -> 
         return MqttS3CommManager(
             broker, store, rank=rank, size=size,
             run_id=str(getattr(args, "run_id", 0)),
+            owns_broker=owns_broker,  # factory-created broker dies with the manager
         )
     raise ValueError(f"unknown comm backend '{backend}'")
 

@@ -41,9 +41,11 @@ class MqttS3CommManager(BaseCommunicationManager):
         rank: int = 0,
         size: int = 1,
         run_id: str = "0",
+        owns_broker: bool = False,
     ):
         self.broker = broker
         self.store = store
+        self._owns_broker = owns_broker
         self.rank = int(rank)
         self.size = int(size)
         self.run_id = str(run_id)
@@ -126,3 +128,6 @@ class MqttS3CommManager(BaseCommunicationManager):
                 self.broker.unsubscribe(self._uplink_topic(client_id))
         else:
             self.broker.unsubscribe(self._downlink_topic(self.rank))
+        if self._owns_broker:
+            # the factory created this broker for us; stop its poller thread
+            self.broker.close()

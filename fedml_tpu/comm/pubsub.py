@@ -89,37 +89,42 @@ class FileSystemBroker(PubSubBroker):
         os.makedirs(d, exist_ok=True)
         return d
 
+    @staticmethod
+    def _next_seq(d: str) -> int:
+        seqs = [int(f[:-4]) for f in os.listdir(d) if f.endswith(".msg")]
+        return max(seqs) + 1 if seqs else 0
+
     def publish(self, topic: str, payload: bytes) -> None:
         d = self._topic_dir(topic)
-        with self._seq_lock:
-            # claim the next sequence number atomically via exclusive create;
-            # O_EXCL makes concurrent publishers (even cross-process) retry
-            # rather than overwrite
-            seq = len([f for f in os.listdir(d) if f.endswith(".msg")])
-            while True:
-                path = os.path.join(d, f"{seq:012d}.msg")
-                try:
-                    fd = os.open(path + ".tmp", os.O_CREAT | os.O_EXCL | os.O_WRONLY)
-                    break
-                except FileExistsError:
-                    seq += 1
+        # Write the complete payload to a process-unique tmp file first, then
+        # claim a sequence slot by hard-linking it to the final name: link(2)
+        # is atomic and fails if the slot is taken, so concurrent publishers
+        # (cross-process included) retry at the next seq instead of
+        # overwriting each other — and a publisher that dies before linking
+        # claims nothing, so a crash can never leave a gap that wedges the
+        # pollers' in-order dispatch.
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".pub")
         try:
             with os.fdopen(fd, "wb") as f:
                 f.write(payload)
-            os.replace(path + ".tmp", path)
+            with self._seq_lock:
+                seq = self._next_seq(d)
+                while True:
+                    path = os.path.join(d, f"{seq:012d}.msg")
+                    try:
+                        os.link(tmp, path)
+                        break
+                    except FileExistsError:
+                        seq += 1
         finally:
-            if os.path.exists(path + ".tmp"):
-                os.unlink(path + ".tmp")
+            os.unlink(tmp)
 
     def subscribe(self, topic: str, callback: Callback) -> None:
         with self._lock:
             self._subs[topic] = callback
             # new subscribers start at the topic's current head (MQTT
             # semantics: no replay of history)
-            d = self._topic_dir(topic)
-            self._cursor[topic] = len(
-                [f for f in os.listdir(d) if f.endswith(".msg")]
-            )
+            self._cursor[topic] = self._next_seq(self._topic_dir(topic))
 
     def subscribe_from_start(self, topic: str, callback: Callback) -> None:
         """Like subscribe, but replays everything already published — used by

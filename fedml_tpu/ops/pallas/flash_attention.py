@@ -262,20 +262,6 @@ def flash_attention(
     return out
 
 
-def _dense_attention(q, k, v, causal):
-    Dh = q.shape[-1]
-    scale = 1.0 / jnp.sqrt(Dh).astype(jnp.float32)
-    logits = jnp.einsum(
-        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
-    ) * scale
-    if causal:
-        T, S = logits.shape[-2], logits.shape[-1]
-        mask = jnp.tril(jnp.ones((T, S), dtype=bool))
-        logits = jnp.where(mask, logits, NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32)).astype(q.dtype)
-
-
 def _fwd(q, k, v, causal, block_q, block_k):
     interpret = jax.default_backend() != "tpu"
     out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)

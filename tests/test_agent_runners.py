@@ -124,3 +124,62 @@ def test_edge_daemon_reports_failure(tmp_path):
     assert server.wait_for_edges([3], timeout=10)[3] == "FAILED"
     edge.stop()
     broker.close()
+
+def test_edge_daemon_restart_does_not_replay_finished_jobs(tmp_path):
+    """A restarted daemon re-reads job-topic history (subscribe_from_start)
+    but must skip runs its persisted history already records as terminal."""
+    broker = FileSystemBroker(root=str(tmp_path / "broker"))
+    home = str(tmp_path / "home")
+    edge = FedMLEdgeRunner(5, broker, home_dir=home)
+    edge.start()
+    server = FedMLServerRunner(broker)
+    # a job that fails fast (missing package) still reaches a terminal state
+    server.send_training_request_to_edges(
+        run_id="done1", edge_ids=[5], package_path=str(tmp_path / "nope.zip"))
+    assert edge.wait(timeout=30)
+    edge.stop()
+
+    # restart: same home dir, fresh broker instance over the same dir
+    broker2 = FileSystemBroker(root=str(tmp_path / "broker"))
+    edge2 = FedMLEdgeRunner(5, broker2, home_dir=home)
+    calls = []
+    orig = edge2.retrieve_and_unzip_package
+    edge2.retrieve_and_unzip_package = lambda *a, **k: (calls.append(a), orig(*a, **k))[1]
+    edge2.start()
+    import time as _time
+    _time.sleep(0.5)  # let the poller replay topic history
+    assert calls == [], "restarted daemon re-executed an already-terminal job"
+    assert edge2._job_history == {"done1": "FAILED"}
+    edge2.stop()
+    broker.close()
+    broker2.close()
+
+
+def test_filesystem_broker_concurrent_publishers_no_loss(tmp_path):
+    """Racing publishers (two broker instances over one dir, many threads)
+    must never overwrite each other's sequence slots."""
+    import threading as _threading
+
+    b1 = FileSystemBroker(root=str(tmp_path / "broker"))
+    b2 = FileSystemBroker(root=str(tmp_path / "broker"))
+    got = []
+    lock = _threading.Lock()
+    b1.subscribe_from_start("t", lambda _t, p: (lock.acquire(), got.append(p), lock.release()))
+
+    def blast(b, tag):
+        for i in range(25):
+            b.publish("t", f"{tag}:{i}".encode())
+
+    threads = [_threading.Thread(target=blast, args=(b, tag))
+               for b, tag in ((b1, "a"), (b2, "b"), (b1, "c"), (b2, "d"))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    deadline = __import__("time").time() + 10
+    while len(got) < 100 and __import__("time").time() < deadline:
+        __import__("time").sleep(0.05)
+    assert len(got) == 100, f"lost {100 - len(got)} messages to publisher races"
+    assert len(set(got)) == 100
+    b1.close()
+    b2.close()
