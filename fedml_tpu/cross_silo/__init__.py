@@ -8,12 +8,14 @@ DDP).
 
 from .aggregator import FedMLAggregator
 from .client_manager import FedMLClientManager
+from .hierarchical import ClientMasterManager, ClientSlaveManager, SlaveSync
 from .horizontal_api import (
     Client,
     FedML_Horizontal,
     HierarchicalClient,
     HierarchicalServer,
     Server,
+    assemble_silo,
 )
 from .message_define import MyMessage
 from .server_manager import FedMLServerManager
@@ -22,5 +24,6 @@ from .trainer import FedMLTrainer
 __all__ = [
     "FedMLAggregator", "FedMLClientManager", "FedMLServerManager", "FedMLTrainer",
     "FedML_Horizontal", "Server", "Client", "HierarchicalServer", "HierarchicalClient",
+    "ClientMasterManager", "ClientSlaveManager", "SlaveSync", "assemble_silo",
     "MyMessage",
 ]

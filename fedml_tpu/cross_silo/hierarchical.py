@@ -1,0 +1,112 @@
+"""Hierarchical cross-silo: a silo spanning multiple processes/hosts.
+
+Parity: reference ``cross_silo/hierarchical/`` — ``ClientMasterManager``
+(process 0 of the silo talks to the FL server and broadcasts
+``[round_idx, model, client_index]`` to silo slaves via
+``dist.broadcast_object_list``, ``client_slave_manager.py:39
+await_sync_process_group``), ``ProcessGroupManager`` (``dist.init_process_group``)
+and the pdsh/torchrun launcher (``dist_trainer_launcher.py:23``).
+
+Redesign: the process group is ``jax.distributed`` (coordinator service, see
+``parallel/mesh.py:maybe_initialize_distributed`` + ``scripts/
+launch_multihost.sh``); the per-round master→slave sync is
+``multihost_utils.broadcast_one_to_all`` (an XLA collective, riding ICI/DCN
+instead of a gloo TCP ring); and DDP dissolves entirely — every silo process
+enters the same jitted ``local_update`` whose batch axis is sharded over a
+``Mesh`` that spans the processes, so the gradient all-reduce is a psum XLA
+inserts, not a DDP hook.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+import numpy as np
+
+from .client_manager import FedMLClientManager
+
+FINISH_SENTINEL = -1
+
+
+class SlaveSync:
+    """Master→slave round synchronization over the jax.distributed world.
+
+    The broadcast payload is ``(round_idx, client_index, params)`` — exactly
+    the ``[round_idx, model_params, client_index]`` object list the reference
+    broadcasts into the silo process group (``client_slave_manager.py:39``).
+    All processes must construct this with the same pytree structure
+    (slaves pass their own init params as the template).
+    """
+
+    def __init__(self, params_template):
+        self._template = params_template
+
+    def broadcast_round(self, round_idx: int, client_index: int, params):
+        from jax.experimental import multihost_utils
+
+        payload = (np.int64(round_idx), np.int64(client_index), params)
+        return multihost_utils.broadcast_one_to_all(payload)
+
+    def await_round(self):
+        """Slave side: blocks until the master reaches its broadcast."""
+        from jax.experimental import multihost_utils
+
+        payload = (np.int64(0), np.int64(0), self._template)
+        round_idx, client_index, params = multihost_utils.broadcast_one_to_all(
+            payload
+        )
+        return int(round_idx), int(client_index), params
+
+    def broadcast_finish(self):
+        self.broadcast_round(FINISH_SENTINEL, 0, self._template)
+
+
+class ClientMasterManager(FedMLClientManager):
+    """Process 0 of a multi-process silo: speaks the WAN FL protocol AND
+    leads the silo's collective training (reference
+    ``client_master_manager.py``)."""
+
+    def __init__(self, *a, slave_sync: Optional[SlaveSync] = None, **kw):
+        super().__init__(*a, **kw)
+        self.slave_sync = slave_sync
+
+    def _train(self) -> None:
+        if self.slave_sync is not None:
+            self.slave_sync.broadcast_round(
+                self.round_idx, self.trainer.client_index,
+                self.trainer.model_params,
+            )
+        super()._train()
+
+    def finish(self) -> None:
+        if self.slave_sync is not None:
+            self.slave_sync.broadcast_finish()
+        super().finish()
+
+
+class ClientSlaveManager:
+    """Silo processes 1..P-1: no WAN connection — they follow the master's
+    broadcasts and co-execute the collective local update (reference
+    ``client_slave_manager.py``: ``await_sync_process_group`` then train)."""
+
+    def __init__(self, trainer):
+        self.trainer = trainer
+        self._sync = SlaveSync(trainer.model_params)
+
+    @property
+    def slave_sync(self) -> SlaveSync:
+        return self._sync
+
+    def run(self) -> None:
+        while True:
+            round_idx, client_index, params = self._sync.await_round()
+            if round_idx == FINISH_SENTINEL:
+                logging.info("silo slave %d: finish", jax.process_index())
+                return
+            self.trainer.update_model(params)
+            self.trainer.update_dataset(client_index)
+            # same jitted program as the master — the batch axis is sharded
+            # over the silo mesh, so this call IS the collective step
+            self.trainer.train(round_idx)

@@ -24,6 +24,13 @@ from .server_manager import FedMLServerManager
 from .trainer import FedMLTrainer
 
 
+def assemble_silo(args, mesh=None):
+    """Load data, build the model + compiled local_update for one silo.
+    Public so multi-process workers can assemble once and wire the pieces
+    into both server and trainer actors themselves."""
+    return _assemble(args, mesh)
+
+
 def _assemble(args, mesh=None):
     fed_data, output_dim = data_mod.load(args)
     model = models_mod.create(args, output_dim)

@@ -46,8 +46,10 @@ class FedMLTrainer:
         self.args = args
         self.mesh = mesh
         self.batch_size = int(getattr(args, "batch_size", 32))
+        self._batch_sh = None
         if mesh is not None:
             batch_sh = shard_along(mesh, AXIS_DATA, 1)  # (NB, BS, ...) -> shard BS
+            self._batch_sh = batch_sh
             rep = replicated(mesh)
             self._local_update = jax.jit(
                 local_update,
@@ -78,11 +80,21 @@ class FedMLTrainer:
             [self.client_index], bs, num_batches=None, rng=self._pack_rng
         )
         data = {
-            "x": jnp.asarray(batches.x[0]),
-            "y": jnp.asarray(batches.y[0]),
-            "mask": jnp.asarray(batches.mask[0]),
-            "num_samples": jnp.asarray(batches.num_samples[0]),
+            "x": np.asarray(batches.x[0]),
+            "y": np.asarray(batches.y[0]),
+            "mask": np.asarray(batches.mask[0]),
+            "num_samples": np.asarray(batches.num_samples[0]),
         }
+        if self.mesh is not None and jax.process_count() > 1:
+            # multi-process silo: every process packs the identical global
+            # batch (same files, same rng), so assemble sharded jax.Arrays
+            # from it — jit rejects plain numpy for cross-process shardings
+            sh = self._batch_sh
+            for key in ("x", "y", "mask"):
+                arr = data[key]
+                data[key] = jax.make_array_from_callback(
+                    arr.shape, sh, lambda idx, a=arr: a[idx]
+                )
         self._rng, step_rng = jax.random.split(self._rng)
         out = self._local_update(self.model_params, (), data, step_rng)
         weights_np = jax.tree.map(np.asarray, out.update)

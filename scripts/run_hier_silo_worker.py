@@ -1,0 +1,100 @@
+"""One process of a multi-process hierarchical cross-silo silo.
+
+Launch P of these (one per host/process; see ``scripts/launch_multihost.sh``)
+with JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID set.
+Process 0 runs the FL server plus the silo's ClientMasterManager; processes
+1..P-1 run ClientSlaveManager. The silo's local update is one jitted program
+whose batch axis is sharded over a Mesh spanning every process.
+
+Parity: reference ``cross_silo/hierarchical/dist_trainer_launcher.py:23``
+(pdsh+torchrun entry) and the master/slave managers it launches.
+
+Usage: python scripts/run_hier_silo_worker.py --out OUT.json [--rounds N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import threading
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--rounds", type=int, default=2)
+    opts = ap.parse_args()
+
+    import jax
+
+    import fedml_tpu
+    from fedml_tpu.cross_silo import (
+        ClientMasterManager,
+        ClientSlaveManager,
+        FedMLAggregator,
+        FedMLServerManager,
+        FedMLTrainer,
+        SlaveSync,
+        assemble_silo,
+    )
+    from fedml_tpu.parallel import AXIS_DATA, MeshConfig, create_mesh
+
+    args = fedml_tpu.init(config=dict(
+        dataset="mnist", model="lr", debug_small_data=True,
+        client_num_in_total=1, client_num_per_round=1, comm_round=opts.rounds,
+        learning_rate=0.1, epochs=1, batch_size=16,
+        frequency_of_the_test=1, random_seed=0,
+    ))
+    n_dev = len(jax.devices())
+    assert jax.process_count() > 1, "this worker expects a jax.distributed world"
+    mesh = create_mesh(MeshConfig(axes=((AXIS_DATA, n_dev),)),
+                       devices=jax.devices())
+
+    # assemble ONCE; both the server actor (proc 0) and the trainer share it
+    fed_data, variables, apply_fn, local_update = assemble_silo(args)
+    trainer = FedMLTrainer(
+        client_index=0, fed_data=fed_data, model_params=variables,
+        local_update=local_update, args=args, mesh=mesh,
+    )
+
+    if jax.process_index() == 0:
+        from fedml_tpu.comm import LoopbackHub
+
+        hub = LoopbackHub()
+        aggregator = FedMLAggregator(
+            fed_data.test_data_global, fed_data.train_data_global,
+            fed_data.train_data_num, 1, args, variables, apply_fn=apply_fn,
+        )
+        server = FedMLServerManager(
+            args, aggregator, rank=0, client_num=1, backend="LOOPBACK", hub=hub,
+        )
+        master = ClientMasterManager(
+            args, trainer, rank=1, size=2, backend="LOOPBACK", hub=hub,
+            slave_sync=SlaveSync(variables),
+        )
+        t = threading.Thread(target=master.run, daemon=True)
+        t.start()
+        server.start()
+        server.run()
+        t.join(timeout=120)
+        with open(opts.out, "w") as f:
+            json.dump({
+                "history": server.history,
+                "process_count": jax.process_count(),
+                "global_devices": n_dev,
+                "local_devices": len(jax.local_devices()),
+            }, f)
+    else:
+        slave = ClientSlaveManager(trainer)
+        slave.run()
+        with open(opts.out, "w") as f:
+            json.dump({
+                "process_count": jax.process_count(),
+                "global_devices": n_dev,
+                "local_devices": len(jax.local_devices()),
+                "slave": True,
+            }, f)
+
+
+if __name__ == "__main__":
+    main()
