@@ -75,6 +75,70 @@ def dp_schedule(
     return assignment, costs
 
 
+def bucket_schedule(
+    batch_counts: Sequence[int],
+    axis: int,
+    max_buckets: int = 4,
+) -> List[Tuple[np.ndarray, int]]:
+    """Group cohort positions into width-buckets minimizing padded compute.
+
+    The compiled round step is rectangular: every client slot costs
+    ``width`` batches regardless of its true batch count, and slot counts
+    pad up to a multiple of the mesh client axis. Splitting a skewed cohort
+    into a few width-classes (each compiled once — widths are cohort maxima,
+    so at most ``max_buckets`` distinct shapes) trades a handful of extra
+    XLA programs for dropping the padding waste.
+
+    Exact dynamic program over the sorted counts (the honest successor of
+    the reference's branch-and-bound ``DP_schedule``,
+    ``core/schedule/scheduler.py:110``): cost of a contiguous sorted group
+    = padded_slots(group) * max_count(group); minimize the total over at
+    most ``max_buckets`` groups.
+
+    Returns: list of (positions, width) — positions index into
+    ``batch_counts``; widths ascending.
+    """
+    counts = np.asarray(batch_counts, dtype=np.int64)
+    n = len(counts)
+    axis = max(1, int(axis))
+    if n == 0:
+        return []
+    order = np.argsort(counts, kind="stable")
+    sc = counts[order]
+
+    B = max(1, min(int(max_buckets), n))
+    INF = np.inf
+    # f[b][j] = min cost of first j sorted clients using <= b groups;
+    # inner minimization vectorized over the split point i (this runs on the
+    # per-round hot path, so no O(n^2) pure-Python loops)
+    i_idx = np.arange(n)  # candidate split starts
+    f_prev = np.full(n + 1, INF)
+    f_prev[0] = 0.0
+    back = np.zeros((B + 1, n + 1), dtype=np.int64)
+    for b in range(1, B + 1):
+        f_cur = np.full(n + 1, INF)
+        f_cur[0] = 0.0
+        for j in range(1, n + 1):
+            # group [i, j) padded to a multiple of axis, at width sc[j-1]
+            k = j - i_idx[:j]
+            cand = f_prev[:j] + (-(-k // axis)) * axis * int(sc[j - 1])
+            arg = int(np.argmin(cand))
+            f_cur[j] = cand[arg]
+            back[b][j] = arg
+        f_prev = f_cur
+    # reconstruct
+    cuts = []
+    j, b = n, B
+    while j > 0:
+        i = int(back[b][j])
+        cuts.append((i, j))
+        j, b = i, b - 1
+    cuts.reverse()
+    return [
+        (order[i:j].astype(np.int64), int(sc[j - 1])) for i, j in cuts if j > i
+    ]
+
+
 def even_client_schedule(client_indexes: Sequence[int], n_shards: int) -> List[np.ndarray]:
     """np.array_split semantics of the reference NCCL simulator's
     ``client_schedule`` (``nccl/base_framework/Server.py:109``): contiguous

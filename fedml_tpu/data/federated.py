@@ -96,12 +96,16 @@ class FederatedData:
         batch_size: int,
         num_batches: int | None = None,
         rng: np.random.Generator | None = None,
+        perms: Sequence[np.ndarray] | None = None,
     ) -> ClientIndexBatches:
         """Index-only counterpart of ``pack_clients`` (device-resident path).
 
         Consumes ``rng`` identically to ``pack_clients`` (one permutation per
         client, in cohort order) so a run is bit-reproducible whichever path
-        packs a given round.
+        packs a given round. ``perms`` (one permutation per client) overrides
+        ``rng`` — callers that pack the same cohort in different orders (the
+        bucketed schedule) pass per-client-seeded permutations so the shuffle
+        is independent of packing order.
         """
         assert self._global_index is not None
         idx_lists = [self._global_index[c] for c in client_ids]
@@ -114,10 +118,12 @@ class FederatedData:
         mask = np.zeros((C, cap), dtype=np.float32)
         for i, ix in enumerate(idx_lists):
             n = min(len(ix), cap)
-            order = (
-                rng.permutation(len(ix))[:n] if rng is not None
-                else np.arange(n)
-            )
+            if perms is not None:
+                order = np.asarray(perms[i])[:n]
+            elif rng is not None:
+                order = rng.permutation(len(ix))[:n]
+            else:
+                order = np.arange(n)
             idx[i, :n] = ix[order]
             mask[i, :n] = 1.0
         shape = (C, num_batches, batch_size)
@@ -134,12 +140,15 @@ class FederatedData:
         num_batches: int | None = None,
         drop_remainder: bool = False,
         rng: np.random.Generator | None = None,
+        perms: Sequence[np.ndarray] | None = None,
     ) -> ClientBatches:
         """Pad/stack the given clients' train data into a rectangle.
 
         ``num_batches`` defaults to ceil(max_client_samples / batch_size);
         smaller clients are padded with zero rows and mask 0. If ``rng`` is
-        given each client's samples are shuffled first (local-epoch shuffle).
+        given each client's samples are shuffled first (local-epoch shuffle);
+        ``perms`` (one permutation per client) overrides ``rng`` for
+        packing-order-independent shuffles.
         """
         pairs = [self.train_data_local_dict[c] for c in client_ids]
         sizes = np.asarray([len(p) for p in pairs], dtype=np.int32)
@@ -154,8 +163,9 @@ class FederatedData:
         label_shape = pairs[0].y.shape[1:]  # () scalar labels, (T,) per-token
         C = len(pairs)
         new_shape = (C, num_batches, batch_size)
-        perms = None
-        if rng is not None:
+        if perms is not None:
+            perms = [np.asarray(p) for p in perms]
+        elif rng is not None:
             perms = [rng.permutation(len(p)) for p in pairs]
 
         # fast path: fused native shuffle+gather+pad over the global arrays

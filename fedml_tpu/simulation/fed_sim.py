@@ -77,6 +77,44 @@ class SimConfig:
     # arrays diverge from the global ones (poisoned clients).
     device_data: bool = True
     device_data_max_bytes: int = 4 << 30
+    # cohort scheduling (reference core/schedule/scheduler.py role):
+    # "even"     — one rectangular program, every client padded to the
+    #              cohort-max batch count (fastest for uniform cohorts);
+    # "bucketed" — split the cohort into width-classes via the exact DP in
+    #              core.scheduler.bucket_schedule and run one partial-agg
+    #              program per class: skewed cohorts stop paying the
+    #              max-width padding for every small client.
+    cohort_schedule: str = "even"
+    max_width_buckets: int = 4
+
+
+def _gather_from_device(data: Dict[str, Any], x_all, y_all) -> Dict[str, Any]:
+    """Device-resident data path: replace the cohort's index rectangle with
+    x/y gathered from the HBM-resident global arrays, zeroing padded rows
+    (padded rows gather index 0; zeroing keeps both packing paths feeding
+    identical batches — BatchNorm statistics see every row, masked or not)."""
+    idx = data.pop("idx")
+    m = data["mask"]
+
+    def _masked(gathered):
+        mb = m.reshape(m.shape + (1,) * (gathered.ndim - m.ndim))
+        return gathered * mb.astype(gathered.dtype)
+
+    data["x"] = _masked(x_all[idx])
+    data["y"] = _masked(y_all[idx])
+    return data
+
+
+def _cohort_outputs(alg: FedAlgorithm, params, cohort, client_states, rng):
+    """vmap the algorithm's local_update over the cohort; each client's RNG
+    stream is keyed by its global cohort position ("pos") so any schedule
+    that reorders clients (bucketed) draws identical randomness."""
+    data = dict(cohort)
+    pos = data.pop("pos")
+    rngs = jax.vmap(lambda p: jax.random.fold_in(rng, p))(pos)
+    return jax.vmap(alg.local_update, in_axes=(None, 0, 0, 0))(
+        params, client_states, data, rngs
+    )
 
 
 class FedSimulator:
@@ -119,9 +157,29 @@ class FedSimulator:
             and (train.x.nbytes + train.y.nbytes) <= cfg.device_data_max_bytes
         )
         if self._use_device_data:
-            self._x_dev = jnp.asarray(train.x)
-            self._y_dev = jnp.asarray(train.y)
+            if mesh is not None:
+                # replicate over the mesh ONCE here — a single-device array
+                # would be re-replicated (full copy) on every step call
+                self._x_dev = jax.device_put(train.x, replicated(mesh))
+                self._y_dev = jax.device_put(train.y, replicated(mesh))
+            else:
+                self._x_dev = jnp.asarray(train.x)
+                self._y_dev = jnp.asarray(train.y)
+        self._axis_size = 1 if mesh is None else int(mesh.shape[AXIS_CLIENT])
+        self._batch_counts = {
+            c: max(1, -(-len(v) // cfg.batch_size))
+            for c, v in fed_data.train_data_local_dict.items()
+        }
+        # bucketed partial aggregation needs the plain weighted mean; custom
+        # aggregates (median/trimmed...) see the full stacked cohort only in
+        # the even path
+        self._bucketed = (
+            cfg.cohort_schedule == "bucketed" and algorithm.aggregate is None
+        )
         self._round_step = self._build_round_step()
+        if self._bucketed:
+            self._partial_step = self._build_partial_step()
+            self._finalize_step = self._build_finalize_step()
 
     # --- compiled pieces ---------------------------------------------------
 
@@ -129,11 +187,7 @@ class FedSimulator:
         alg = self.alg
 
         def round_body(params, server_state, cohort, client_states, rng):
-            C = cohort["num_samples"].shape[0]
-            rngs = jax.random.split(rng, C)
-            outs = jax.vmap(alg.local_update, in_axes=(None, 0, 0, 0))(
-                params, client_states, cohort, rngs
-            )
+            outs = _cohort_outputs(alg, params, cohort, client_states, rng)
             # weighted mean in f32 (reference pre-scale trick, LocalAggregator.py:84)
             w = outs.weight.astype(jnp.float32)
             total = jnp.maximum(w.sum(), 1.0)
@@ -151,24 +205,11 @@ class FedSimulator:
             return new_params, new_server_state, outs.state, metrics
 
         if self._use_device_data:
-            # device-resident path: the cohort carries only an index rectangle;
-            # x/y are gathered from the HBM-resident global arrays inside the
-            # compiled step (host->device per round = a few KB of indices)
+            # device-resident path: the cohort carries only an index
+            # rectangle (host->device per round = a few KB of indices)
             def round_step(params, server_state, cohort, client_states, rng,
                            x_all, y_all):
-                data = dict(cohort)
-                idx = data.pop("idx")
-                m = data["mask"]
-
-                def _masked(gathered):
-                    # padded rows gather index 0; zero them so both packing
-                    # paths feed identical batches (BatchNorm statistics see
-                    # every row, masked or not)
-                    mb = m.reshape(m.shape + (1,) * (gathered.ndim - m.ndim))
-                    return gathered * mb.astype(gathered.dtype)
-
-                data["x"] = _masked(x_all[idx])
-                data["y"] = _masked(y_all[idx])
+                data = _gather_from_device(dict(cohort), x_all, y_all)
                 return round_body(params, server_state, data, client_states, rng)
         else:
             round_step = round_body
@@ -187,6 +228,62 @@ class FedSimulator:
                 donate_argnums=(0, 1),
             )
         return jax.jit(round_step, donate_argnums=(0, 1))
+
+    def _build_partial_step(self) -> Callable:
+        """One width-bucket's local training + weighted partial sums (f32).
+        Compiled once per distinct (slots, width) shape — the bucket
+        scheduler bounds those to ``max_width_buckets`` per cohort."""
+        alg = self.alg
+
+        def partial_body(params, cohort, client_states, rng):
+            outs = _cohort_outputs(alg, params, cohort, client_states, rng)
+            w = outs.weight.astype(jnp.float32)
+            sum_wu = jax.tree.map(
+                lambda u: jnp.tensordot(w, u.astype(jnp.float32), axes=(0, 0)),
+                outs.update,
+            )
+            return sum_wu, w.sum(), outs.state, outs.metrics
+
+        if self._use_device_data:
+            def partial_step(params, cohort, client_states, rng, x_all, y_all):
+                data = _gather_from_device(dict(cohort), x_all, y_all)
+                return partial_body(params, data, client_states, rng)
+        else:
+            partial_step = partial_body
+
+        n_extra = 2 if self._use_device_data else 0
+        if self.mesh is not None:
+            cohort_sh = shard_along(self.mesh, AXIS_CLIENT, 0)
+            rep = replicated(self.mesh)
+            return jax.jit(
+                partial_step,
+                in_shardings=(rep, cohort_sh, cohort_sh, rep) + (rep,) * n_extra,
+                out_shardings=(rep, rep, cohort_sh, cohort_sh),
+            )
+        return jax.jit(partial_step)
+
+    def _build_finalize_step(self) -> Callable:
+        """Combine bucket partial sums into the weighted mean + server update.
+        Requires the update pytree to mirror the params pytree (true for the
+        mean-aggregating algorithms bucketing supports)."""
+        alg = self.alg
+
+        def finalize(params, server_state, sum_wu, total_w):
+            total = jnp.maximum(total_w, 1.0)
+            agg = jax.tree.map(
+                lambda s, p: (s / total).astype(p.dtype), sum_wu, params
+            )
+            return alg.server_update(params, agg, server_state)
+
+        if self.mesh is not None:
+            rep = replicated(self.mesh)
+            return jax.jit(
+                finalize,
+                in_shardings=(rep, rep, rep, rep),
+                out_shardings=(rep, rep),
+                donate_argnums=(0, 1),
+            )
+        return jax.jit(finalize, donate_argnums=(0, 1))
 
     def _build_eval(self, apply_fn):
         eval_fn = make_eval_fn(apply_fn)
@@ -229,9 +326,7 @@ class FedSimulator:
         base_rng = jax.random.PRNGKey(cfg.seed)
         start_round, ckpt = 0, None
         if cfg.checkpoint_dir:
-            from ..utils.checkpoint import (
-                CheckpointManager, restore_simulator_state, save_simulator_state,
-            )
+            from ..utils.checkpoint import CheckpointManager, restore_simulator_state
 
             ckpt = CheckpointManager(cfg.checkpoint_dir)
             if cfg.resume and ckpt.latest_step() is not None:
@@ -246,28 +341,53 @@ class FedSimulator:
             # round-indexed RNG streams: resume at round k reproduces an
             # uninterrupted run exactly
             pack_rng = np.random.default_rng([cfg.seed, round_idx])
-            if self._use_device_data:
-                packed = self.fed.pack_client_index(
-                    client_ids, cfg.batch_size, self.num_local_batches, rng=pack_rng
-                )
-                payload = {"idx": packed.idx}
-            else:
-                packed = self.fed.pack_clients(
-                    client_ids, cfg.batch_size, self.num_local_batches, rng=pack_rng
-                )
-                payload = {"x": packed.x, "y": packed.y}
-            mask_np, samples_np = packed.mask, packed.num_samples
+            step_rng = jax.random.fold_in(base_rng, round_idx)
+            # drop mask is drawn FIRST (before any packing) and the
+            # per-client shuffle comes from per-client-seeded generators, so
+            # the even and bucketed schedules consume identical randomness
+            # whatever order they pack clients in
+            drop = None
             if cfg.client_dropout_rate > 0.0:
                 drop = pack_rng.random(len(client_ids)) < cfg.client_dropout_rate
                 if drop.all():
                     drop[0] = False  # a round needs at least one survivor
+            if self._bucketed:
+                metrics = self._run_bucketed_round(
+                    np.asarray(client_ids), round_idx, drop, step_rng
+                )
+                rec = {
+                    "round": round_idx,
+                    "round_time": time.perf_counter() - t0,
+                    "train_loss": float(np.mean(metrics["train_loss"])),
+                    "train_acc": float(
+                        np.sum(metrics["train_correct"])
+                        / max(float(np.sum(metrics["train_valid"])), 1.0)
+                    ),
+                }
+                self._post_round(rec, round_idx, apply_fn, ckpt, log_fn)
+                continue
+            perms = self._client_perms(client_ids, round_idx)
+            if self._use_device_data:
+                packed = self.fed.pack_client_index(
+                    client_ids, cfg.batch_size, self.num_local_batches,
+                    perms=perms,
+                )
+                payload = {"idx": packed.idx}
+            else:
+                packed = self.fed.pack_clients(
+                    client_ids, cfg.batch_size, self.num_local_batches,
+                    perms=perms,
+                )
+                payload = {"x": packed.x, "y": packed.y}
+            mask_np, samples_np = packed.mask, packed.num_samples
+            if drop is not None:
                 mask_np = mask_np * (~drop)[:, None, None]
                 samples_np = samples_np * (~drop)
             cohort = {k: jnp.asarray(v) for k, v in payload.items()}
             cohort["mask"] = jnp.asarray(mask_np)
             cohort["num_samples"] = jnp.asarray(samples_np)
+            cohort["pos"] = jnp.arange(len(client_ids), dtype=jnp.uint32)
             states = self._cohort_states(client_ids)
-            step_rng = jax.random.fold_in(base_rng, round_idx)
             step_args = (self.params, self.server_state, cohort, states, step_rng)
             if self._use_device_data:
                 step_args += (self._x_dev, self._y_dev)
@@ -283,24 +403,119 @@ class FedSimulator:
                     metrics["train_correct"].sum() / max(float(metrics["train_valid"].sum()), 1.0)
                 ),
             }
-            if apply_fn is not None and (
-                round_idx % cfg.frequency_of_the_test == 0 or round_idx == cfg.comm_round - 1
-            ):
-                rec.update(self.evaluate(apply_fn))
-            self.history.append(rec)
-            if ckpt is not None and (
-                (round_idx + 1) % cfg.checkpoint_frequency == 0
-                or round_idx == cfg.comm_round - 1
-            ):
-                save_simulator_state(ckpt, self, round_idx)
-            if log_fn:
-                log_fn(f"[round {round_idx}] " + " ".join(
-                    f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}"
-                    for k, v in rec.items() if k != "round"
-                ))
+            self._post_round(rec, round_idx, apply_fn, ckpt, log_fn)
+        # drain the async dispatch queue: per-round host reads (metric
+        # scalars) can complete before the executables fully retire, so
+        # without this the caller's wall-clock over run() — and the last
+        # rounds' attribution — would under-count device work still in flight
+        jax.block_until_ready(self.params)
         if ckpt is not None:
             ckpt.close()
         return self.history
+
+    def _post_round(self, rec, round_idx, apply_fn, ckpt, log_fn) -> None:
+        cfg = self.cfg
+        if apply_fn is not None and (
+            round_idx % cfg.frequency_of_the_test == 0 or round_idx == cfg.comm_round - 1
+        ):
+            rec.update(self.evaluate(apply_fn))
+        self.history.append(rec)
+        if ckpt is not None and (
+            (round_idx + 1) % cfg.checkpoint_frequency == 0
+            or round_idx == cfg.comm_round - 1
+        ):
+            from ..utils.checkpoint import save_simulator_state
+
+            save_simulator_state(ckpt, self, round_idx)
+        if log_fn:
+            log_fn(f"[round {round_idx}] " + " ".join(
+                f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}"
+                for k, v in rec.items() if k != "round"
+            ))
+
+    def _client_perms(self, client_ids, round_idx: int):
+        """Per-client local-epoch shuffles, seeded by (run seed, round,
+        client id) — identical whichever order/schedule packs the cohort."""
+        return [
+            np.random.default_rng(
+                [self.cfg.seed, round_idx, int(c)]
+            ).permutation(len(self.fed.train_data_local_dict[int(c)]))
+            for c in client_ids
+        ]
+
+    def _run_bucketed_round(self, client_ids: np.ndarray, round_idx: int,
+                            drop, step_rng):
+        """Width-bucketed cohort execution (SimConfig.cohort_schedule doc):
+        one partial-aggregation program per width-class, a single finalize.
+        Numerically the same weighted mean as the even path (per-client RNG
+        and shuffles keyed by cohort position / client id, f32 partial
+        sums), modulo fp summation order."""
+        from ..core.scheduler import bucket_schedule
+
+        cfg = self.cfg
+        counts = [
+            min(self._batch_counts[int(c)], self.num_local_batches)
+            for c in client_ids
+        ]
+        buckets = bucket_schedule(counts, self._axis_size, cfg.max_width_buckets)
+        sum_wu = None
+        total_w = None
+        metrics_parts: Dict[str, List[np.ndarray]] = {}
+        for positions, width in buckets:
+            ids = client_ids[positions]
+            n_real = len(ids)
+            slots = -(-n_real // self._axis_size) * self._axis_size
+            pad = slots - n_real
+            if pad:
+                ids = np.concatenate([ids, np.repeat(ids[-1], pad)])
+                positions = np.concatenate(
+                    [positions, np.repeat(positions[-1], pad)]
+                )
+            perms = self._client_perms(ids, round_idx)
+            if self._use_device_data:
+                packed = self.fed.pack_client_index(
+                    ids, cfg.batch_size, width, perms=perms
+                )
+                payload = {"idx": packed.idx}
+            else:
+                packed = self.fed.pack_clients(
+                    ids, cfg.batch_size, width, perms=perms
+                )
+                payload = {"x": packed.x, "y": packed.y}
+            mask_np, samples_np = packed.mask, packed.num_samples
+            if pad:
+                mask_np = mask_np.copy()
+                samples_np = samples_np.copy()
+                mask_np[n_real:] = 0
+                samples_np[n_real:] = 0
+            if drop is not None:
+                d = drop[positions[:n_real]]
+                mask_np = mask_np.copy()
+                samples_np = samples_np.copy()
+                mask_np[:n_real] *= (~d)[:, None, None]
+                samples_np[:n_real] *= ~d
+            cohort = {k: jnp.asarray(v) for k, v in payload.items()}
+            cohort["mask"] = jnp.asarray(mask_np)
+            cohort["num_samples"] = jnp.asarray(samples_np)
+            cohort["pos"] = jnp.asarray(positions.astype(np.uint32))
+            states = self._cohort_states(ids)
+            step_args = (self.params, cohort, states, step_rng)
+            if self._use_device_data:
+                step_args += (self._x_dev, self._y_dev)
+            swu, sw, new_states, mets = self._partial_step(*step_args)
+            sum_wu = swu if sum_wu is None else jax.tree.map(jnp.add, sum_wu, swu)
+            total_w = sw if total_w is None else total_w + sw
+            if new_states != ():
+                self._store_states(
+                    ids[:n_real],
+                    jax.tree.map(lambda x: x[:n_real], new_states),
+                )
+            for k, v in mets.items():
+                metrics_parts.setdefault(k, []).append(np.asarray(v)[:n_real])
+        self.params, self.server_state = self._finalize_step(
+            self.params, self.server_state, sum_wu, total_w
+        )
+        return {k: np.concatenate(v) for k, v in metrics_parts.items()}
 
     def evaluate(self, apply_fn) -> Dict[str, float]:
         if self._eval_fn is None:
