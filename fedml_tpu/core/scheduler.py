@@ -79,6 +79,7 @@ def bucket_schedule(
     batch_counts: Sequence[int],
     axis: int,
     max_buckets: int = 4,
+    max_width: int | None = None,
 ) -> List[Tuple[np.ndarray, int]]:
     """Group cohort positions into width-buckets minimizing padded compute.
 
@@ -92,11 +93,13 @@ def bucket_schedule(
     Exact dynamic program over the sorted counts (the honest successor of
     the reference's branch-and-bound ``DP_schedule``,
     ``core/schedule/scheduler.py:110``): cost of a contiguous sorted group
-    = padded_slots(group) * max_count(group); minimize the total over at
-    most ``max_buckets`` groups.
+    = padded_slots(group) * width(group); minimize the total over at most
+    ``max_buckets`` groups. Widths are rounded UP to powers of two so the
+    per-(slots, width) compiled programs converge to a handful of shapes
+    across rounds with varying cohorts instead of recompiling every round.
 
     Returns: list of (positions, width) — positions index into
-    ``batch_counts``; widths ascending.
+    ``batch_counts``; widths ascending powers of two.
     """
     counts = np.asarray(batch_counts, dtype=np.int64)
     n = len(counts)
@@ -104,7 +107,13 @@ def bucket_schedule(
     if n == 0:
         return []
     order = np.argsort(counts, kind="stable")
-    sc = counts[order]
+    # quantize each client's width requirement up to a power of two; the DP
+    # then groups on the quantized ladder (a group's width = its max).
+    # max_width caps the ladder (callers pass their per-client batch cap so
+    # quantization never raises a client's effective training budget).
+    sc = 1 << np.ceil(np.log2(np.maximum(counts[order], 1))).astype(np.int64)
+    if max_width is not None:
+        sc = np.minimum(sc, int(max_width))
 
     B = max(1, min(int(max_buckets), n))
     INF = np.inf
@@ -119,9 +128,12 @@ def bucket_schedule(
         f_cur = np.full(n + 1, INF)
         f_cur[0] = 0.0
         for j in range(1, n + 1):
-            # group [i, j) padded to a multiple of axis, at width sc[j-1]
+            # group [i, j) at width sc[j-1]; slot count mirrors execution:
+            # ceil(k/axis) rounded UP to a power of two, times axis
             k = j - i_idx[:j]
-            cand = f_prev[:j] + (-(-k // axis)) * axis * int(sc[j - 1])
+            per_axis = -(-k // axis)
+            per_axis = (2 ** np.ceil(np.log2(np.maximum(per_axis, 1)))).astype(np.int64)
+            cand = f_prev[:j] + per_axis * axis * int(sc[j - 1])
             arg = int(np.argmin(cand))
             f_cur[j] = cand[arg]
             back[b][j] = arg

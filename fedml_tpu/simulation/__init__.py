@@ -77,7 +77,7 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
         checkpoint_frequency=int(getattr(args, "checkpoint_frequency", 10)),
         resume=bool(getattr(args, "resume", True)),
         client_dropout_rate=float(getattr(args, "client_dropout_rate", 0.0)),
-        cohort_schedule=str(getattr(args, "cohort_schedule", "even")),
+        cohort_schedule=str(getattr(args, "cohort_schedule", "auto")),
         max_width_buckets=int(getattr(args, "max_width_buckets", 4)),
     )
 

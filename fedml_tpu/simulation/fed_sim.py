@@ -83,8 +83,13 @@ class SimConfig:
     # "bucketed" — split the cohort into width-classes via the exact DP in
     #              core.scheduler.bucket_schedule and run one partial-agg
     #              program per class: skewed cohorts stop paying the
-    #              max-width padding for every small client.
-    cohort_schedule: str = "even"
+    #              max-width padding for every small client (a Dirichlet
+    #              CIFAR cohort averages ~8 batches/client but pads to the
+    #              ~24-batch max — a 3x compute waste bucketing removes);
+    # "auto"     — bucketed when the dataset's client sizes are skewed
+    #              (max >= 2x median) and the algorithm mean-aggregates,
+    #              else even.
+    cohort_schedule: str = "auto"
     max_width_buckets: int = 4
 
 
@@ -173,9 +178,12 @@ class FedSimulator:
         # bucketed partial aggregation needs the plain weighted mean; custom
         # aggregates (median/trimmed...) see the full stacked cohort only in
         # the even path
-        self._bucketed = (
-            cfg.cohort_schedule == "bucketed" and algorithm.aggregate is None
-        )
+        schedule = cfg.cohort_schedule
+        if schedule == "auto":
+            counts = np.asarray(list(self._batch_counts.values()))
+            skewed = counts.max() >= 2 * max(np.median(counts), 1)
+            schedule = "bucketed" if skewed else "even"
+        self._bucketed = schedule == "bucketed" and algorithm.aggregate is None
         self._round_step = self._build_round_step()
         if self._bucketed:
             self._partial_step = self._build_partial_step()
@@ -201,8 +209,17 @@ class FedSimulator:
                     outs.update,
                 )
             new_params, new_server_state = alg.server_update(params, agg, server_state)
-            metrics = {k: v for k, v in outs.metrics.items()}
-            return new_params, new_server_state, outs.state, metrics
+            # reduce metrics to ONE tiny vector inside the program: each
+            # separate host read is a device round trip (expensive over a
+            # tunneled chip), so the round's metrics come back in a single
+            # (2,) transfer — [mean train_loss, train_acc]
+            m = outs.metrics
+            metrics_vec = jnp.stack([
+                m["train_loss"].mean().astype(jnp.float32),
+                (m["train_correct"].sum()
+                 / jnp.maximum(m["train_valid"].sum(), 1.0)).astype(jnp.float32),
+            ])
+            return new_params, new_server_state, outs.state, metrics_vec
 
         if self._use_device_data:
             # device-resident path: the cohort carries only an index
@@ -333,6 +350,7 @@ class FedSimulator:
                 start_round = restore_simulator_state(ckpt, self)
                 if log_fn:
                     log_fn(f"[resume] from round {start_round} @ {cfg.checkpoint_dir}")
+        pending = None  # deferred round record awaiting its metric readback
         for round_idx in range(start_round, cfg.comm_round):
             t0 = time.perf_counter()
             client_ids = reference_client_sampling(
@@ -352,19 +370,12 @@ class FedSimulator:
                 if drop.all():
                     drop[0] = False  # a round needs at least one survivor
             if self._bucketed:
-                metrics = self._run_bucketed_round(
+                metrics_vec = self._run_bucketed_round(
                     np.asarray(client_ids), round_idx, drop, step_rng
                 )
-                rec = {
-                    "round": round_idx,
-                    "round_time": time.perf_counter() - t0,
-                    "train_loss": float(np.mean(metrics["train_loss"])),
-                    "train_acc": float(
-                        np.sum(metrics["train_correct"])
-                        / max(float(np.sum(metrics["train_valid"])), 1.0)
-                    ),
-                }
-                self._post_round(rec, round_idx, apply_fn, ckpt, log_fn)
+                pending = self._defer_rec(
+                    round_idx, t0, metrics_vec, pending, apply_fn, ckpt, log_fn
+                )
                 continue
             perms = self._client_perms(client_ids, round_idx)
             if self._use_device_data:
@@ -391,19 +402,15 @@ class FedSimulator:
             step_args = (self.params, self.server_state, cohort, states, step_rng)
             if self._use_device_data:
                 step_args += (self._x_dev, self._y_dev)
-            self.params, self.server_state, new_states, metrics = self._round_step(
-                *step_args
+            self.params, self.server_state, new_states, metrics_vec = (
+                self._round_step(*step_args)
             )
             self._store_states(client_ids, new_states)
-            rec = {
-                "round": round_idx,
-                "round_time": time.perf_counter() - t0,
-                "train_loss": float(metrics["train_loss"].mean()),
-                "train_acc": float(
-                    metrics["train_correct"].sum() / max(float(metrics["train_valid"].sum()), 1.0)
-                ),
-            }
-            self._post_round(rec, round_idx, apply_fn, ckpt, log_fn)
+            pending = self._defer_rec(
+                round_idx, t0, metrics_vec, pending, apply_fn, ckpt, log_fn
+            )
+        if pending is not None:
+            self._finalize_rec(pending, apply_fn, ckpt, log_fn)
         # drain the async dispatch queue: per-round host reads (metric
         # scalars) can complete before the executables fully retire, so
         # without this the caller's wall-clock over run() — and the last
@@ -413,17 +420,52 @@ class FedSimulator:
             ckpt.close()
         return self.history
 
-    def _post_round(self, rec, round_idx, apply_fn, ckpt, log_fn) -> None:
+    def _defer_rec(self, round_idx, t0, metrics_vec, pending,
+                   apply_fn, ckpt, log_fn):
+        """Deferred metric readback: finalize the PREVIOUS round's record now
+        that this round is dispatched, so its device->host transfer overlaps
+        this round's compute instead of stalling the pipeline. Rounds that
+        evaluate or checkpoint must see the params of their own round, so
+        those finalize immediately (a sync point). Returns the new pending
+        record (or None)."""
         cfg = self.cfg
-        if apply_fn is not None and (
-            round_idx % cfg.frequency_of_the_test == 0 or round_idx == cfg.comm_round - 1
+        rec = {
+            "round": round_idx,
+            "round_time": time.perf_counter() - t0,
+            "_mvec": metrics_vec,
+        }
+        if pending is not None:
+            self._finalize_rec(pending, apply_fn, ckpt, log_fn)
+        if (apply_fn is not None and self._should_eval(round_idx)) or (
+            ckpt is not None and self._should_checkpoint(round_idx)
         ):
+            self._finalize_rec(rec, apply_fn, ckpt, log_fn)
+            return None
+        return rec
+
+    def _should_eval(self, round_idx: int) -> bool:
+        cfg = self.cfg
+        return (round_idx % cfg.frequency_of_the_test == 0
+                or round_idx == cfg.comm_round - 1)
+
+    def _should_checkpoint(self, round_idx: int) -> bool:
+        cfg = self.cfg
+        return ((round_idx + 1) % cfg.checkpoint_frequency == 0
+                or round_idx == cfg.comm_round - 1)
+
+    def _finalize_rec(self, rec, apply_fn, ckpt, log_fn) -> None:
+        """Materialize a round record's deferred metric vector (ONE small
+        device->host transfer) and run the post-round bookkeeping."""
+        mvec = np.asarray(rec.pop("_mvec"))
+        rec["train_loss"] = float(mvec[0])
+        rec["train_acc"] = float(mvec[1])
+        self._post_round(rec, rec["round"], apply_fn, ckpt, log_fn)
+
+    def _post_round(self, rec, round_idx, apply_fn, ckpt, log_fn) -> None:
+        if apply_fn is not None and self._should_eval(round_idx):
             rec.update(self.evaluate(apply_fn))
         self.history.append(rec)
-        if ckpt is not None and (
-            (round_idx + 1) % cfg.checkpoint_frequency == 0
-            or round_idx == cfg.comm_round - 1
-        ):
+        if ckpt is not None and self._should_checkpoint(round_idx):
             from ..utils.checkpoint import save_simulator_state
 
             save_simulator_state(ckpt, self, round_idx)
@@ -457,14 +499,25 @@ class FedSimulator:
             min(self._batch_counts[int(c)], self.num_local_batches)
             for c in client_ids
         ]
-        buckets = bucket_schedule(counts, self._axis_size, cfg.max_width_buckets)
+        buckets = bucket_schedule(
+            counts, self._axis_size, cfg.max_width_buckets,
+            max_width=self.num_local_batches,
+        )
         sum_wu = None
         total_w = None
-        metrics_parts: Dict[str, List[np.ndarray]] = {}
+        # metric accumulators stay DEVICE scalars (lazy): the caller defers
+        # the single readback so it overlaps the next round's compute
+        loss_sum = correct_sum = valid_sum = None
+        n_clients = 0
         for positions, width in buckets:
             ids = client_ids[positions]
             n_real = len(ids)
-            slots = -(-n_real // self._axis_size) * self._axis_size
+            # slots = axis-multiple rounded up to a power-of-two multiplier,
+            # so the set of compiled (slots, width) shapes stays small as
+            # cohorts vary round to round
+            per_axis = -(-n_real // self._axis_size)
+            per_axis = 1 << (per_axis - 1).bit_length()
+            slots = per_axis * self._axis_size
             pad = slots - n_real
             if pad:
                 ids = np.concatenate([ids, np.repeat(ids[-1], pad)])
@@ -510,12 +563,23 @@ class FedSimulator:
                     ids[:n_real],
                     jax.tree.map(lambda x: x[:n_real], new_states),
                 )
-            for k, v in mets.items():
-                metrics_parts.setdefault(k, []).append(np.asarray(v)[:n_real])
+            ls = mets["train_loss"][:n_real].sum()
+            cs = mets["train_correct"][:n_real].sum()
+            vs = mets["train_valid"][:n_real].sum()
+            if loss_sum is None:
+                loss_sum, correct_sum, valid_sum = ls, cs, vs
+            else:
+                loss_sum, correct_sum, valid_sum = (
+                    loss_sum + ls, correct_sum + cs, valid_sum + vs
+                )
+            n_clients += n_real
         self.params, self.server_state = self._finalize_step(
             self.params, self.server_state, sum_wu, total_w
         )
-        return {k: np.concatenate(v) for k, v in metrics_parts.items()}
+        return jnp.stack([
+            (loss_sum / max(n_clients, 1)).astype(jnp.float32),
+            (correct_sum / jnp.maximum(valid_sum, 1.0)).astype(jnp.float32),
+        ])
 
     def evaluate(self, apply_fn) -> Dict[str, float]:
         if self._eval_fn is None:

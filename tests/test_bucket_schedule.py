@@ -31,7 +31,16 @@ def test_bucket_schedule_partitions_and_cuts_padding():
 
 def test_bucket_schedule_single_bucket_uniform():
     buckets = bucket_schedule([5, 5, 5, 5], axis=2, max_buckets=4)
-    assert len(buckets) == 1 and buckets[0][1] == 5
+    # widths quantize up to powers of two (compile-cache stability)
+    assert len(buckets) == 1 and buckets[0][1] == 8
+
+
+def test_bucket_schedule_respects_width_cap():
+    # a 47-batch client must NOT have its width quantized past the caller's
+    # per-client batch cap (that would silently raise its training budget
+    # and aggregation weight vs the even path)
+    buckets = bucket_schedule([1, 1, 47], axis=1, max_buckets=2, max_width=24)
+    assert max(w for _, w in buckets) == 24
 
 
 def test_dp_schedule_balances_makespan():
