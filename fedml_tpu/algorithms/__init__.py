@@ -204,6 +204,7 @@ def get_algorithm(
         return FedAlgorithm(
             name=name, init_server_state=_no_state, init_client_state=_no_state,
             local_update=nova_local_update, server_update=server_update,
+            update_is_params=False,  # {norm_delta, tau}, not a params tree
         )
 
     if name_l == FEDML_FEDERATED_OPTIMIZER_SCAFFOLD.lower():
@@ -228,6 +229,7 @@ def get_algorithm(
             init_client_state=init_client_state,
             local_update=local_update, server_update=server_update,
             prepare_client_state=prepare_client_state,
+            update_is_params=False,  # {delta, delta_c}, not a params tree
         )
 
     raise ValueError(f"unknown federated optimizer '{name}'")

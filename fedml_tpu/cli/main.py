@@ -159,6 +159,32 @@ def agent(edge_id, broker_dir, store_dir):
         broker.close()
 
 
+@cli.command("dispatch", help="Fan a built package out to edge agents and wait.")
+@click.option("--package", "-p", required=True, type=click.Path(exists=True))
+@click.option("--edge_id", "-e", "edge_ids", multiple=True, type=int, required=True)
+@click.option("--run_id", "-r", default="run0")
+@click.option("--broker_dir", "-b", default=None)
+@click.option("--store_dir", "-s", default=None)
+@click.option("--timeout", "-t", default=600.0)
+def dispatch(package, edge_ids, run_id, broker_dir, store_dir, timeout):
+    """Reference ``server_runner.py:426 send_training_request_to_edges``:
+    the server-side MLOps flow the agent daemons serve. Exits 0 when every
+    edge reports FINISHED."""
+    from ..comm.pubsub import FileSystemBroker
+    from ..comm.store import FileSystemBlobStore
+    from .runner import FedMLServerRunner
+
+    broker = FileSystemBroker(root=broker_dir)
+    store = FileSystemBlobStore(root=store_dir)
+    server = FedMLServerRunner(broker, store=store)
+    server.send_training_request_to_edges(run_id, list(edge_ids), package)
+    statuses = server.wait_for_edges(list(edge_ids), timeout=timeout)
+    click.echo(json.dumps({"run_id": run_id, "statuses": statuses}))
+    broker.close()
+    if not all(statuses.get(e) == "FINISHED" for e in edge_ids):
+        raise SystemExit(1)
+
+
 @cli.command("run", help="Run a simulation from a YAML config.")
 @click.option("--cf", "config_file", required=True, type=click.Path(exists=True))
 @click.option("--backend", default=None, help="sp | TPU (overrides YAML)")

@@ -105,6 +105,12 @@ class FedAlgorithm:
     # Optional per-round injection of server state into client state before
     # local_update (e.g. SCAFFOLD broadcasting the server control variate).
     prepare_client_state: Optional[Callable[[PyTree, PyTree], PyTree]] = None
+    # True when ClientOutput.update mirrors the params pytree (the common
+    # case). Algorithms whose update carries a different structure (FedNova's
+    # {norm_delta, tau}) set False — the simulator's bucketed partial
+    # aggregation requires params-shaped updates and falls back to the even
+    # schedule otherwise.
+    update_is_params: bool = True
 
 
 # --- object shells (reference API parity) -----------------------------------

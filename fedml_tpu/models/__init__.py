@@ -29,6 +29,12 @@ from .gkt import GKTClientNet, GKTServerNet
 from .darts import DARTSSearchNet, derive_genotype
 from .unet import UNetLite
 from .gcn import GCNGraphClassifier
+from .mobile import (
+    MobileLeNet5,
+    MobileResNet18,
+    build_mobile_model_file,
+    load_mobile_model_file,
+)
 
 __all__ = [
     "create", "init_params", "sample_input_for",
@@ -38,6 +44,8 @@ __all__ = [
     "TransformerLM", "TransformerClassifier", "ViT",
     "Generator", "Discriminator", "GKTClientNet", "GKTServerNet",
     "DARTSSearchNet", "derive_genotype", "UNetLite", "GCNGraphClassifier",
+    "MobileLeNet5", "MobileResNet18", "build_mobile_model_file",
+    "load_mobile_model_file",
 ]
 
 

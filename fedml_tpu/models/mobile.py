@@ -1,0 +1,135 @@
+"""Mobile (cross-device / Beehive) model builders.
+
+Parity: reference ``model/mobile/mnn_lenet.py:35`` (``create_mnn_lenet5_model``
+builds a LeNet-5 and saves a ``.mnn`` file for Android/iOS clients) and
+``model/mobile/mnn_resnet.py:137`` (``create_mnn_resnet18_model``). The
+reference depends on the MNN C++ runtime's Python bindings to author the
+on-device file; this rebuild is TPU-native, so the deployable artifact is the
+framework's own format-agnostic device payload (``cross_device/server.py``
+blob codec): a single msgpack container holding an architecture manifest plus
+the serialized init params. A phone-side runtime (MNN, TFLite, ...) plugs in
+by translating the manifest; the SERVER side — which is all the reference
+ships in-repo — round-trips this format unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+PyTree = Any
+
+
+class MobileLeNet5(nn.Module):
+    """LeNet-5 for on-device MNIST training (reference mnn_lenet.py:35:
+    conv5x5(20) -> pool -> conv5x5(50) -> pool -> fc500 -> fc10)."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        if x.ndim == 3:
+            x = x[..., None]
+        x = nn.Conv(20, (5, 5), padding="VALID", dtype=self.dtype)(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(50, (5, 5), padding="VALID", dtype=self.dtype)(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(500, dtype=self.dtype)(x))
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+class MobileResNet18(nn.Module):
+    """ImageNet-style ResNet-18 for on-device training (reference
+    mnn_resnet.py:137); GroupNorm instead of BatchNorm so federated
+    averaging of statistics is a non-issue on-device."""
+
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)],
+                    use_bias=False, dtype=self.dtype)(x)
+        x = nn.relu(nn.GroupNorm(num_groups=32, dtype=self.dtype)(x))
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=[(1, 1), (1, 1)])
+        for stage, filters in enumerate((64, 128, 256, 512)):
+            for block in range(2):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                residual = x
+                y = nn.Conv(filters, (3, 3), strides, padding="SAME",
+                            use_bias=False, dtype=self.dtype)(x)
+                y = nn.relu(nn.GroupNorm(num_groups=32, dtype=self.dtype)(y))
+                y = nn.Conv(filters, (3, 3), padding="SAME",
+                            use_bias=False, dtype=self.dtype)(y)
+                y = nn.GroupNorm(num_groups=32, dtype=self.dtype)(y)
+                if residual.shape != y.shape:
+                    residual = nn.Conv(filters, (1, 1), strides,
+                                       use_bias=False, dtype=self.dtype)(residual)
+                x = nn.relu(residual + y)
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+def build_mobile_model_file(
+    model_name: str,
+    path: str,
+    num_classes: Optional[int] = None,
+    seed: int = 0,
+) -> bytes:
+    """Author the deployable device model artifact (reference
+    ``create_mnn_lenet5_model``/``create_mnn_resnet18_model`` write ``.mnn``
+    files here). The artifact = msgpack{manifest, params-blob}; returns the
+    bytes and writes them to ``path``."""
+    from ..comm.message import pack_payload
+    from ..cross_device.server import encode_model_blob
+
+    if model_name in ("lenet", "lenet5", "mnn_lenet"):
+        model = MobileLeNet5(num_classes=num_classes or 10)
+        sample = jnp.zeros((1, 28, 28, 1), jnp.float32)
+    elif model_name in ("resnet18", "mnn_resnet"):
+        model = MobileResNet18(num_classes=num_classes or 1000)
+        sample = jnp.zeros((1, 224, 224, 3), jnp.float32)
+    else:
+        raise ValueError(f"unknown mobile model '{model_name}'")
+    variables = model.init(jax.random.PRNGKey(seed), sample)
+    artifact = pack_payload({
+        "manifest": {
+            "format": "fedml_tpu.mobile.v1",
+            "arch": model_name,
+            "num_classes": int(num_classes or
+                               (10 if "lenet" in model_name else 1000)),
+            "input_shape": list(sample.shape[1:]),
+        },
+        "params": encode_model_blob(variables),
+    })
+    with open(path, "wb") as f:
+        f.write(artifact)
+    return artifact
+
+
+def load_mobile_model_file(path: str):
+    """Server-side load of a device artifact: returns (model, variables) —
+    the counterpart the Beehive aggregator evaluates with (reference
+    ``fedml_aggregator.py:171`` loads the .mnn into the MNN runtime)."""
+    from ..comm.message import unpack_payload
+    from ..cross_device.server import decode_model_blob
+
+    with open(path, "rb") as f:
+        art = unpack_payload(f.read())
+    man = art["manifest"]
+    if "lenet" in man["arch"]:
+        model = MobileLeNet5(num_classes=int(man["num_classes"]))
+        sample = jnp.zeros((1, *man["input_shape"]), jnp.float32)
+    else:
+        model = MobileResNet18(num_classes=int(man["num_classes"]))
+        sample = jnp.zeros((1, *man["input_shape"]), jnp.float32)
+    template = model.init(jax.random.PRNGKey(0), sample)
+    variables = decode_model_blob(art["params"], template)
+    return model, variables
