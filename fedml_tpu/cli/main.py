@@ -178,7 +178,8 @@ def dispatch(package, edge_ids, run_id, broker_dir, store_dir, timeout):
     store = FileSystemBlobStore(root=store_dir)
     server = FedMLServerRunner(broker, store=store)
     server.send_training_request_to_edges(run_id, list(edge_ids), package)
-    statuses = server.wait_for_edges(list(edge_ids), timeout=timeout)
+    statuses = server.wait_for_edges(
+        list(edge_ids), timeout=timeout, run_id=run_id)
     click.echo(json.dumps({"run_id": run_id, "statuses": statuses}))
     broker.close()
     if not all(statuses.get(e) == "FINISHED" for e in edge_ids):

@@ -274,7 +274,8 @@ class FedMLEdgeRunner:
         # per-edge file: multiple agents sharing one home dir must not
         # clobber each other's state (plus the legacy shared file the CLI
         # `status` command falls back to)
-        rec = {"status": status, "edge_id": self.edge_id, "time": time.time()}
+        rec = {"status": status, "edge_id": self.edge_id, "time": time.time(),
+               "run_id": getattr(self.metrics, "run_id", None)}
         with open(os.path.join(self.home,
                                f"status_edge{self.edge_id}.json"), "w") as f:
             json.dump(rec, f)
@@ -299,6 +300,7 @@ class FedMLServerRunner:
         self.store = store
         self.metrics = MLOpsMetrics(sink=sink)
         self.edge_status: Dict[int, str] = {}
+        self.edge_run: Dict[int, Any] = {}
         self._status_lock = threading.Lock()
         self.broker.subscribe(STATUS_TOPIC, self._on_edge_status)
 
@@ -306,6 +308,7 @@ class FedMLServerRunner:
         rec = unpack_payload(payload)
         with self._status_lock:
             self.edge_status[int(rec["edge_id"])] = rec["status"]
+            self.edge_run[int(rec["edge_id"])] = rec.get("run_id")
 
     def upload_package(self, run_id, package_path: str) -> str:
         """Publish the built package for edges to fetch. With a store, edges
@@ -349,11 +352,20 @@ class FedMLServerRunner:
             )
 
     def wait_for_edges(self, edge_ids, terminal=("FINISHED", "FAILED", "KILLED"),
-                       timeout: float = 300.0) -> Dict[int, str]:
+                       timeout: float = 300.0, run_id=None) -> Dict[int, str]:
+        """Block until every edge reports a terminal status — scoped to
+        ``run_id`` when given, so stale FINISHED messages from a previous
+        run never satisfy a new dispatch."""
         deadline = time.time() + timeout
+
+        def _done(e):
+            if self.edge_status.get(e) not in terminal:
+                return False
+            return run_id is None or self.edge_run.get(e) == run_id
+
         while time.time() < deadline:
             with self._status_lock:
-                if all(self.edge_status.get(e) in terminal for e in edge_ids):
+                if all(_done(e) for e in edge_ids):
                     break
             time.sleep(0.05)
         with self._status_lock:

@@ -18,7 +18,7 @@ from .message import (
 )
 from .loopback import LoopbackCommManager, LoopbackHub, get_default_hub
 from .managers import ClientManager, FedMLCommManager, ServerManager, create_comm_backend
-from .mqtt_s3 import MqttS3CommManager
+from .mqtt_s3 import MqttS3CommManager, MqttS3MnnCommManager
 from .pubsub import FileSystemBroker, InProcessBroker, PubSubBroker
 from .store import BlobStore, FileSystemBlobStore, InMemoryBlobStore
 from .topology import (
@@ -34,7 +34,7 @@ __all__ = [
     "compress_tree", "decompress_tree", "is_compressed",
     "LoopbackCommManager", "LoopbackHub", "get_default_hub",
     "ClientManager", "FedMLCommManager", "ServerManager", "create_comm_backend",
-    "MqttS3CommManager", "PubSubBroker", "InProcessBroker", "FileSystemBroker",
+    "MqttS3CommManager", "MqttS3MnnCommManager", "PubSubBroker", "InProcessBroker", "FileSystemBroker",
     "BlobStore", "FileSystemBlobStore", "InMemoryBlobStore",
     "BaseTopologyManager", "SymmetricTopologyManager", "AsymmetricTopologyManager",
     "ring_mixing_matrix",

@@ -34,8 +34,9 @@ def create_comm_backend(backend: str, rank: int, size: int, args=None, **kw) -> 
             ip_config=kw.get("ip_config") or getattr(args, "grpc_ipconfig_path", None),
             base_port=int(kw.get("base_port") or getattr(args, "grpc_base_port", 8890)),
         )
-    if backend == constants.COMM_BACKEND_MQTT_S3:
-        from .mqtt_s3 import MqttS3CommManager
+    if backend in (constants.COMM_BACKEND_MQTT_S3,
+                   constants.COMM_BACKEND_MQTT_S3_MNN):
+        from .mqtt_s3 import MqttS3CommManager, MqttS3MnnCommManager
         from .pubsub import FileSystemBroker
         from .store import FileSystemBlobStore
 
@@ -50,10 +51,18 @@ def create_comm_backend(backend: str, rank: int, size: int, args=None, **kw) -> 
             store = FileSystemBlobStore(
                 root=getattr(args, "blob_store_dir", None) or kw.get("store_dir")
             )
-        return MqttS3CommManager(
+        cls = (MqttS3MnnCommManager
+               if backend == constants.COMM_BACKEND_MQTT_S3_MNN
+               else MqttS3CommManager)
+        extra = {}
+        if cls is MqttS3MnnCommManager:
+            extra["download_dir"] = (getattr(args, "model_file_cache_dir", None)
+                                     or kw.get("download_dir"))
+        return cls(
             broker, store, rank=rank, size=size,
             run_id=str(getattr(args, "run_id", 0)),
             owns_broker=owns_broker,  # factory-created broker dies with the manager
+            **extra,
         )
     raise ValueError(f"unknown comm backend '{backend}'")
 

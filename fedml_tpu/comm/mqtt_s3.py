@@ -79,13 +79,15 @@ class MqttS3CommManager(BaseCommunicationManager):
             msg.add_params(Message.MSG_ARG_KEY_MODEL_PARAMS, unpack_payload(blob))
         self._inbox.put(msg)
 
-    def send_message(self, msg: Message) -> None:
-        receiver_id = msg.get_receiver_id()
-        topic = (
-            self._downlink_topic(receiver_id)
+    def _topic_for(self, msg: Message) -> str:
+        return (
+            self._downlink_topic(msg.get_receiver_id())
             if self.rank == 0
             else self._uplink_topic(self.rank)
         )
+
+    def send_message(self, msg: Message) -> None:
+        topic = self._topic_for(msg)
         params = msg.get_params()
         model_params = params.get(Message.MSG_ARG_KEY_MODEL_PARAMS)
         if model_params is not None:
@@ -131,3 +133,65 @@ class MqttS3CommManager(BaseCommunicationManager):
         if self._owns_broker:
             # the factory created this broker for us; stop its poller thread
             self.broker.close()
+
+
+MSG_ARG_KEY_MODEL_FILE = "model_file_path"
+
+
+class MqttS3MnnCommManager(MqttS3CommManager):
+    """Cross-device (Beehive) variant: ships device model FILES.
+
+    Parity: reference ``mqtt_s3_mnn/remote_storage.py:56,76`` — the payload
+    is a serialized on-device model file (``.mnn`` there; the framework's
+    mobile artifact here, see ``models/mobile.py``), uploaded to the object
+    store whole and re-materialized as a local file on the receiver, whose
+    message then carries the file PATH (Android clients and the MNN server
+    aggregator both operate on files, ``fedml_aggregator.py:46``).
+    """
+
+    def __init__(self, *a, download_dir: Optional[str] = None, **kw):
+        import os
+        import tempfile
+
+        super().__init__(*a, **kw)
+        self.download_dir = download_dir or tempfile.mkdtemp(
+            prefix="fedml_tpu_mnn_")
+        os.makedirs(self.download_dir, exist_ok=True)
+
+    def send_message(self, msg: Message) -> None:
+        import os
+
+        path = msg.get(MSG_ARG_KEY_MODEL_FILE)
+        if path is not None:
+            if not os.path.exists(str(path)):
+                # fail at the send site — shipping the sender-local path
+                # string would surface as a dangling file far away
+                raise FileNotFoundError(
+                    f"model file to ship does not exist: {path}")
+            topic = self._topic_for(msg)
+            key = f"{topic}_{uuid.uuid4()}_{os.path.basename(str(path))}"
+            with open(str(path), "rb") as f:
+                url = self.store.put(key, f.read())
+            params = dict(msg.get_params())
+            params[MSG_ARG_KEY_MODEL_FILE] = key
+            params[Message.MSG_ARG_KEY_MODEL_PARAMS_URL] = url
+            out = Message()
+            out.init(params)
+            self.broker.publish(topic, out.to_bytes())
+            return
+        super().send_message(msg)
+
+    def _on_payload(self, topic: str, payload: bytes) -> None:
+        import os
+
+        msg = Message.from_bytes(payload)
+        key = msg.get(MSG_ARG_KEY_MODEL_FILE)
+        url = msg.get(Message.MSG_ARG_KEY_MODEL_PARAMS_URL)
+        if key is not None and url is not None:
+            local = os.path.join(self.download_dir, os.path.basename(str(key)))
+            with open(local, "wb") as f:
+                f.write(self.store.get(str(key)))
+            msg.add_params(MSG_ARG_KEY_MODEL_FILE, local)
+            self._inbox.put(msg)
+            return
+        super()._on_payload(topic, payload)

@@ -149,3 +149,48 @@ def test_cross_silo_e2e_over_mqtt_s3(tmp_path):
     assert server.history[-1]["test_acc"] > 0.4
     # model weights rode the blob store, not the control plane
     assert len(os.listdir(store_dir)) > 0
+
+def test_mqtt_s3_mnn_ships_model_files(tmp_path):
+    """Beehive file-shipping variant (reference mqtt_s3_mnn/remote_storage.py
+    :56,76): the sender uploads a device model FILE to the store, the
+    receiver re-materializes it locally and gets the local path."""
+    from fedml_tpu.comm.managers import create_comm_backend
+    from fedml_tpu.comm.mqtt_s3 import MSG_ARG_KEY_MODEL_FILE
+    from fedml_tpu.models import build_mobile_model_file, load_mobile_model_file
+
+    broker = FileSystemBroker(root=str(tmp_path / "broker"))
+    store = FileSystemBlobStore(root=str(tmp_path / "blobs"))
+    server = create_comm_backend(
+        "MQTT_S3_MNN", rank=0, size=2, broker=broker, store=store,
+        download_dir=str(tmp_path / "srv_dl"))
+    client = create_comm_backend(
+        "MQTT_S3_MNN", rank=1, size=2, broker=broker, store=store,
+        download_dir=str(tmp_path / "cli_dl"))
+
+    # server authors the device artifact and ships the FILE downlink
+    art_path = str(tmp_path / "lenet5.fedml")
+    build_mobile_model_file("lenet5", art_path, seed=1)
+    msg = Message("init", 0, 1)
+    msg.add_params(MSG_ARG_KEY_MODEL_FILE, art_path)
+    server.send_message(msg)
+
+    got = []
+    class Obs:
+        def receive_message(self, t, m):
+            got.append(m)
+    client.add_observer(Obs())
+    t = threading.Thread(target=client.handle_receive_message, daemon=True)
+    t.start()
+    deadline = time.time() + 10
+    while not got and time.time() < deadline:
+        time.sleep(0.05)
+    assert got, "file message never arrived"
+    local = got[0].get(MSG_ARG_KEY_MODEL_FILE)
+    assert local != art_path and os.path.exists(local)
+    # the re-materialized artifact loads into the same model
+    model, variables = load_mobile_model_file(local)
+    import jax.numpy as jnp
+    assert model.apply(variables, jnp.zeros((1, 28, 28, 1))).shape == (1, 10)
+    client.stop_receive_message()
+    server.stop_receive_message()
+    broker.close()
