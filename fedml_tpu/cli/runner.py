@@ -281,9 +281,7 @@ class FedMLEdgeRunner:
             json.dump(rec, f)
         with open(os.path.join(self.home, "status.json"), "w") as f:
             json.dump(rec, f)
-        self.broker.publish(STATUS_TOPIC, pack_payload(
-            {"edge_id": self.edge_id, "status": status}
-        ))
+        self.broker.publish(STATUS_TOPIC, pack_payload(rec))
 
 
 class FedMLServerRunner:
@@ -369,4 +367,9 @@ class FedMLServerRunner:
                     break
             time.sleep(0.05)
         with self._status_lock:
-            return dict(self.edge_status)
+            if run_id is None:
+                return dict(self.edge_status)
+            # scope the RESULT too: a stale status from another run must not
+            # read as this run's outcome after a timeout
+            return {e: s for e, s in self.edge_status.items()
+                    if self.edge_run.get(e) == run_id}

@@ -86,6 +86,20 @@ class MqttS3CommManager(BaseCommunicationManager):
             else self._uplink_topic(self.rank)
         )
 
+    def _offload_and_publish(self, topic: str, params, blob: bytes,
+                             param_key: str, suffix: str = "") -> None:
+        """Shared store-offload: upload ``blob``, rewrite ``param_key`` to
+        the store key (+URL), publish the small control message."""
+        key = f"{topic}_{uuid.uuid4()}{suffix}"
+        url = self.store.put(key, blob)
+        params = dict(params)
+        params[param_key] = key
+        params[Message.MSG_ARG_KEY_MODEL_PARAMS_URL] = url
+        out = Message()
+        out.init(params)
+        logging.debug("mqtt_s3: payload %d B -> store key %s", len(blob), key)
+        self.broker.publish(topic, out.to_bytes())
+
     def send_message(self, msg: Message) -> None:
         topic = self._topic_for(msg)
         params = msg.get_params()
@@ -95,15 +109,8 @@ class MqttS3CommManager(BaseCommunicationManager):
 
             blob = pack_payload(model_params)
             if len(blob) > INLINE_PAYLOAD_MAX_BYTES:
-                key = f"{topic}_{uuid.uuid4()}"
-                url = self.store.put(key, blob)
-                params = dict(params)
-                params[Message.MSG_ARG_KEY_MODEL_PARAMS] = key
-                params[Message.MSG_ARG_KEY_MODEL_PARAMS_URL] = url
-                out = Message()
-                out.init(params)
-                logging.debug("mqtt_s3: payload %d B -> store key %s", len(blob), key)
-                self.broker.publish(topic, out.to_bytes())
+                self._offload_and_publish(
+                    topic, params, blob, Message.MSG_ARG_KEY_MODEL_PARAMS)
                 return
         self.broker.publish(topic, msg.to_bytes())
 
@@ -168,16 +175,12 @@ class MqttS3MnnCommManager(MqttS3CommManager):
                 # string would surface as a dangling file far away
                 raise FileNotFoundError(
                     f"model file to ship does not exist: {path}")
-            topic = self._topic_for(msg)
-            key = f"{topic}_{uuid.uuid4()}_{os.path.basename(str(path))}"
             with open(str(path), "rb") as f:
-                url = self.store.put(key, f.read())
-            params = dict(msg.get_params())
-            params[MSG_ARG_KEY_MODEL_FILE] = key
-            params[Message.MSG_ARG_KEY_MODEL_PARAMS_URL] = url
-            out = Message()
-            out.init(params)
-            self.broker.publish(topic, out.to_bytes())
+                blob = f.read()
+            self._offload_and_publish(
+                self._topic_for(msg), msg.get_params(), blob,
+                MSG_ARG_KEY_MODEL_FILE,
+                suffix=f"_{os.path.basename(str(path))}")
             return
         super().send_message(msg)
 

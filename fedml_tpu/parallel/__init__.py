@@ -32,6 +32,7 @@ from .collectives import (
     ppermute_tree,
     ring_neighbors,
 )
+from .pipeline import PipelineConfig, PipelinedLMTrainer, make_pipe_mesh
 
 __all__ = [
     "AXIS_CLIENT", "AXIS_DATA", "AXIS_MODEL", "AXIS_PIPE", "AXIS_SEQ", "AXIS_EXPERT",
@@ -39,4 +40,5 @@ __all__ = [
     "replicated", "shard_along", "shard_leading_axis", "replicate_tree",
     "psum_tree", "pmean_tree", "weighted_psum_tree", "all_gather_tree",
     "ppermute_tree", "ring_neighbors",
+    "PipelineConfig", "PipelinedLMTrainer", "make_pipe_mesh",
 ]

@@ -1,0 +1,218 @@
+"""Pipeline parallelism (GPipe-style) over the ``pipe`` mesh axis.
+
+The reference has no pipeline engine (its ``distributed/`` Cheetah line is an
+empty placeholder; the closest pattern is SplitNN's layer-split activation
+exchange, ``simulation/mpi/split_nn/client.py:23``). This is the TPU-native
+version: every device owns one STAGE of the homogeneous decoder stack
+(stage-stacked params sharded over ``pipe``), and microbatches stream through
+the stages inside ``shard_map`` — the stage-to-stage activation transfer is a
+``lax.ppermute`` on ICI, the schedule is a ``lax.scan`` over
+``microbatches + stages - 1`` ticks (the classic GPipe fill/drain diagram),
+and the backward pass is just JAX differentiating through scan + ppermute
+(reverse-mode turns the +1 rotation into a -1 rotation automatically).
+
+Embedding and the LM head sit OUTSIDE the pipeline (replicated / dp-sharded)
+so every stage body is identical — which is what lets stage params stack
+into one leading-axis pytree and the whole schedule compile to a single
+program.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.transformer import Block
+from .mesh import AXIS_DATA, AXIS_PIPE, MeshConfig, create_mesh
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class PipelineConfig:
+    pp: int = 2            # pipeline stages (devices along ``pipe``)
+    dp: int = 1            # data parallelism across replicas of the pipeline
+    microbatches: int = 4  # per-step microbatches streamed through the pipe
+    lr: float = 3e-4
+
+
+def make_pipe_mesh(cfg: PipelineConfig, devices=None) -> Mesh:
+    return create_mesh(
+        MeshConfig(axes=((AXIS_DATA, cfg.dp), (AXIS_PIPE, cfg.pp))),
+        devices=devices,
+    )
+
+
+class _StageBody(nn.Module):
+    """The homogeneous per-stage body: ``layers_per_stage`` decoder blocks."""
+
+    dim: int
+    num_heads: int
+    layers_per_stage: int
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        for _ in range(self.layers_per_stage):
+            x = Block(self.dim, self.num_heads, causal=True, dtype=self.dtype)(x)
+        return x
+
+
+def _pipeline_apply(stage_apply, stage_params, x_mb, *, pp: int, axis: str):
+    """Run microbatches through the stages. Called INSIDE shard_map over
+    ``axis``: ``stage_params`` is this device's stage (leading axis already
+    consumed), ``x_mb`` is (M, mb, T, D) — the full microbatch queue,
+    replicated along ``axis`` (only stage 0 reads it; cheap at these sizes
+    and keeps the schedule a pure scan).
+
+    Returns (M, mb, T, D): the last stage's outputs in microbatch order
+    (valid on the last stage; other stages return zeros and the caller
+    selects via psum of the one-hot masked result).
+    """
+    idx = jax.lax.axis_index(axis)
+    M, mb, T, D = x_mb.shape
+    n_ticks = M + pp - 1
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 ingests microbatch t (garbage buffer beyond the fill)
+        feed = x_mb[jnp.minimum(t, M - 1)]
+        inp = jnp.where(idx == 0, feed, state)
+        out = stage_apply(stage_params, inp)
+        # last stage emits microbatch t-(pp-1) at tick t
+        emit_idx = t - (pp - 1)
+        is_emit = jnp.logical_and(idx == pp - 1, emit_idx >= 0)
+        outputs = jax.lax.cond(
+            is_emit,
+            lambda o: jax.lax.dynamic_update_slice(
+                o, out[None], (jnp.maximum(emit_idx, 0), 0, 0, 0)),
+            lambda o: o,
+            outputs,
+        )
+        # rotate activations one stage forward (stage pp-1 -> 0 wraps, but
+        # stage 0 overwrites its input with the next microbatch anyway)
+        state = jax.lax.ppermute(
+            out, axis, [(i, (i + 1) % pp) for i in range(pp)]
+        )
+        return (state, outputs), None
+
+    state0 = jnp.zeros((mb, T, D), x_mb.dtype)
+    outputs0 = jnp.zeros((M, mb, T, D), x_mb.dtype)
+    (_, outputs), _ = jax.lax.scan(
+        tick, (state0, outputs0), jnp.arange(n_ticks)
+    )
+    # every non-last stage holds zeros; psum over the pipe axis broadcasts
+    # the last stage's result to all stages (so the head computes everywhere
+    # and the loss is replicated along ``pipe``)
+    mask = (idx == pp - 1).astype(outputs.dtype)
+    return jax.lax.psum(outputs * mask, axis)
+
+
+class PipelinedLMTrainer:
+    """Causal-LM trainer with the decoder stack pipelined over ``pipe``.
+
+    Stage params live stacked on a leading stage axis sharded over the
+    ``pipe`` mesh axis; embedding/norm/head params are replicated. Batch is
+    sharded over ``data`` as usual (each dp replica runs its own pipeline;
+    XLA psums the gradients).
+    """
+
+    def __init__(self, cfg: PipelineConfig, vocab_size: int, dim: int,
+                 num_heads: int, num_layers: int, max_len: int,
+                 dtype=jnp.float32, mesh: Optional[Mesh] = None, seed: int = 0):
+        assert num_layers % cfg.pp == 0, "layers must split evenly into stages"
+        self.cfg = cfg
+        self.mesh = mesh or make_pipe_mesh(cfg)
+        self.dim, self.max_len = dim, max_len
+        layers_per_stage = num_layers // cfg.pp
+        self.stage = _StageBody(dim, num_heads, layers_per_stage, dtype)
+
+        rng = jax.random.PRNGKey(seed)
+        keys = jax.random.split(rng, cfg.pp + 3)
+        x0 = jnp.zeros((1, max_len, dim), dtype)
+        # one init per stage, stacked on the leading axis
+        stage_params = jax.vmap(
+            lambda k: self.stage.init(k, x0)
+        )(jnp.stack(keys[: cfg.pp]))
+        self.embed = nn.Embed(vocab_size, dim, dtype=dtype)
+        embed_params = self.embed.init(keys[-3], jnp.zeros((1, 1), jnp.int32))
+        self.head = nn.Dense(vocab_size, use_bias=False, dtype=dtype)
+        head_params = self.head.init(keys[-2], x0)
+        pos = 0.02 * jax.random.normal(keys[-1], (max_len, dim), dtype)
+        self.params = {
+            "stages": stage_params, "embed": embed_params,
+            "head": head_params, "pos": pos,
+        }
+        pipe_first = NamedSharding(self.mesh, P(AXIS_PIPE))
+        rep = NamedSharding(self.mesh, P())
+        self._param_sh = {
+            "stages": jax.tree.map(lambda _: pipe_first, stage_params),
+            "embed": jax.tree.map(lambda _: rep, embed_params),
+            "head": jax.tree.map(lambda _: rep, head_params),
+            "pos": rep,
+        }
+        self.params = jax.device_put(self.params, self._param_sh)
+        self.opt = optax.adam(cfg.lr)
+        # init AFTER placement: zeros_like on sharded params gives the adam
+        # moments the same pipe/replicated layout
+        self.opt_state = self.opt.init(self.params)
+        self._step = self._build_step()
+
+    def _build_step(self):
+        cfg, mesh, stage = self.cfg, self.mesh, self.stage
+        pp, M = cfg.pp, cfg.microbatches
+
+        stage_apply = lambda p, x: stage.apply(p, x)  # noqa: E731
+
+        pipe_spec = P(AXIS_PIPE)
+
+        def run_pipeline(stages_stacked, h_mb):
+            # shard_map over pipe: each device gets its (1, ...) stage slice
+            def inner(stage_slice, x_mb):
+                local = jax.tree.map(lambda a: a[0], stage_slice)
+                return _pipeline_apply(
+                    stage_apply, local, x_mb, pp=pp, axis=AXIS_PIPE
+                )
+
+            return jax.shard_map(
+                inner, mesh=mesh,
+                in_specs=(jax.tree.map(lambda _: pipe_spec, stages_stacked),
+                          P(None, AXIS_DATA)),
+                out_specs=P(None, AXIS_DATA),
+                check_vma=False,
+            )(stages_stacked, h_mb)
+
+        def loss_fn(params, tokens, targets):
+            B, T = tokens.shape
+            h = self.embed.apply(params["embed"], tokens)
+            h = h + params["pos"][None, :T]
+            mb = B // M
+            h_mb = h.reshape(M, mb, T, self.dim)
+            out = run_pipeline(params["stages"], h_mb)
+            out = out.reshape(B, T, self.dim)
+            logits = self.head.apply(params["head"], out)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits.astype(jnp.float32), targets
+            ).mean()
+
+        @jax.jit
+        def step(params, opt_state, tokens, targets):
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens, targets)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        return step
+
+    def step(self, tokens: np.ndarray, targets: np.ndarray) -> float:
+        self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state,
+            jnp.asarray(tokens, jnp.int32), jnp.asarray(targets, jnp.int32),
+        )
+        return float(loss)

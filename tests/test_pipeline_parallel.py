@@ -1,0 +1,76 @@
+"""GPipe-style pipeline parallelism: schedule correctness vs an unpipelined
+stack, and learning through the pipelined backward (scan + ppermute VJP)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.parallel.pipeline import (
+    PipelineConfig,
+    PipelinedLMTrainer,
+    make_pipe_mesh,
+)
+
+
+def _data(B=8, T=16, vocab=64, seed=0):
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, vocab, size=(B, T)).astype(np.int32)
+    return toks, np.roll(toks, -1, axis=1)
+
+
+def test_pipeline_matches_sequential_forward():
+    """pp=4 pipelined forward == the same stages applied sequentially."""
+    cfg = PipelineConfig(pp=4, dp=1, microbatches=4)
+    mesh = make_pipe_mesh(cfg, devices=jax.devices()[:4])
+    tr = PipelinedLMTrainer(cfg, vocab_size=64, dim=32, num_heads=4,
+                            num_layers=4, max_len=16, mesh=mesh)
+    toks, _ = _data()
+
+    h = tr.embed.apply(tr.params["embed"], jnp.asarray(toks))
+    h = h + tr.params["pos"][None, : toks.shape[1]]
+
+    # reference: apply stage s params in order, no pipeline
+    ref = h
+    for s in range(cfg.pp):
+        stage_s = jax.tree.map(lambda a, s=s: a[s], tr.params["stages"])
+        ref = tr.stage.apply(stage_s, ref)
+
+    # pipelined: run the jitted loss path up to the pipeline output by
+    # reusing the internal schedule
+    from fedml_tpu.parallel.pipeline import _pipeline_apply
+    from jax.sharding import PartitionSpec as P
+    from fedml_tpu.parallel.mesh import AXIS_DATA, AXIS_PIPE
+
+    M = cfg.microbatches
+    mb = h.shape[0] // M
+    h_mb = h.reshape(M, mb, h.shape[1], h.shape[2])
+
+    def inner(stage_slice, x_mb):
+        local = jax.tree.map(lambda a: a[0], stage_slice)
+        return _pipeline_apply(
+            lambda p, x: tr.stage.apply(p, x), local, x_mb,
+            pp=cfg.pp, axis=AXIS_PIPE,
+        )
+
+    out = jax.shard_map(
+        inner, mesh=mesh,
+        in_specs=(jax.tree.map(lambda _: P(AXIS_PIPE), tr.params["stages"]),
+                  P(None, AXIS_DATA)),
+        out_specs=P(None, AXIS_DATA),
+        check_vma=False,
+    )(tr.params["stages"], h_mb)
+    out = out.reshape(ref.shape)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pipeline_trainer_learns():
+    """dp2 x pp4 end-to-end: loss decreases through the pipelined backward."""
+    cfg = PipelineConfig(pp=4, dp=2, microbatches=4, lr=3e-3)
+    mesh = make_pipe_mesh(cfg, devices=jax.devices()[:8])
+    tr = PipelinedLMTrainer(cfg, vocab_size=64, dim=32, num_heads=4,
+                            num_layers=8, max_len=16, mesh=mesh)
+    toks, tgt = _data(B=8)
+    losses = [tr.step(toks, tgt) for _ in range(12)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] - 0.5, losses
