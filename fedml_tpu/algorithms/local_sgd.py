@@ -60,6 +60,12 @@ class LocalTrainConfig:
                                      # we implement it.
     use_scaffold: bool = False
     max_grad_norm: Optional[float] = None
+    # Example-level DP-SGD (Abadi et al.): per-example gradients clipped to
+    # dp_l2_clip, Gaussian noise dp_noise_multiplier * clip added to the
+    # batch sum. The reference's core/dp is an EMPTY stub; this is the real
+    # mechanism (accounting in fedml_tpu.core.dp).
+    dp_l2_clip: Optional[float] = None
+    dp_noise_multiplier: float = 0.0
 
     def make_optimizer(self) -> optax.GradientTransformation:
         chain = []
@@ -112,10 +118,19 @@ def make_local_update(
     loss_fn = make_loss_fn(apply_fn, needs_dropout)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
     prox_mu = 0.0 if cfg.prox_mu is None else cfg.prox_mu
+    if cfg.dp_noise_multiplier > 0.0 and cfg.dp_l2_clip is None:
+        raise ValueError(
+            "dp_noise_multiplier set without dp_l2_clip — noise calibration "
+            "needs the clip (sensitivity); set dp_l2_clip to enable DP-SGD"
+        )
     if has_batch_stats:
         assert not cfg.use_scaffold, (
             "SCAFFOLD control variates are defined on params only; "
             "combine with GroupNorm models instead"
+        )
+        assert cfg.dp_l2_clip is None, (
+            "DP-SGD with BatchNorm is unsupported (running statistics leak "
+            "unclipped example information); use a GroupNorm model variant"
         )
         return _make_bn_local_update(apply_fn, cfg, opt, prox_mu, needs_dropout)
 
@@ -127,11 +142,53 @@ def make_local_update(
         if cfg.use_scaffold:
             c_global, c_local = client_state
 
+        def dp_grads(params, bx, by, bm, step_rng):
+            """Per-example clip + noise (the actual core/dp mechanism)."""
+            C = cfg.dp_l2_clip
+
+            def ex_loss(p, ex_x, ex_y, ex_m):
+                return loss_fn(p, ex_x[None], ex_y[None], ex_m[None], step_rng)
+
+            (losses, (corrects, valids)), g_ex = jax.vmap(
+                jax.value_and_grad(ex_loss, has_aux=True),
+                in_axes=(None, 0, 0, 0),
+            )(params, bx, by, bm)
+            # per-example global l2 norm over the whole gradient pytree
+            sq = sum(
+                jnp.sum(g.reshape(g.shape[0], -1) ** 2, axis=1)
+                for g in jax.tree.leaves(g_ex)
+            )
+            scale = jnp.minimum(1.0, C / jnp.maximum(jnp.sqrt(sq), 1e-12))
+
+            def clip_sum(g):
+                s = scale.reshape((-1,) + (1,) * (g.ndim - 1))
+                return (g * s).sum(axis=0)
+
+            summed = jax.tree.map(clip_sum, g_ex)
+            sigma = cfg.dp_noise_multiplier * C  # static: 0.0 = clip-only
+            if sigma > 0.0:
+                noise_rng = jax.random.fold_in(step_rng, 7)
+                flat, treedef = jax.tree.flatten(summed)
+                keys = jax.random.split(noise_rng, len(flat))
+                summed = jax.tree.unflatten(treedef, [
+                    g + sigma * jax.random.normal(k, g.shape, g.dtype)
+                    for g, k in zip(flat, keys)
+                ])
+            denom = jnp.maximum(bm.sum(), 1.0)
+            grads = jax.tree.map(lambda g: g / denom, summed)
+            loss = (losses * bm.reshape(losses.shape)).sum() / denom
+            return (loss, (corrects.sum(), valids.sum())), grads
+
         def batch_step(carry, inputs):
             params, opt_state, step = carry
             bx, by, bm = inputs
             step_rng = jax.random.fold_in(rng, step)
-            (loss, (correct, valid)), grads = grad_fn(params, bx, by, bm, step_rng)
+            if cfg.dp_l2_clip is not None:
+                (loss, (correct, valid)), grads = dp_grads(
+                    params, bx, by, bm, step_rng)
+            else:
+                (loss, (correct, valid)), grads = grad_fn(
+                    params, bx, by, bm, step_rng)
             if prox_mu > 0.0:
                 grads = tree_add(grads, tree_scale(tree_sub(params, global_params), prox_mu))
             if cfg.use_scaffold:

@@ -19,6 +19,7 @@ from .partition import (
     partition_class_samples_with_dirichlet_distribution,
     homo_partition,
 )
+from .dp import DPConfig, epsilon_for_training, rdp_epsilon
 from .robust import RobustAggregator, coordinate_median, norm_clip_update, trimmed_mean
 from .scheduler import balanced_client_schedule, dp_schedule, even_client_schedule
 
@@ -32,7 +33,7 @@ __all__ = [
     "non_iid_partition_with_dirichlet_distribution",
     "partition_class_samples_with_dirichlet_distribution",
     "homo_partition",
-    "RobustAggregator",
+    "DPConfig", "rdp_epsilon", "epsilon_for_training", "RobustAggregator",
     "coordinate_median",
     "norm_clip_update",
     "trimmed_mean",

@@ -1,0 +1,63 @@
+"""Differential-privacy accounting for the DP-SGD mechanism.
+
+Parity note: the reference's ``core/dp/__init__.py`` is an EMPTY stub
+(SURVEY.md §2.1 "Attack/DP: stubs") — this module implements the real thing.
+The mechanism lives in ``algorithms/local_sgd.py`` (``dp_l2_clip`` +
+``dp_noise_multiplier``: per-example gradient clipping, Gaussian noise on the
+batch sum); this module converts (noise multiplier, steps) into an (eps,
+delta) guarantee via Renyi-DP composition of the Gaussian mechanism.
+
+The bound used is the standard RDP of the Gaussian mechanism composed T
+times — RDP_alpha = T * alpha / (2 sigma^2) — converted with
+eps = min_alpha RDP_alpha + log(1/delta)/(alpha - 1). It does NOT apply
+subsampling amplification, so it is CONSERVATIVE (reported eps is an upper
+bound on the true privacy loss whenever batches are subsampled).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DPConfig:
+    l2_clip: float = 1.0
+    noise_multiplier: float = 1.0
+    delta: float = 1e-5
+
+
+def rdp_epsilon(
+    noise_multiplier: float,
+    steps: int,
+    delta: float = 1e-5,
+    orders: Optional[np.ndarray] = None,
+) -> float:
+    """(eps, delta)-DP upper bound after ``steps`` compositions of the
+    Gaussian mechanism with the given noise multiplier (sigma = multiplier
+    * sensitivity; sensitivity = the clip norm).
+
+    Conservative: no subsampling amplification (see module docstring).
+    Returns inf when noise_multiplier == 0.
+    """
+    if noise_multiplier <= 0:
+        return float("inf")
+    if orders is None:
+        orders = np.concatenate([
+            np.linspace(1.1, 10.9, 99), np.arange(11, 256, dtype=np.float64),
+        ])
+    rdp = steps * orders / (2.0 * noise_multiplier ** 2)
+    eps = rdp + np.log(1.0 / delta) / (orders - 1.0)
+    return float(np.min(eps))
+
+
+def epsilon_for_training(
+    noise_multiplier: float,
+    comm_rounds: int,
+    steps_per_round: int,
+    delta: float = 1e-5,
+) -> float:
+    """eps for a full FL run: every local DP-SGD step composes."""
+    return rdp_epsilon(noise_multiplier, comm_rounds * steps_per_round, delta)
