@@ -124,14 +124,18 @@ def make_local_update(
             "needs the clip (sensitivity); set dp_l2_clip to enable DP-SGD"
         )
     if has_batch_stats:
-        assert not cfg.use_scaffold, (
-            "SCAFFOLD control variates are defined on params only; "
-            "combine with GroupNorm models instead"
-        )
-        assert cfg.dp_l2_clip is None, (
-            "DP-SGD with BatchNorm is unsupported (running statistics leak "
-            "unclipped example information); use a GroupNorm model variant"
-        )
+        # hard errors, not asserts: silently proceeding would train
+        # non-private / non-SCAFFOLD while claiming otherwise (and asserts
+        # vanish under python -O)
+        if cfg.use_scaffold:
+            raise ValueError(
+                "SCAFFOLD control variates are defined on params only; "
+                "combine with GroupNorm models instead")
+        if cfg.dp_l2_clip is not None:
+            raise ValueError(
+                "DP-SGD with BatchNorm is unsupported (running statistics "
+                "leak unclipped example information); use a GroupNorm "
+                "model variant")
         return _make_bn_local_update(apply_fn, cfg, opt, prox_mu, needs_dropout)
 
     def local_update(global_params, client_state, data, rng) -> ClientOutput:

@@ -19,7 +19,14 @@ from .partition import (
     partition_class_samples_with_dirichlet_distribution,
     homo_partition,
 )
-from .dp import DPConfig, epsilon_for_training, rdp_epsilon
+from .dp import epsilon_for_training, rdp_epsilon
+from .security import (
+    FedMLAttacker,
+    gaussian_attack,
+    label_flip_data,
+    scale_attack,
+    sign_flip_attack,
+)
 from .robust import RobustAggregator, coordinate_median, norm_clip_update, trimmed_mean
 from .scheduler import balanced_client_schedule, dp_schedule, even_client_schedule
 
@@ -33,7 +40,9 @@ __all__ = [
     "non_iid_partition_with_dirichlet_distribution",
     "partition_class_samples_with_dirichlet_distribution",
     "homo_partition",
-    "DPConfig", "rdp_epsilon", "epsilon_for_training", "RobustAggregator",
+    "rdp_epsilon", "epsilon_for_training", "RobustAggregator",
+    "FedMLAttacker", "scale_attack", "sign_flip_attack", "gaussian_attack",
+    "label_flip_data",
     "coordinate_median",
     "norm_clip_update",
     "trimmed_mean",

@@ -16,17 +16,9 @@ bound on the true privacy loss whenever batches are subsampled).
 
 from __future__ import annotations
 
-import dataclasses
 from typing import Optional
 
 import numpy as np
-
-
-@dataclasses.dataclass(frozen=True)
-class DPConfig:
-    l2_clip: float = 1.0
-    noise_multiplier: float = 1.0
-    delta: float = 1e-5
 
 
 def rdp_epsilon(

@@ -1,0 +1,111 @@
+"""Adversarial-client attack harness.
+
+Parity-plus: the reference's ``core/security/fedml_attacker.py:1-4`` is a
+stub that returns ``(None, None)``; its actual robustness surface is the
+defense side only (``core/robustness``). Here the ATTACK side is functional
+too, so the defenses can be evaluated: attacks are pure functions on the
+stacked per-client update pytree (leading client axis C) — exactly what the
+simulators aggregate — selected by a boolean attacker mask. All jittable.
+
+Attacks implemented (standard FL threat models):
+- ``scale_attack`` — model replacement (Bagdasaryan et al.): the attacker
+  boosts its update by ~C/eta to survive averaging.
+- ``sign_flip_attack`` — gradient ascent by flipped updates.
+- ``gaussian_attack`` — random-noise updates (untargeted disruption).
+- ``label_flip_data`` — data-level label flipping (complements the backdoor
+  ``poison_clients`` in ``data/__init__.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PyTree = Any
+
+
+def _mask_bcast(mask: jax.Array, leaf: jax.Array) -> jax.Array:
+    return mask.reshape((-1,) + (1,) * (leaf.ndim - 1)).astype(leaf.dtype)
+
+
+def scale_attack(updates: PyTree, attacker_mask: jax.Array,
+                 boost: float = 10.0) -> PyTree:
+    """Model replacement: attackers' updates scaled by ``boost``."""
+    return jax.tree.map(
+        lambda u: u * (1.0 + (boost - 1.0) * _mask_bcast(attacker_mask, u)),
+        updates,
+    )
+
+
+def sign_flip_attack(updates: PyTree, attacker_mask: jax.Array,
+                     strength: float = 1.0) -> PyTree:
+    """Attackers ship the negated (scaled) honest update."""
+    return jax.tree.map(
+        lambda u: u * (1.0 - (1.0 + strength) * _mask_bcast(attacker_mask, u)),
+        updates,
+    )
+
+
+def gaussian_attack(updates: PyTree, attacker_mask: jax.Array, rng,
+                    std: float = 1.0) -> PyTree:
+    """Attackers replace their update with pure Gaussian noise."""
+    leaves, treedef = jax.tree.flatten(updates)
+    keys = jax.random.split(rng, len(leaves))
+    out = []
+    for leaf, key in zip(leaves, keys):
+        m = _mask_bcast(attacker_mask, leaf)
+        noise = std * jax.random.normal(key, leaf.shape, leaf.dtype)
+        out.append(leaf * (1 - m) + noise * m)
+    return jax.tree.unflatten(treedef, out)
+
+
+def label_flip_data(y: np.ndarray, num_classes: int) -> np.ndarray:
+    """Deterministic label flip y -> (num_classes - 1 - y)."""
+    return (num_classes - 1 - np.asarray(y)).astype(np.asarray(y).dtype)
+
+
+class FedMLAttacker:
+    """Reference API shell (``fedml_attacker.py``) made functional: holds an
+    attacker mask and applies the configured attack to stacked updates."""
+
+    ATTACK_TYPES = ("scale", "sign_flip", "gaussian")
+
+    def __init__(self, attack_type: str = "scale", attacker_ratio: float = 0.2,
+                 boost: float = 10.0, std: float = 1.0, seed: int = 0):
+        if attack_type not in self.ATTACK_TYPES:
+            hint = (" (label flipping is data-level: use label_flip_data "
+                    "on the attacker clients' labels)"
+                    if attack_type == "label_flip" else "")
+            raise ValueError(
+                f"unknown attack '{attack_type}'; one of {self.ATTACK_TYPES}"
+                + hint)
+        self.attack_type = attack_type
+        self.attacker_ratio = float(attacker_ratio)
+        self.boost = float(boost)
+        self.std = float(std)
+        self.seed = int(seed)
+        self._calls = 0
+
+    def attacker_mask(self, cohort_size: int) -> np.ndarray:
+        mask = np.zeros(cohort_size, np.float32)
+        if self.attacker_ratio <= 0.0:
+            return mask  # ratio 0 = clean baseline, truly no attacker
+        rng = np.random.default_rng(self.seed)
+        n = max(1, int(round(self.attacker_ratio * cohort_size)))
+        mask[rng.choice(cohort_size, n, replace=False)] = 1.0
+        return mask
+
+    def attack(self, updates: PyTree, cohort_size: int) -> PyTree:
+        mask = jnp.asarray(self.attacker_mask(cohort_size))
+        self._calls += 1
+        if self.attack_type == "scale":
+            return scale_attack(updates, mask, self.boost)
+        if self.attack_type == "sign_flip":
+            return sign_flip_attack(updates, mask)
+        # gaussian: fresh noise per call — the key advances with a counter so
+        # multi-round attacks are not a fixed-direction bias
+        rng = jax.random.fold_in(jax.random.PRNGKey(self.seed), self._calls)
+        return gaussian_attack(updates, mask, rng, self.std)

@@ -67,7 +67,8 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
             None if getattr(args, "dp_l2_clip", None) is None
             else float(args.dp_l2_clip)
         ),
-        dp_noise_multiplier=float(getattr(args, "dp_noise_multiplier", 0.0)),
+        dp_noise_multiplier=float(getattr(args, "dp_noise_multiplier", None)
+                                  or 0.0),
     )
     needs_dropout = getattr(args, "model", "lr") in ("cnn",)
     optimizer_name = str(getattr(args, "federated_optimizer", "FedAvg"))

@@ -1,0 +1,79 @@
+"""Attack harness vs robust aggregation: the stubbed reference attacker made
+functional (core/security.py) and evaluated against the defenses."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.core.robust import coordinate_median, norm_clip_stacked, trimmed_mean
+from fedml_tpu.core.security import (
+    FedMLAttacker,
+    gaussian_attack,
+    label_flip_data,
+    scale_attack,
+    sign_flip_attack,
+)
+
+
+def _honest_updates(C=10, d=32, seed=0):
+    rng = np.random.default_rng(seed)
+    base = rng.normal(size=d).astype(np.float32)
+    # honest clients: small perturbations of a common direction
+    return {"w": jnp.asarray(base[None] + 0.05 * rng.normal(size=(C, d)).astype(np.float32))}
+
+
+def _mean(stacked):
+    return jax.tree.map(lambda u: u.mean(axis=0), stacked)
+
+
+def test_scale_attack_breaks_mean_median_survives():
+    ups = _honest_updates()
+    honest_mean = _mean(ups)["w"]
+    mask = jnp.asarray(np.eye(10, dtype=np.float32)[0])  # client 0 attacks
+    attacked = scale_attack(ups, mask, boost=50.0)
+
+    naive = _mean(attacked)["w"]
+    med = coordinate_median(attacked)["w"]
+    err_naive = float(jnp.linalg.norm(naive - honest_mean))
+    err_median = float(jnp.linalg.norm(med - honest_mean))
+    assert err_naive > 5 * err_median
+    assert err_median < 0.5
+
+
+def test_sign_flip_attack_trimmed_mean_survives():
+    ups = _honest_updates()
+    honest_mean = _mean(ups)["w"]
+    mask = jnp.asarray((np.arange(10) < 2).astype(np.float32))  # 2 attackers
+    attacked = sign_flip_attack(ups, mask, strength=20.0)
+
+    naive = _mean(attacked)["w"]
+    trimmed = trimmed_mean(attacked, trim_ratio=0.2)["w"]
+    assert float(jnp.linalg.norm(naive - honest_mean)) > \
+        3 * float(jnp.linalg.norm(trimmed - honest_mean))
+
+
+def test_gaussian_attack_norm_clip_bounds_damage():
+    ups = _honest_updates()
+    mask = jnp.asarray(np.eye(10, dtype=np.float32)[3])
+    attacked = gaussian_attack(ups, mask, jax.random.PRNGKey(0), std=100.0)
+    clipped = norm_clip_stacked(attacked, norm_bound=8.0)  # honest norms ~5.7
+    # after clipping, no client's update norm exceeds the bound
+    norms = jnp.sqrt((clipped["w"] ** 2).sum(axis=1))
+    assert float(norms.max()) <= 8.0 + 1e-3
+    # honest clients below the bound are untouched
+    np.testing.assert_allclose(
+        np.asarray(clipped["w"][1]), np.asarray(attacked["w"][1]), atol=1e-6)
+
+
+def test_attacker_facade_and_label_flip():
+    atk = FedMLAttacker(attack_type="scale", attacker_ratio=0.3, boost=7.0, seed=1)
+    mask = atk.attacker_mask(10)
+    assert mask.sum() == 3
+    ups = _honest_updates()
+    out = atk.attack(ups, 10)["w"]
+    ratio = np.asarray(jnp.linalg.norm(out, axis=1) /
+                       jnp.linalg.norm(ups["w"], axis=1))
+    assert np.allclose(np.sort(ratio)[-3:], 7.0, atol=1e-4)
+
+    y = np.array([0, 1, 9])
+    np.testing.assert_array_equal(label_flip_data(y, 10), [9, 8, 0])
