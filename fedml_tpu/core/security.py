@@ -74,7 +74,8 @@ class FedMLAttacker:
     ATTACK_TYPES = ("scale", "sign_flip", "gaussian")
 
     def __init__(self, attack_type: str = "scale", attacker_ratio: float = 0.2,
-                 boost: float = 10.0, std: float = 1.0, seed: int = 0):
+                 boost: float = 10.0, std: float = 1.0, strength: float = 1.0,
+                 seed: int = 0):
         if attack_type not in self.ATTACK_TYPES:
             hint = (" (label flipping is data-level: use label_flip_data "
                     "on the attacker clients' labels)"
@@ -82,10 +83,14 @@ class FedMLAttacker:
             raise ValueError(
                 f"unknown attack '{attack_type}'; one of {self.ATTACK_TYPES}"
                 + hint)
+        if not 0.0 <= float(attacker_ratio) <= 1.0:
+            raise ValueError(
+                f"attacker_ratio must be in [0, 1], got {attacker_ratio}")
         self.attack_type = attack_type
         self.attacker_ratio = float(attacker_ratio)
         self.boost = float(boost)
         self.std = float(std)
+        self.strength = float(strength)
         self.seed = int(seed)
         self._calls = 0
 
@@ -104,7 +109,7 @@ class FedMLAttacker:
         if self.attack_type == "scale":
             return scale_attack(updates, mask, self.boost)
         if self.attack_type == "sign_flip":
-            return sign_flip_attack(updates, mask)
+            return sign_flip_attack(updates, mask, self.strength)
         # gaussian: fresh noise per call — the key advances with a counter so
         # multi-round attacks are not a fixed-direction bias
         rng = jax.random.fold_in(jax.random.PRNGKey(self.seed), self._calls)

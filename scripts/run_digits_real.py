@@ -1,0 +1,60 @@
+"""Real-data federated accuracy artifact for a zero-egress environment.
+
+sklearn's bundled handwritten-digits set (1797 real 8x8 images — the one
+genuinely real vision dataset available without network egress) federated
+across 10 clients, LR FedAvg. Unlike the synthetic stand-ins, the resulting
+accuracy is a real generalization number; the history JSON records it for
+the record (results/digits_real_history.json).
+
+Usage: python scripts/run_digits_real.py [--rounds N] [--hetero]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=60)
+    ap.add_argument("--hetero", action="store_true",
+                    help="Dirichlet(0.5) non-IID partition instead of IID")
+    opts = ap.parse_args()
+
+    import fedml_tpu
+    from fedml_tpu.simulation import build_simulator
+
+    args = fedml_tpu.init(config=dict(
+        dataset="digits", model="lr",
+        partition_method="hetero" if opts.hetero else "homo",
+        partition_alpha=0.5,
+        client_num_in_total=10, client_num_per_round=10,
+        comm_round=opts.rounds, learning_rate=0.3, epochs=1, batch_size=32,
+        frequency_of_the_test=10, random_seed=0,
+    ))
+    sim, apply_fn = build_simulator(args)
+    t0 = time.time()
+    hist = sim.run(apply_fn)
+    out = {
+        "dataset": "sklearn digits (REAL data, 1797 samples, 8x8)",
+        "partition": "dirichlet-0.5" if opts.hetero else "iid",
+        "config": {"clients": 10, "rounds": opts.rounds, "model": "lr",
+                   "lr": 0.3, "batch_size": 32},
+        "final_test_acc": hist[-1].get("test_acc"),
+        "wall_seconds": time.time() - t0,
+        "history": hist,
+    }
+    os.makedirs("results", exist_ok=True)
+    path = os.path.join(
+        "results",
+        f"digits_real_{'hetero' if opts.hetero else 'iid'}_history.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps({k: v for k, v in out.items() if k != "history"}))
+
+
+if __name__ == "__main__":
+    main()
