@@ -84,6 +84,21 @@ ServerUpdateFn = Callable[[PyTree, PyTree, PyTree], tuple]
 # (global_params, aggregated_update, server_state) -> (new_params, server_state)
 
 
+def weighted_mean(stacked_updates: PyTree, weights) -> PyTree:
+    """Sample-weighted mean over the leading client axis, accumulated in f32
+    (the reference pre-scale trick, ``nccl/base_framework/LocalAggregator.py:84``)
+    and cast back to each leaf's dtype. The default FL aggregation."""
+    import jax.numpy as jnp
+
+    w = weights.astype(jnp.float32)
+    total = jnp.maximum(w.sum(), 1.0)
+    return jax.tree.map(
+        lambda u: jnp.tensordot(w / total, u.astype(jnp.float32),
+                                axes=(0, 0)).astype(u.dtype),
+        stacked_updates,
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class FedAlgorithm:
     """A federated optimizer as pure functions (all jittable).

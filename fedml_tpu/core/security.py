@@ -74,8 +74,8 @@ class FedMLAttacker:
     ATTACK_TYPES = ("scale", "sign_flip", "gaussian")
 
     def __init__(self, attack_type: str = "scale", attacker_ratio: float = 0.2,
-                 boost: float = 10.0, std: float = 1.0, strength: float = 1.0,
-                 seed: int = 0):
+                 boost: float = 10.0, std: float = 1.0, *,
+                 strength: float = 1.0, seed: int = 0):
         if attack_type not in self.ATTACK_TYPES:
             hint = (" (label flipping is data-level: use label_flip_data "
                     "on the attacker clients' labels)"

@@ -87,6 +87,13 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
         max_width_buckets=int(getattr(args, "max_width_buckets", 4)),
     )
 
+    attack_type = getattr(args, "attack_type", None)
+    if attack_type and optimizer_name.lower() in ("hierarchicalfl", "decentralized"):
+        raise ValueError(
+            f"attack_type is wired into the FedSimulator aggregation path; "
+            f"the '{optimizer_name}' engine does not support injected "
+            f"attackers (running it clean would silently fake a robustness "
+            f"result)")
     # two-level and serverless variants use dedicated engines
     if optimizer_name.lower() == "hierarchicalfl":
         from ..algorithms import make_local_update
@@ -138,8 +145,53 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
         trim_ratio=float(getattr(args, "trim_ratio", 0.1)),
         dp_seed=int(getattr(args, "random_seed", 0)),
     )
+    if attack_type:
+        alg = _inject_attacker(alg, args)
     sim = FedSimulator(fed_data, alg, variables, sim_cfg, mesh=mesh)
     return sim, apply_fn
+
+
+def _inject_attacker(alg, args):
+    """Adversarial-client simulation: wrap aggregation so the configured
+    attack (core/security) corrupts the stacked updates BEFORE any defense
+    runs. Deterministic attacks only (scale/sign_flip) — aggregation is
+    traced once, so a gaussian attacker would freeze to one noise draw;
+    use the library API outside jit for that threat model."""
+    import dataclasses as _dc
+
+    import jax.numpy as jnp
+
+    from ..core.security import FedMLAttacker
+
+    attack_type = str(args.attack_type)
+    if attack_type not in ("scale", "sign_flip"):
+        raise ValueError(
+            f"simulator-injected attacks support scale/sign_flip, got "
+            f"'{attack_type}' (gaussian needs per-round rng; drive it via "
+            f"core.security outside the compiled round)")
+    if not getattr(alg, "update_is_params", True):
+        raise ValueError(
+            f"attack injection needs params-shaped client updates; "
+            f"'{alg.name}' ships a structured update (e.g. FedNova's "
+            f"tau) that the attack transforms would corrupt")
+    atk = FedMLAttacker(
+        attack_type,
+        attacker_ratio=float(getattr(args, "attacker_ratio", 0.2)),
+        boost=float(getattr(args, "attack_boost", 10.0)),
+        strength=float(getattr(args, "attack_strength", 1.0)),
+        seed=int(getattr(args, "random_seed", 0)),
+    )
+    base_agg = alg.aggregate
+
+    def attacked_aggregate(stacked_updates, weights):
+        attacked = atk.attack(stacked_updates, int(weights.shape[0]))
+        if base_agg is not None:
+            return base_agg(attacked, weights)
+        from ..core.algframe import weighted_mean
+
+        return weighted_mean(attacked, weights)
+
+    return _dc.replace(alg, aggregate=attacked_aggregate)
 
 
 class SimulatorSingleProcess:

@@ -200,18 +200,13 @@ class FedSimulator:
 
         def round_body(params, server_state, cohort, client_states, rng):
             outs = _cohort_outputs(alg, params, cohort, client_states, rng)
-            # weighted mean in f32 (reference pre-scale trick, LocalAggregator.py:84)
             w = outs.weight.astype(jnp.float32)
-            total = jnp.maximum(w.sum(), 1.0)
             if alg.aggregate is not None:
                 agg = alg.aggregate(outs.update, w)
             else:
-                agg = jax.tree.map(
-                    lambda u: jnp.tensordot(
-                        w / total, u.astype(jnp.float32), axes=(0, 0)
-                    ).astype(u.dtype),
-                    outs.update,
-                )
+                from ..core.algframe import weighted_mean
+
+                agg = weighted_mean(outs.update, w)
             new_params, new_server_state = alg.server_update(params, agg, server_state)
             # reduce metrics to ONE tiny vector inside the program: each
             # separate host read is a device round trip (expensive over a
