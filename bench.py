@@ -7,7 +7,8 @@ Measurement protocol:
 - a warm run over the SAME round range as a timed block pays compile +
   device-data upload (discarded) — sampling is round-indexed, so the warm
   run compiles exactly the cohort shapes the timed blocks will replay,
-- then 3 independent timed runs ("blocks") of N rounds each, measured
+- then 5 independent timed runs ("blocks") of N rounds each (after one
+  discarded burn-in block), measured
   WALL-TO-WALL around sim.run(): run() ends by materializing the final
   round's metric vector, whose value requires every dispatched executable
   to have retired — so the wall time is honest even on backends where
@@ -42,7 +43,7 @@ def main() -> None:
     import fedml_tpu
     from fedml_tpu.simulation import build_simulator
 
-    blocks, rounds_per_block = 3, 6
+    blocks, rounds_per_block = 5, 6
     args = fedml_tpu.init(config=dict(
         dataset="cifar10", model="resnet56", partition_method="hetero",
         partition_alpha=0.5, client_num_in_total=100, client_num_per_round=10,
@@ -66,8 +67,12 @@ def main() -> None:
     import time
 
     # warm: compile every cohort shape the timed blocks will replay
-    # (comm_round == rounds_per_block) + device-data upload
+    # (comm_round == rounds_per_block) + device-data upload; then one
+    # discarded burn-in block — the first post-compile block consistently
+    # runs ~20% slow (tunnel/chip warmup) and would skew a 3-block median
     assert args.comm_round == rounds_per_block
+    sim.run(apply_fn=None, log_fn=None)
+    sim.history.clear()
     sim.run(apply_fn=None, log_fn=None)
     block_rates = []
     for _ in range(blocks):
