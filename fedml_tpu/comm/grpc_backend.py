@@ -54,6 +54,59 @@ def build_ip_table(path_or_map: Union[str, Dict[int, str], None], size: int) -> 
     return table
 
 
+class GrpcTls:
+    """Mutual-TLS material for the WAN plane (the reference pins an
+    MLOps-issued cert for its control plane, ``core/mlops/mlops_configs.py:15``;
+    its gRPC data plane is insecure-only — this goes further with mTLS).
+
+    ``ca`` verifies peers; ``cert``/``key`` identify this process. With all
+    three set, both server and channels require client certificates.
+    ``override_authority`` lets tests/self-signed deployments dial by IP
+    while the cert names a hostname.
+    """
+
+    def __init__(self, ca_path: str, cert_path: str, key_path: str,
+                 override_authority: Optional[str] = None):
+        read = lambda p: open(p, "rb").read()  # noqa: E731
+        self.ca = read(ca_path)
+        self.cert = read(cert_path)
+        self.key = read(key_path)
+        self.override_authority = override_authority
+
+    @classmethod
+    def from_args(cls, args) -> Optional["GrpcTls"]:
+        ca = getattr(args, "grpc_ca_path", None)
+        cert = getattr(args, "grpc_cert_path", None)
+        key = getattr(args, "grpc_key_path", None)
+        if not (ca and cert and key):
+            if ca or cert or key:
+                raise ValueError(
+                    "partial gRPC TLS config: grpc_ca_path, grpc_cert_path "
+                    "and grpc_key_path must all be set (or none)")
+            return None
+        return cls(ca, cert, key,
+                   override_authority=getattr(args, "grpc_tls_authority", None))
+
+    def server_credentials(self):
+        return grpc.ssl_server_credentials(
+            [(self.key, self.cert)],
+            root_certificates=self.ca,
+            require_client_auth=True,
+        )
+
+    def channel_credentials(self):
+        return grpc.ssl_channel_credentials(
+            root_certificates=self.ca,
+            private_key=self.key,
+            certificate_chain=self.cert,
+        )
+
+    def channel_options(self):
+        if self.override_authority:
+            return [("grpc.ssl_target_name_override", self.override_authority)]
+        return []
+
+
 class GRPCCommManager(BaseCommunicationManager):
     def __init__(
         self,
@@ -63,10 +116,14 @@ class GRPCCommManager(BaseCommunicationManager):
         size: int = 1,
         ip_config: Union[str, Dict[int, str], None] = None,
         base_port: int = 8890,
+        tls: Optional["GrpcTls"] = None,
+        send_timeout: float = 300.0,
     ):
         self.rank = int(rank)
         self.size = int(size)
         self.base_port = int(base_port)
+        self.tls = tls
+        self.send_timeout = float(send_timeout)
         self.port = int(port) if port is not None else self.base_port + self.rank
         self.ip_table = build_ip_table(ip_config, size)
         if self.port != self.base_port + self.rank:
@@ -106,15 +163,26 @@ class GRPCCommManager(BaseCommunicationManager):
             options=_GRPC_OPTIONS,
         )
         self._server.add_generic_rpc_handlers((handler,))
-        self._server.add_insecure_port(f"{host}:{self.port}")
+        if self.tls is not None:
+            self._server.add_secure_port(
+                f"{host}:{self.port}", self.tls.server_credentials())
+        else:
+            self._server.add_insecure_port(f"{host}:{self.port}")
         self._server.start()
-        logging.info("grpc server started: rank %d @ %s:%d", rank, host, self.port)
+        logging.info("grpc server started: rank %d @ %s:%d (tls=%s)",
+                     rank, host, self.port, self.tls is not None)
 
     def _stub(self, receiver_id: int):
         if receiver_id not in self._channels:
             entry = self.ip_table[receiver_id]
             target = entry if ":" in entry else f"{entry}:{self.base_port + receiver_id}"
-            self._channels[receiver_id] = grpc.insecure_channel(target, options=_GRPC_OPTIONS)
+            if self.tls is not None:
+                channel = grpc.secure_channel(
+                    target, self.tls.channel_credentials(),
+                    options=_GRPC_OPTIONS + self.tls.channel_options())
+            else:
+                channel = grpc.insecure_channel(target, options=_GRPC_OPTIONS)
+            self._channels[receiver_id] = channel
         return self._channels[receiver_id].unary_unary(
             f"/{SERVICE_NAME}/{METHOD_SEND}",
             request_serializer=None,
@@ -122,7 +190,11 @@ class GRPCCommManager(BaseCommunicationManager):
         )
 
     def send_message(self, msg: Message) -> None:
-        self._stub(msg.get_receiver_id())(msg.to_bytes(), wait_for_ready=True)
+        # wait_for_ready rides out transient reconnects, but the deadline
+        # bounds PERSISTENT failures (e.g. a TLS handshake that can never
+        # succeed) — without it a misconfigured peer stalls the run silently
+        self._stub(msg.get_receiver_id())(
+            msg.to_bytes(), wait_for_ready=True, timeout=self.send_timeout)
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)

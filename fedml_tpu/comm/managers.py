@@ -26,13 +26,14 @@ def create_comm_backend(backend: str, rank: int, size: int, args=None, **kw) -> 
 
         return LoopbackCommManager(rank=rank, size=size, hub=kw.get("hub"))
     if backend == constants.COMM_BACKEND_GRPC:
-        from .grpc_backend import GRPCCommManager
+        from .grpc_backend import GRPCCommManager, GrpcTls
 
         return GRPCCommManager(
             rank=rank,
             size=size,
             ip_config=kw.get("ip_config") or getattr(args, "grpc_ipconfig_path", None),
             base_port=int(kw.get("base_port") or getattr(args, "grpc_base_port", 8890)),
+            tls=kw.get("tls") or GrpcTls.from_args(args),
         )
     if backend in (constants.COMM_BACKEND_MQTT_S3,
                    constants.COMM_BACKEND_MQTT_S3_MNN):
