@@ -19,7 +19,7 @@ a gRPC deployment would exchange.
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict, List, Tuple
+from typing import Any, Callable, Dict, List
 
 import jax
 import jax.numpy as jnp

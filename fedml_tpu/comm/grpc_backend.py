@@ -17,7 +17,6 @@ import csv
 import logging
 import os
 import queue
-import threading
 from concurrent import futures
 from typing import Dict, List, Optional, Union
 

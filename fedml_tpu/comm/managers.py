@@ -11,7 +11,7 @@ construction via a small factory, and a loopback backend for in-process tests
 from __future__ import annotations
 
 import logging
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict
 
 from .. import constants
 from .base import BaseCommunicationManager, Observer

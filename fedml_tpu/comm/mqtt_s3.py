@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import logging
 import queue
-import threading
 import uuid
 from typing import List, Optional
 

@@ -14,7 +14,7 @@ from the cross-silo server manager (the reference duplicates it).
 from __future__ import annotations
 
 import os
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp

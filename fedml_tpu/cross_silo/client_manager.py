@@ -10,7 +10,7 @@ reference's weighted param mean but half the numerical drift in bf16.
 from __future__ import annotations
 
 import logging
-from typing import Optional
+
 
 from ..comm import Message, ClientManager
 from .message_define import MyMessage

@@ -18,7 +18,7 @@ Python loops (SURVEY.md §7 hard parts); on TPU we pad + mask instead.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, NamedTuple, Sequence, Tuple
+from typing import Dict, List, NamedTuple, Sequence, Tuple
 
 import numpy as np
 

@@ -18,7 +18,7 @@ from __future__ import annotations
 import gzip
 import os
 import pickle
-from typing import Dict, Optional, Tuple
+from typing import Optional
 
 import numpy as np
 

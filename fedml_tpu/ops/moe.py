@@ -11,7 +11,7 @@ follows Shazeer et al. (fraction-routed x mean-gate dot product).
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Tuple
 
 import flax.linen as nn
 import jax

@@ -14,7 +14,7 @@ loops nodes in Python and exchanges deepcopied state dicts.
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List
 
 import jax
 import jax.numpy as jnp

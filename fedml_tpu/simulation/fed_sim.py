@@ -28,9 +28,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core.algframe import ClientOutput, FedAlgorithm
+from ..core.algframe import FedAlgorithm
 from ..data.federated import FederatedData
-from ..algorithms.local_sgd import make_eval_fn, tree_scale
+from ..algorithms.local_sgd import make_eval_fn
 from ..parallel.mesh import AXIS_CLIENT
 from ..parallel.sharding import replicated, shard_along
 

@@ -10,7 +10,7 @@ run mid-training.
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, Optional
+from typing import Any, Optional
 
 PyTree = Any
 

@@ -5,7 +5,7 @@ from __future__ import annotations
 
 import logging
 import sys
-from typing import Optional
+
 
 def get_logger(role: str = "Server", rank: int = 0, level: int = logging.INFO) -> logging.Logger:
     name = f"fedml_tpu.{role}.{rank}"
