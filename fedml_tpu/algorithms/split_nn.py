@@ -23,7 +23,6 @@ from typing import Any, Callable, Dict, List
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 PyTree = Any
 

@@ -17,7 +17,6 @@ import os
 from typing import Any, Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from ..comm.message import pack_payload, unpack_payload

@@ -34,7 +34,9 @@ NEG_INF = float(jnp.finfo(jnp.float32).min)
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                   block_k: int, causal: bool, scale: float):
     """Grid: (B*H, T // block_q). Refs (leading grid-block dim of 1):
-    q (1, block_q, Dh), k/v (1, T, Dh), o (1, block_q, Dh), lse (1, block_q)."""
+    q (1, block_q, Dh), k/v (1, T, Dh), o (1, block_q, Dh),
+    lse (1, 1, block_q) — the singleton middle dim keeps the block's last
+    two dims Mosaic-legal ((1, block_q): dim -2 equals the array dim)."""
     block_q = q_ref.shape[1]
     Dh = q_ref.shape[2]
     T = k_ref.shape[1]
@@ -79,7 +81,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         m, l, acc = jax.lax.fori_loop(0, n_kblocks, body, (m0, l0, acc0))
     l_safe = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l_safe))[:, 0]
+    lse_ref[0, 0] = (m + jnp.log(l_safe))[:, 0]
 
 
 def _bh_layout(t):
@@ -92,7 +94,9 @@ def _flash_forward(
     q: jax.Array, k: jax.Array, v: jax.Array, causal: bool,
     block_q: int, block_k: int, interpret: bool,
 ):
-    """q/k/v: (B, T, H, Dh) -> (out (B, T, H, Dh), lse (B*H, T) f32)."""
+    """q/k/v: (B, T, H, Dh) -> (out (B, T, H, Dh), lse (B*H, 1, T) f32).
+    lse carries a singleton middle dim so its blocks satisfy Mosaic's
+    last-two-dims rule (divisible by (8, 128) or equal to the array dims)."""
     B, T, H, Dh = q.shape
     scale = 1.0 / (Dh ** 0.5)
     qb, kb, vb = _bh_layout(q), _bh_layout(k), _bh_layout(v)
@@ -101,7 +105,7 @@ def _flash_forward(
         functools.partial(_flash_kernel, block_k=block_k, causal=causal, scale=scale),
         out_shape=(
             jax.ShapeDtypeStruct((B * H, T, Dh), q.dtype),
-            jax.ShapeDtypeStruct((B * H, T), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, 1, T), jnp.float32),
         ),
         grid=grid,
         in_specs=[
@@ -111,7 +115,7 @@ def _flash_forward(
         ],
         out_specs=(
             pl.BlockSpec((1, block_q, Dh), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda bh, qi: (bh, qi)),
+            pl.BlockSpec((1, 1, block_q), lambda bh, qi: (bh, 0, qi)),
         ),
         interpret=interpret,
     )(qb, kb, vb)
@@ -129,8 +133,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     q_start = qi * block_q
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]          # (block_q, 1)
-    delta = delta_ref[0][:, None]      # (block_q, 1)
+    lse = lse_ref[0, 0][:, None]       # (block_q, 1)
+    delta = delta_ref[0, 0][:, None]   # (block_q, 1)
     n_kblocks = T // block_k
 
     def body(kb, dq):
@@ -177,8 +181,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q_start = qb * block_q
         q = q_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(q_start, block_q), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(q_start, block_q)][:, None]
-        delta = delta_ref[0, pl.ds(q_start, block_q)][:, None]
+        lse = lse_ref[0, 0, pl.ds(q_start, block_q)][:, None]
+        delta = delta_ref[0, 0, pl.ds(q_start, block_q)][:, None]
         s = scale * jax.lax.dot_general(
             q, k_blk, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
         if causal:
@@ -208,19 +212,19 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
-    """Blockwise dq/dk/dv; q/k/v/out/g (B, T, H, Dh), lse (B*H, T)."""
+    """Blockwise dq/dk/dv; q/k/v/out/g (B, T, H, Dh), lse (B*H, 1, T)."""
     B, T, H, Dh = q.shape
     scale = 1.0 / (Dh ** 0.5)
     qb, kb, vb = _bh_layout(q), _bh_layout(k), _bh_layout(v)
     dob = _bh_layout(g)
     # delta_i = sum_d dO_id * O_id — O(T*Dh), plain XLA (fuses into one pass)
     delta = jnp.sum(dob.astype(jnp.float32) * _bh_layout(out).astype(jnp.float32),
-                    axis=-1)  # (B*H, T)
+                    axis=-1)[:, None, :]  # (B*H, 1, T), lse's layout
 
     qkv_spec = lambda blk: pl.BlockSpec((1, blk, Dh), lambda bh, i: (bh, i, 0))  # noqa: E731
     full_spec = pl.BlockSpec((1, T, Dh), lambda bh, i: (bh, 0, 0))
-    row_spec = lambda blk: pl.BlockSpec((1, blk), lambda bh, i: (bh, i))  # noqa: E731
-    full_row = pl.BlockSpec((1, T), lambda bh, i: (bh, 0))
+    row_spec = lambda blk: pl.BlockSpec((1, 1, blk), lambda bh, i: (bh, 0, i))  # noqa: E731
+    full_row = pl.BlockSpec((1, 1, T), lambda bh, i: (bh, 0, 0))
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, block_k=block_k, causal=causal, scale=scale),
