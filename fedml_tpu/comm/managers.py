@@ -35,6 +35,15 @@ def create_comm_backend(backend: str, rank: int, size: int, args=None, **kw) -> 
             base_port=int(kw.get("base_port") or getattr(args, "grpc_base_port", 8890)),
             tls=kw.get("tls") or GrpcTls.from_args(args),
         )
+    if backend == constants.COMM_BACKEND_TRPC:
+        from .trpc_backend import TRPCCommManager
+
+        return TRPCCommManager(
+            rank=rank,
+            size=size,
+            ip_config=kw.get("ip_config") or getattr(args, "trpc_master_config_path", None),
+            base_port=int(kw.get("base_port") or getattr(args, "trpc_base_port", 9890)),
+        )
     if backend in (constants.COMM_BACKEND_MQTT_S3,
                    constants.COMM_BACKEND_MQTT_S3_MNN):
         from .mqtt_s3 import MqttS3CommManager, MqttS3MnnCommManager

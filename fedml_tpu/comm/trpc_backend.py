@@ -1,0 +1,322 @@
+"""TRPC backend: tensor-native RPC over raw TCP sockets.
+
+Parity: reference ``core/distributed/communication/trpc/trpc_comm_manager.py:26``
+— the torch TensorPipe RPC backend (16 worker threads, 1800 s timeout, CSV
+master config) used as the cross-silo alternative to gRPC, whose selling point
+is *tensor-aware zero-copy transport*. TensorPipe is a torch C++ dependency;
+the TPU-native equivalent keeps the property that matters — tensors ride the
+wire as raw buffers, never re-encoded — on plain sockets:
+
+- **Framing**: one message = ``magic | u64 header_len | header | tensor bytes``.
+  The header is msgpack of the params dict with every ndarray leaf swapped for
+  a ``{"__t__": i}`` placeholder plus a spec table ``(dtype, shape, nbytes)``.
+- **Send** walks the pytree once and hands the socket the original array
+  buffers (``sendmsg`` scatter-gather) — zero serialization copies of tensor
+  payloads (msgpack touches only the small metadata header).
+- **Receive** allocates each tensor and reads the wire straight into it
+  (``recv_into``) — zero-copy on the way in, and the arrays arrive writable
+  (the msgpack codec path must pay a defensive copy for its read-only
+  ``frombuffer`` views; this backend never creates a read-only view at all).
+- Persistent connections per peer (dial once, like TensorPipe pipes), a
+  listener thread + one reader thread per inbound pipe, send serialized per
+  peer with a lock.
+
+The reference embeds a latency micro-benchmark in the manager
+(``trpc_comm_manager.py:160-225``); :func:`measure_roundtrip` is that harness.
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import msgpack
+import numpy as np
+
+from .base import BaseCommunicationManager, Observer
+from .grpc_backend import build_ip_table
+from .message import Message, _dtype_token, _resolve_dtype
+
+_MAGIC = b"FTRP\x01"
+_HDR = struct.Struct(">Q")  # header length
+_SEND_TIMEOUT_S = 1800.0  # reference trpc_comm_manager.py: rpc timeout 1800s
+_EXT_TENSOR_REF = 43  # msgpack ExtType marking a tensor slot in the meta tree
+
+
+class _TensorRef:
+    """Decoded tensor placeholder — an ExtType can never collide with user
+    data (a plain dict key like ``"__t__"`` could, and did in review)."""
+
+    __slots__ = ("idx",)
+
+    def __init__(self, idx: int):
+        self.idx = idx
+
+
+def _flatten_tensors(obj: Any, specs: List[Tuple[str, tuple, int]],
+                     buffers: List[memoryview]) -> Any:
+    """Replace ndarray leaves with ExtType placeholders; collect specs +
+    raw buffers."""
+    if isinstance(obj, np.ndarray):
+        arr = np.ascontiguousarray(obj)
+        idx = len(specs)
+        specs.append((_dtype_token(arr.dtype), arr.shape, arr.nbytes))
+        # ml_dtypes arrays (bfloat16/...) reject the buffer protocol; a uint8
+        # view exposes the same memory without a copy
+        buffers.append(memoryview(arr.view(np.uint8)).cast("B"))
+        return msgpack.ExtType(_EXT_TENSOR_REF, struct.pack(">I", idx))
+    if hasattr(obj, "__array__") and not isinstance(obj, (bool, int, float, str, bytes)):
+        return _flatten_tensors(np.asarray(obj), specs, buffers)
+    if isinstance(obj, dict):
+        return {k: _flatten_tensors(v, specs, buffers) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_flatten_tensors(v, specs, buffers) for v in obj]
+    return obj
+
+
+def _ref_hook(code: int, data: bytes):
+    if code == _EXT_TENSOR_REF:
+        return _TensorRef(struct.unpack(">I", data)[0])
+    return msgpack.ExtType(code, data)
+
+
+def _unflatten_tensors(obj: Any, tensors: List[np.ndarray]) -> Any:
+    if isinstance(obj, _TensorRef):
+        return tensors[obj.idx]
+    if isinstance(obj, dict):
+        return {k: _unflatten_tensors(v, tensors) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unflatten_tensors(v, tensors) for v in obj]
+    return obj
+
+
+# sendmsg accepts at most IOV_MAX (1024 on Linux) buffers per call, and a
+# short write can stop anywhere inside any buffer — both bit in review with
+# model-sized payloads. This loop batches the iovec and resumes from the
+# exact byte where the kernel stopped.
+_IOV_BATCH = 512
+
+
+def sendmsg_all(sock: socket.socket, chunks: List[Union[bytes, memoryview]]) -> None:
+    views = [c if isinstance(c, memoryview) else memoryview(c) for c in chunks]
+    i, off = 0, 0
+    while i < len(views):
+        batch = [views[i][off:]]
+        batch.extend(views[i + 1:i + _IOV_BATCH])
+        sent = sock.sendmsg(batch)
+        while sent > 0:
+            rem = len(views[i]) - off
+            if sent >= rem:
+                sent -= rem
+                i += 1
+                off = 0
+                if i == len(views):
+                    assert sent == 0
+                    break
+            else:
+                off += sent
+                sent = 0
+
+
+def encode_frames(params: Dict[str, Any]) -> List[Union[bytes, memoryview]]:
+    """Message params -> list of wire chunks (header bytes + tensor views)."""
+    specs: List[Tuple[str, tuple, int]] = []
+    buffers: List[memoryview] = []
+    meta = _flatten_tensors(params, specs, buffers)
+    header = msgpack.packb({"meta": meta, "specs": specs}, strict_types=False)
+    return [_MAGIC, _HDR.pack(len(header)), header] + buffers
+
+
+def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    got = 0
+    while got < len(view):
+        n = sock.recv_into(view[got:])
+        if n == 0:
+            raise ConnectionError("peer closed mid-frame")
+        got += n
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    _recv_exact_into(sock, memoryview(buf))
+    return bytes(buf)
+
+
+def read_frame(sock: socket.socket) -> Optional[Dict[str, Any]]:
+    """Read one framed message; None on clean EOF before a frame starts."""
+    try:
+        magic = _recv_exact(sock, len(_MAGIC))
+    except (ConnectionError, OSError):
+        return None
+    if magic != _MAGIC:
+        raise ValueError(f"bad frame magic {magic!r}")
+    (hlen,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    header = msgpack.unpackb(_recv_exact(sock, hlen), strict_map_key=False,
+                             ext_hook=_ref_hook)
+    tensors: List[np.ndarray] = []
+    for dtype_str, shape, nbytes in header["specs"]:
+        arr = np.empty(tuple(shape), dtype=_resolve_dtype(dtype_str))
+        _recv_exact_into(sock, memoryview(arr.view(np.uint8)).cast("B"))
+        assert arr.nbytes == nbytes
+        tensors.append(arr)
+    return _unflatten_tensors(header["meta"], tensors)
+
+
+class TRPCCommManager(BaseCommunicationManager):
+    """Reference ``TRPCCommManager:26`` surface over the tensor-socket pipe."""
+
+    def __init__(
+        self,
+        rank: int = 0,
+        size: int = 1,
+        ip_config: Union[str, Dict[int, str], None] = None,
+        base_port: int = 9890,
+        host: str = "0.0.0.0",
+        port: Optional[int] = None,
+    ):
+        self.rank = int(rank)
+        self.size = int(size)
+        self.base_port = int(base_port)
+        self.port = int(port) if port is not None else self.base_port + self.rank
+        self.ip_table = build_ip_table(ip_config, size)
+        self._observers: List[Observer] = []
+        self._pipes: Dict[int, socket.socket] = {}
+        self._send_locks: Dict[int, threading.Lock] = {}
+        self._dial_lock = threading.Lock()
+        import queue
+
+        self._inbox: "queue.Queue[Optional[Message]]" = queue.Queue()
+        self._stopping = threading.Event()
+        self._listener = socket.create_server((host, self.port), backlog=size + 4)
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"trpc-accept-{rank}", daemon=True
+        )
+        self._accept_thread.start()
+        logging.info("trpc pipe listening: rank %d @ %s:%d", rank, host, self.port)
+
+    # --- wire ---------------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                conn, _addr = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(
+                target=self._reader_loop, args=(conn,),
+                name=f"trpc-read-{self.rank}", daemon=True,
+            ).start()
+
+    def _reader_loop(self, conn: socket.socket) -> None:
+        while not self._stopping.is_set():
+            try:
+                params = read_frame(conn)
+            except (ValueError, OSError) as e:
+                logging.warning("trpc rank %d: dropping pipe: %s", self.rank, e)
+                params = None
+            if params is None:
+                conn.close()
+                return
+            msg = Message()
+            msg.init(params)
+            self._inbox.put(msg)
+
+    def _pipe(self, receiver_id: int) -> socket.socket:
+        with self._dial_lock:
+            sock = self._pipes.get(receiver_id)
+            if sock is None:
+                entry = self.ip_table[receiver_id]
+                if ":" in entry:
+                    h, p = entry.rsplit(":", 1)
+                    target = (h, int(p))
+                else:
+                    target = (entry, self.base_port + receiver_id)
+                sock = socket.create_connection(target, timeout=_SEND_TIMEOUT_S)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._pipes[receiver_id] = sock
+                # setdefault: the reconnect path runs while the sender still
+                # holds this receiver's lock — replacing it would let a second
+                # thread interleave frames on the fresh socket
+                self._send_locks.setdefault(receiver_id, threading.Lock())
+            return sock
+
+    # --- BaseCommunicationManager -------------------------------------------
+    def send_message(self, msg: Message) -> None:
+        receiver = msg.get_receiver_id()
+        sock = self._pipe(receiver)
+        chunks = encode_frames(msg.get_params())
+        with self._send_locks[receiver]:
+            # scatter-gather send: tensor buffers go to the kernel as-is
+            try:
+                sendmsg_all(sock, chunks)
+            except OSError:
+                # one reconnect: the peer may have restarted between rounds
+                with self._dial_lock:
+                    self._pipes.pop(receiver, None)
+                sock = self._pipe(receiver)
+                sendmsg_all(sock, chunks)
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        if observer in self._observers:
+            self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        while True:
+            msg = self._inbox.get()
+            if msg is None:
+                break
+            for observer in list(self._observers):
+                observer.receive_message(msg.get_type(), msg)
+
+    def stop_receive_message(self) -> None:
+        self._stopping.set()
+        self._inbox.put(None)
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._dial_lock:
+            for sock in self._pipes.values():
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._pipes.clear()
+
+
+def measure_roundtrip(
+    mgr_a: TRPCCommManager,
+    mgr_b: TRPCCommManager,
+    sizes: Tuple[int, ...] = (1_000, 100_000, 1_000_000),
+    repeats: int = 5,
+) -> Dict[int, float]:
+    """Latency harness (reference embeds one in ``trpc_comm_manager.py:160-225``):
+    A sends a float32 tensor of ``n`` elements to B, B echoes it back; reports
+    median round-trip seconds per size. Drives the sockets directly (no
+    observer loop) so it measures transport, not dispatch."""
+    results: Dict[int, float] = {}
+    for n in sizes:
+        payload = np.arange(n, dtype=np.float32)
+        times = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            msg = Message(type="bench", sender_id=mgr_a.rank, receiver_id=mgr_b.rank)
+            msg.add_params("tensor", payload)
+            mgr_a.send_message(msg)
+            got = mgr_b._inbox.get(timeout=30)
+            echo = Message(type="echo", sender_id=mgr_b.rank, receiver_id=mgr_a.rank)
+            echo.add_params("tensor", got.get("tensor"))
+            mgr_b.send_message(echo)
+            back = mgr_a._inbox.get(timeout=30)
+            times.append(time.perf_counter() - t0)
+            np.testing.assert_array_equal(back.get("tensor"), payload)
+        times.sort()
+        results[n] = times[len(times) // 2]
+    return results

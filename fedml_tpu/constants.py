@@ -24,6 +24,7 @@ CROSS_SILO_SCENARIO_HIERARCHICAL = "hierarchical"
 # --- communication backends (WAN / control plane) ---------------------------
 COMM_BACKEND_LOOPBACK = "LOOPBACK"   # in-process, deterministic (tests)
 COMM_BACKEND_GRPC = "GRPC"
+COMM_BACKEND_TRPC = "TRPC"           # tensor-socket pipes (TensorPipe parity)
 COMM_BACKEND_MQTT_S3 = "MQTT_S3"     # pub/sub control plane + blob store payloads
 COMM_BACKEND_MQTT_S3_MNN = "MQTT_S3_MNN"  # same planes; payload = device model FILES
 COMM_BACKEND_TPU = "TPU"             # collective plane inside a pod
