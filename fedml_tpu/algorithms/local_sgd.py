@@ -25,7 +25,12 @@ import jax.numpy as jnp
 import optax
 
 from ..core.algframe import ClientOutput
-from ..ops.losses import masked_accuracy, masked_softmax_cross_entropy
+from ..ops.losses import (
+    masked_accuracy,
+    masked_mse,
+    masked_softmax_cross_entropy,
+    masked_within_tolerance,
+)
 
 PyTree = Any
 
@@ -66,6 +71,10 @@ class LocalTrainConfig:
     # mechanism (accounting in fedml_tpu.core.dp).
     dp_l2_clip: Optional[float] = None
     dp_noise_multiplier: float = 0.0
+    # "ce" (classification/per-token) | "mse" (regression — FedGraphNN
+    # moleculenet property regression); mse reports within-0.5 hits as the
+    # correct/valid pair so regression rides the same metric plumbing
+    loss_kind: str = "ce"
 
     def make_optimizer(self) -> optax.GradientTransformation:
         chain = []
@@ -80,14 +89,35 @@ class LocalTrainConfig:
         return optax.chain(*chain)
 
 
-def make_loss_fn(apply_fn: Callable, needs_dropout: bool = False) -> Callable:
+def infer_loss_kind(args, fed_data) -> str:
+    """Resolve the loss family for a (config, dataset) pair: an explicit
+    ``args.loss_kind`` wins; otherwise float targets mean regression (mse),
+    integer targets mean classification (ce). Keyed on the DATA, not the
+    model name, so any regression pairing gets the right loss."""
+    lk = getattr(args, "loss_kind", None)
+    if lk:
+        return str(lk)
+    import numpy as np
+
+    y = np.asarray(fed_data.train_data_global.y)
+    return "mse" if np.issubdtype(y.dtype, np.floating) else "ce"
+
+
+def make_loss_fn(apply_fn: Callable, needs_dropout: bool = False,
+                 loss_kind: str = "ce") -> Callable:
     """(params, x, y, mask, rng) -> (loss, (correct, valid)) with masking."""
+    if loss_kind not in ("ce", "mse"):
+        raise ValueError(f"unknown loss_kind '{loss_kind}'")
 
     def loss_fn(params, x, y, mask, rng):
         kwargs = {"rngs": {"dropout": rng}} if needs_dropout else {}
-        logits = apply_fn(params, x, train=True, **kwargs)
-        loss = masked_softmax_cross_entropy(logits, y, mask)
-        correct, valid = masked_accuracy(logits, y, mask)
+        out = apply_fn(params, x, train=True, **kwargs)
+        if loss_kind == "mse":
+            loss = masked_mse(out, y, mask)
+            correct, valid = masked_within_tolerance(out, y, mask)
+        else:
+            loss = masked_softmax_cross_entropy(out, y, mask)
+            correct, valid = masked_accuracy(out, y, mask)
         return loss, (correct, valid)
 
     return loss_fn
@@ -115,7 +145,7 @@ def make_local_update(
     keys, BN buffers included).
     """
     opt = cfg.make_optimizer()
-    loss_fn = make_loss_fn(apply_fn, needs_dropout)
+    loss_fn = make_loss_fn(apply_fn, needs_dropout, cfg.loss_kind)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
     prox_mu = 0.0 if cfg.prox_mu is None else cfg.prox_mu
     if cfg.dp_noise_multiplier > 0.0 and cfg.dp_l2_clip is None:
@@ -127,6 +157,10 @@ def make_local_update(
         # hard errors, not asserts: silently proceeding would train
         # non-private / non-SCAFFOLD while claiming otherwise (and asserts
         # vanish under python -O)
+        if cfg.loss_kind != "ce":
+            raise ValueError(
+                "loss_kind='mse' with BatchNorm models is unwired; use a "
+                "GroupNorm variant for regression")
         if cfg.use_scaffold:
             raise ValueError(
                 "SCAFFOLD control variates are defined on params only; "
@@ -321,7 +355,7 @@ def _make_bn_local_update(
     return local_update
 
 
-def make_eval_fn(apply_fn: Callable) -> Callable:
+def make_eval_fn(apply_fn: Callable, loss_kind: str = "ce") -> Callable:
     """Batched global eval: (params, x, y, mask) -> (loss_sum, correct, count).
 
     ``mask`` is a per-example validity mask so the last (padded) eval batch
@@ -329,9 +363,13 @@ def make_eval_fn(apply_fn: Callable) -> Callable:
     """
 
     def eval_fn(params, x, y, mask):
-        logits = apply_fn(params, x, train=False)
-        loss = masked_softmax_cross_entropy(logits, y, mask)
-        correct, valid = masked_accuracy(logits, y, mask)
+        out = apply_fn(params, x, train=False)
+        if loss_kind == "mse":
+            loss = masked_mse(out, y, mask)
+            correct, valid = masked_within_tolerance(out, y, mask)
+        else:
+            loss = masked_softmax_cross_entropy(out, y, mask)
+            correct, valid = masked_accuracy(out, y, mask)
         return loss * valid, correct, valid
 
     return eval_fn

@@ -30,8 +30,13 @@ def non_iid_partition_with_dirichlet_distribution(
     net_dataidx_map: Dict[int, List[int]] = {}
     K = classes
     N = len(label_list)
+    # reference parity: retry until every client holds >= 10 samples
+    # (noniid_partition.py:14). When the dataset itself cannot give every
+    # client 10 (N // client_num < 10, e.g. tiny test fixtures), that loop
+    # would spin forever — degrade the target to what is feasible.
+    target = min(10, max(1, N // client_num))
     min_size = 0
-    while min_size < 10:
+    while min_size < target:
         idx_batch: List[List[int]] = [[] for _ in range(client_num)]
         if task == "segmentation":
             # label_list here is (classes, samples) of per-class presence

@@ -41,12 +41,15 @@ def _assemble(args, mesh=None):
     def apply_fn(vars_, x, train=False, rngs=None, mutable=False):
         return model.apply(vars_, x, train=train, rngs=rngs, mutable=mutable)
 
+    from ..algorithms.local_sgd import infer_loss_kind
+
     cfg = LocalTrainConfig(
         lr=float(getattr(args, "learning_rate", 0.03)),
         epochs=int(getattr(args, "epochs", 1)),
         client_optimizer=str(getattr(args, "client_optimizer", "sgd")),
         momentum=float(getattr(args, "momentum", 0.0)),
         weight_decay=float(getattr(args, "weight_decay", 0.0)),
+        loss_kind=infer_loss_kind(args, fed_data),
     )
     local_update = make_local_update(
         apply_fn, cfg, has_batch_stats="batch_stats" in variables

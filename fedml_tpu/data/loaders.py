@@ -145,6 +145,7 @@ def load_partition_data(
     ``small`` shrinks the synthetic fallback for tests.
     """
     scale = 0.02 if small else 1.0
+    part_labels = None  # branches may override the partition label
     if dataset in ("mnist", "femnist"):
         from . import leaf
 
@@ -332,6 +333,165 @@ def load_partition_data(
 
         train, test = gen_seg(n_tr, rng), gen_seg(n_te, rng)
         class_num = 2
+    elif dataset in ("seq_tagging", "wikiner", "w_nut"):
+        # FedNLP sequence tagging (reference app/fednlp/seq_tagging: NER over
+        # W-NUT/wikiner). Synthetic stand-in with a CONTEXTUAL tag rule —
+        # tag_t = f(tok_t, tok_{t-1}) — so attention over neighbors, not the
+        # embedding alone, is what solves it.
+        n_tags, vocab = 9, 128
+        seq_len = 32 if small else 64
+        n_tr, n_te = (max(int(3394 * scale), 256), max(int(1287 * scale), 64))
+
+        def gen_tag(n, s):
+            r = np.random.default_rng(s)
+            x = r.integers(0, vocab, (n, seq_len)).astype(np.int32)
+            prev = np.concatenate([np.zeros((n, 1), np.int64), x[:, :-1]], axis=1)
+            y = (((x % 3) + 3 * (prev % 3)) % n_tags).astype(np.int32)
+            return ArrayPair(x, y)
+
+        train, test = gen_tag(n_tr, 71), gen_tag(n_te, 72)
+        class_num = n_tags
+    elif dataset in ("span_extraction", "squad"):
+        # FedNLP span extraction (reference app/fednlp/span_extraction:
+        # SQuAD QA). Synthetic stand-in: delimiter tokens bracket an answer
+        # span of random length; labels = (start, end) positions. Both
+        # boundaries are OBSERVABLE (a start-only marker with random length
+        # makes the end unlearnable — caught when FL training memorized
+        # train spans at 99% while test sat at chance).
+        vocab = 256
+        seq_len = 32 if small else 64
+        open_tok, close_tok = vocab - 1, vocab - 2
+        # span localization generalizes only with decent position coverage —
+        # keep a healthy floor in small mode (synthetic: free to generate)
+        n_tr, n_te = (max(int(10000 * scale), 1024), max(int(1200 * scale), 128))
+
+        def gen_span(n, s):
+            r = np.random.default_rng(s)
+            x = r.integers(0, vocab - 2, (n, seq_len)).astype(np.int32)
+            starts = r.integers(1, seq_len - 5, n)
+            lengths = r.integers(1, 4, n)
+            ends = starts + lengths - 1  # <= seq_len - 3
+            rows = np.arange(n)
+            x[rows, starts - 1] = open_tok
+            x[rows, ends + 1] = close_tok
+            y = np.stack([starts, ends], axis=1).astype(np.int32)
+            return ArrayPair(x, y)
+
+        train, test = gen_span(n_tr, 81), gen_span(n_te, 82)
+        class_num = seq_len  # classes = sequence positions
+    elif dataset in ("seq2seq", "gigaword", "cnn_dailymail"):
+        # FedNLP seq2seq (reference app/fednlp/seq2seq: abstractive
+        # summarization). Synthetic stand-in: target = the source's first
+        # tgt_len tokens REVERSED — pure copy fails, the decoder must attend
+        # through the encoder memory positionally. The packed rectangle is
+        # [src | BOS + shifted target] (models/transformer.py Seq2Seq contract).
+        vocab = 64
+        src_len = 16 if small else 64
+        tgt_len = 8 if small else 32
+        bos = 0
+        # the reversal circuit needs enough coverage to generalize — keep a
+        # healthy floor even in small mode (synthetic: free to generate)
+        n_tr, n_te = (max(int(8000 * scale), 768), max(int(1000 * scale), 128))
+
+        def gen_s2s(n, s):
+            r = np.random.default_rng(s)
+            src = r.integers(1, vocab, (n, src_len)).astype(np.int32)
+            tgt = src[:, :tgt_len][:, ::-1]
+            dec_in = np.concatenate(
+                [np.full((n, 1), bos, np.int32), tgt[:, :-1]], axis=1)
+            return ArrayPair(np.concatenate([src, dec_in], axis=1), tgt.copy())
+
+        train, test = gen_s2s(n_tr, 83), gen_s2s(n_te, 84)
+        class_num = vocab
+    elif dataset in ("ego_networks_node_clf", "node_clf_synthetic"):
+        # FedGraphNN node-level tasks (reference app/fedgraphnn/
+        # ego_networks_node_clf): per-node labels from STRUCTURE (degree above
+        # the graph median), so message passing — not node features alone —
+        # carries the signal.
+        n_nodes, n_feat = 16, 8
+        n_tr, n_te = (max(int(3000 * scale), 256), max(int(600 * scale), 64))
+
+        def gen_node(n, s):
+            r = np.random.default_rng(s)
+            x = np.zeros((n, n_nodes, n_feat + n_nodes), np.float32)
+            y = np.zeros((n, n_nodes), np.int32)
+            for i in range(n):
+                p = r.uniform(0.1, 0.5)
+                a = (r.random((n_nodes, n_nodes)) < p).astype(np.float32)
+                a = np.triu(a, 1)
+                a = a + a.T
+                deg = a.sum(1)
+                x[i, :, :n_feat] = r.normal(size=(n_nodes, n_feat))
+                x[i, :, 0] = 1.0  # constant channel: A_hat @ 1 exposes degree
+                x[i, :, n_feat:] = a
+                y[i] = (deg > np.median(deg)).astype(np.int32)
+            return ArrayPair(x, y)
+
+        train, test = gen_node(n_tr, 85), gen_node(n_te, 86)
+        class_num = 2
+    elif dataset in ("ego_networks_link_pred", "link_pred_synthetic"):
+        # FedGraphNN link-level tasks (reference app/fedgraphnn/
+        # ego_networks_link_pred, subgraph_link_pred): 2-community graphs,
+        # 30% of edges hidden from the input; labels = the FULL adjacency
+        # (N*N pairwise 0/1) — recoverable from community structure.
+        n_nodes, n_feat = 16, 8
+        n_tr, n_te = (max(int(2000 * scale), 256), max(int(400 * scale), 64))
+
+        def gen_link(n, s):
+            r = np.random.default_rng(s)
+            x = np.zeros((n, n_nodes, n_feat + n_nodes), np.float32)
+            y = np.zeros((n, n_nodes * n_nodes), np.int32)
+            half = n_nodes // 2
+            for i in range(n):
+                comm = np.zeros(n_nodes, np.int32)
+                comm[half:] = 1
+                same = comm[:, None] == comm[None, :]
+                p_edge = np.where(same, 0.7, 0.05)
+                a_full = (r.random((n_nodes, n_nodes)) < p_edge).astype(np.float32)
+                a_full = np.triu(a_full, 1)
+                a_full = a_full + a_full.T
+                hide = np.triu(r.random((n_nodes, n_nodes)) < 0.3, 1)
+                hide = hide + hide.T
+                a_obs = a_full * (1.0 - hide)
+                x[i, :, :n_feat] = r.normal(size=(n_nodes, n_feat))
+                x[i, :, 0] = 1.0  # constant channel (degree via A_hat @ 1)
+                x[i, :, n_feat:] = a_obs
+                y[i] = a_full.reshape(-1).astype(np.int32)
+            return ArrayPair(x, y)
+
+        train, test = gen_link(n_tr, 87), gen_link(n_te, 88)
+        class_num = 2
+        # partition label: y[:, 0] is the adjacency diagonal (always 0 —
+        # degenerate); use per-graph edge-count quartile bins instead
+        edge_counts = train.y.sum(axis=1)
+        part_labels = np.digitize(
+            edge_counts, np.quantile(edge_counts, [0.25, 0.5, 0.75])
+        ).astype(np.int64)
+    elif dataset in ("moleculenet_reg", "esol", "freesolv", "lipophilicity"):
+        # FedGraphNN graph regression (reference app/fedgraphnn/
+        # moleculenet_graph_reg): continuous target = a structural property
+        # (scaled edge density), float labels + loss_kind='mse'.
+        n_nodes, n_feat = 16, 8
+        n_tr, n_te = (max(int(1100 * scale), 128), max(int(220 * scale), 48))
+
+        def gen_reg(n, s):
+            r = np.random.default_rng(s)
+            x = np.zeros((n, n_nodes, n_feat + n_nodes), np.float32)
+            y = np.zeros(n, np.float32)
+            max_edges = n_nodes * (n_nodes - 1) / 2.0
+            for i in range(n):
+                p = r.uniform(0.05, 0.6)
+                a = (r.random((n_nodes, n_nodes)) < p).astype(np.float32)
+                a = np.triu(a, 1)
+                a = a + a.T
+                x[i, :, :n_feat] = r.normal(size=(n_nodes, n_feat))
+                x[i, :, 0] = 1.0  # constant channel (density via pooling)
+                x[i, :, n_feat:] = a
+                y[i] = 4.0 * (np.triu(a, 1).sum() / max_edges)
+            return ArrayPair(x, y)
+
+        train, test = gen_reg(n_tr, 89), gen_reg(n_te, 90)
+        class_num = 1
     elif dataset in ("shakespeare", "fed_shakespeare", "stackoverflow_nwp"):
         from . import leaf
 
@@ -356,10 +516,22 @@ def load_partition_data(
     else:
         raise ValueError(f"unknown dataset '{dataset}'")
 
-    labels = train.y if train.y.ndim == 1 else train.y[:, 0]
+    if part_labels is not None:
+        # a branch provided an explicit partition label (e.g. link
+        # prediction, whose y[:, 0] is the always-zero adjacency diagonal)
+        labels = part_labels
+        part_classes = int(labels.max()) + 1
+    else:
+        labels = train.y if train.y.ndim == 1 else train.y[:, 0]
+        part_classes = class_num
+    if np.issubdtype(labels.dtype, np.floating):
+        # regression targets: Dirichlet skew over quartile bins of the value
+        bins = np.quantile(labels, [0.25, 0.5, 0.75])
+        labels = np.digitize(labels, bins).astype(np.int64)
+        part_classes = 4
     if partition_method == "hetero":
         idx_map = non_iid_partition_with_dirichlet_distribution(
-            labels, client_num, class_num, partition_alpha
+            labels, client_num, part_classes, partition_alpha
         )
     else:
         idx_map = homo_partition(len(train.x), client_num)

@@ -23,12 +23,24 @@ from .resnet import CifarResNet, ResNet18
 from .rnn import RNNOriginalFedAvg, RNNStackOverFlow
 from .mobilenet import MobileNetV1
 from .mobilenet_v3 import EfficientNetLite, MobileNetV3Small, VGG
-from .transformer import TransformerClassifier, TransformerLM, ViT
+from .transformer import (
+    Seq2SeqTransformer,
+    TransformerClassifier,
+    TransformerLM,
+    TransformerSpanExtractor,
+    TransformerTagger,
+    ViT,
+)
 from .gan import Discriminator, Generator
 from .gkt import GKTClientNet, GKTServerNet
 from .darts import DARTSSearchNet, derive_genotype
 from .unet import UNetLite
-from .gcn import GCNGraphClassifier
+from .gcn import (
+    GCNGraphClassifier,
+    GCNGraphRegressor,
+    GCNLinkPredictor,
+    GCNNodeClassifier,
+)
 from .mobile import (
     MobileLeNet5,
     MobileResNet18,
@@ -42,8 +54,10 @@ __all__ = [
     "CifarResNet", "ResNet18", "RNNOriginalFedAvg", "RNNStackOverFlow",
     "MobileNetV1", "MobileNetV3Small", "EfficientNetLite", "VGG",
     "TransformerLM", "TransformerClassifier", "ViT",
+    "TransformerTagger", "TransformerSpanExtractor", "Seq2SeqTransformer",
     "Generator", "Discriminator", "GKTClientNet", "GKTServerNet",
     "DARTSSearchNet", "derive_genotype", "UNetLite", "GCNGraphClassifier",
+    "GCNNodeClassifier", "GCNLinkPredictor", "GCNGraphRegressor",
     "MobileLeNet5", "MobileResNet18", "build_mobile_model_file",
     "load_mobile_model_file",
 ]
@@ -89,6 +103,22 @@ def create(args, output_dim: int):
             num_nodes=int(getattr(args, "graph_num_nodes", 16) or 16),
             dtype=dtype,
         )
+    if model_name == "gcn_node":
+        return GCNNodeClassifier(
+            num_classes=output_dim,
+            num_nodes=int(getattr(args, "graph_num_nodes", 16) or 16),
+            dtype=dtype,
+        )
+    if model_name == "gcn_link":
+        return GCNLinkPredictor(
+            num_nodes=int(getattr(args, "graph_num_nodes", 16) or 16),
+            dtype=dtype,
+        )
+    if model_name == "gcn_reg":
+        return GCNGraphRegressor(
+            num_nodes=int(getattr(args, "graph_num_nodes", 16) or 16),
+            dtype=dtype,
+        )
     if model_name in ("rnn", "rnn_fedavg"):
         if "stackoverflow" in dataset:
             return RNNStackOverFlow(dtype=dtype)
@@ -103,6 +133,31 @@ def create(args, output_dim: int):
         )
     if model_name == "vit":
         return ViT(num_classes=output_dim, dtype=dtype)
+    dim = int(getattr(args, "model_dim", 256) or 256)
+    layers = int(getattr(args, "model_layers", 4) or 4)
+    heads = int(getattr(args, "model_heads", 8) or 8)
+    if model_name in ("transformer_tagger", "bert_tagger"):
+        vocab = int(getattr(args, "vocab_size", 2000) or 2000)
+        return TransformerTagger(
+            num_tags=output_dim, vocab_size=vocab, dim=dim,
+            num_layers=layers, num_heads=heads,
+            max_len=int(getattr(args, "max_seq_len", 512) or 512), dtype=dtype,
+        )
+    if model_name in ("span_extractor", "bert_qa"):
+        vocab = int(getattr(args, "vocab_size", 2000) or 2000)
+        return TransformerSpanExtractor(
+            vocab_size=vocab, dim=dim, num_layers=layers, num_heads=heads,
+            max_len=int(getattr(args, "max_seq_len", 512) or 512), dtype=dtype,
+        )
+    if model_name in ("seq2seq", "bart_tiny"):
+        vocab = int(getattr(args, "vocab_size", 2000) or 2000)
+        return Seq2SeqTransformer(
+            vocab_size=vocab, dim=dim, num_heads=heads,
+            num_layers=int(getattr(args, "model_layers", 3) or 3),
+            src_len=int(getattr(args, "src_seq_len", 64) or 64),
+            tgt_len=int(getattr(args, "tgt_seq_len", 32) or 32),
+            dtype=dtype,
+        )
     raise ValueError(f"unknown model '{model_name}'")
 
 

@@ -42,6 +42,20 @@ class GraphConv(nn.Module):
         return jnp.einsum("bij,bjf->bif", a_hat, h)
 
 
+def _gcn_encode(mod: nn.Module, x) -> jnp.ndarray:
+    """Shared GCN encoder: unpack -> normalize -> n_layers of conv+relu.
+
+    A plain function called from each task model's ``@nn.compact`` body so
+    the GraphConv layers bind to the caller's scope (auto-named
+    ``GraphConv_i`` exactly as before factoring)."""
+    feats, adj = split_graph_tensor(x.astype(mod.dtype), mod.num_nodes)
+    a_hat = normalize_adjacency(adj)
+    h = feats
+    for _ in range(mod.n_layers):
+        h = nn.relu(GraphConv(mod.hidden, dtype=mod.dtype)(h, a_hat))
+    return h
+
+
 class GCNGraphClassifier(nn.Module):
     """Graph-level classifier: GCN layers -> mean pool -> dense head.
 
@@ -56,10 +70,67 @@ class GCNGraphClassifier(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
-        feats, adj = split_graph_tensor(x.astype(self.dtype), self.num_nodes)
-        a_hat = normalize_adjacency(adj)
-        h = feats
-        for _ in range(self.n_layers):
-            h = nn.relu(GraphConv(self.hidden, dtype=self.dtype)(h, a_hat))
-        pooled = h.mean(axis=1)
+        pooled = _gcn_encode(self, x).mean(axis=1)
         return nn.Dense(self.num_classes, dtype=self.dtype)(pooled)
+
+
+class GCNNodeClassifier(nn.Module):
+    """Per-node classifier — the FedGraphNN node-level task family
+    (reference ``app/fedgraphnn/ego_networks_node_clf``). Output
+    (B, N, num_classes); labels (B, N) ride the shared masked CE (the
+    per-example mask broadcasts over the node dim)."""
+
+    num_classes: int = 2
+    num_nodes: int = 16
+    hidden: int = 64
+    n_layers: int = 2
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = _gcn_encode(self, x)
+        return nn.Dense(self.num_classes, dtype=self.dtype, name="node_head")(h)
+
+
+class GCNLinkPredictor(nn.Module):
+    """Link prediction — the FedGraphNN link-level task family (reference
+    ``app/fedgraphnn/ego_networks_link_pred``, ``subgraph_link_pred``).
+
+    Encodes nodes from the OBSERVED (partially-hidden) graph, scores every
+    ordered pair with a bilinear decoder, and returns 2-class logits
+    (no-link/link) shaped (B, N*N, 2) so pairwise labels (B, N*N) ride the
+    shared masked CE."""
+
+    num_nodes: int = 16
+    hidden: int = 64
+    n_layers: int = 2
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = _gcn_encode(self, x)
+        # bilinear pair scores z_i^T W z_j (one matmul chain, MXU-friendly)
+        w = self.param("bilinear", nn.initializers.lecun_normal(),
+                       (self.hidden, self.hidden), self.dtype)
+        scores = jnp.einsum("bif,fg,bjg->bij", h, w, h)
+        B = scores.shape[0]
+        flat = scores.reshape(B, self.num_nodes * self.num_nodes, 1)
+        bias = self.param("link_bias", nn.initializers.zeros, (1,), self.dtype)
+        # [-(s+b), +(s+b)]: a 2-class head driven by one score
+        return jnp.concatenate([-(flat + bias), flat + bias], axis=-1)
+
+
+class GCNGraphRegressor(nn.Module):
+    """Graph-level regression — the FedGraphNN regression family (reference
+    ``app/fedgraphnn/moleculenet_graph_reg``: ESOL/FreeSolv/Lipophilicity).
+    Output (B, 1) continuous; pairs with ``loss_kind='mse'``."""
+
+    num_nodes: int = 16
+    hidden: int = 64
+    n_layers: int = 2
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        pooled = _gcn_encode(self, x).mean(axis=1)
+        return nn.Dense(1, dtype=self.dtype, name="reg_head")(pooled)

@@ -120,6 +120,25 @@ class TransformerLM(nn.Module):
         return nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype, name="head")(h)
 
 
+def _encode_tokens(mod: nn.Module, tokens) -> jax.Array:
+    """Shared bidirectional token encoder: embed + pos + blocks + final LN.
+
+    A plain function (not a submodule) called from each task model's
+    ``@nn.compact`` body, so the layers bind to the CALLER's scope and every
+    task model keeps the flat wte/wpe/block_i/ln_f param tree (checkpoint
+    compatible with the pre-factoring layout)."""
+    T = tokens.shape[1]
+    h = nn.Embed(mod.vocab_size, mod.dim, dtype=mod.dtype, name="wte")(tokens)
+    pos = nn.Embed(mod.max_len, mod.dim, dtype=mod.dtype, name="wpe")(
+        jnp.arange(T)[None, :]
+    )
+    h = h + pos
+    for i in range(mod.num_layers):
+        h = Block(mod.dim, mod.num_heads, causal=False, dtype=mod.dtype,
+                  name=f"block_{i}")(h)
+    return nn.LayerNorm(dtype=mod.dtype, name="ln_f")(h)
+
+
 class TransformerClassifier(nn.Module):
     """Encoder + CLS-pool classifier — the FedNLP text-classification model
     family (reference ``app/fednlp/text_classification/model/bert_model.py``
@@ -136,17 +155,139 @@ class TransformerClassifier(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
-        B, T = tokens.shape
-        h = nn.Embed(self.vocab_size, self.dim, dtype=self.dtype, name="wte")(tokens)
-        pos = nn.Embed(self.max_len, self.dim, dtype=self.dtype, name="wpe")(
-            jnp.arange(T)[None, :]
-        )
-        h = h + pos
+        h = _encode_tokens(self, tokens)
+        return nn.Dense(self.num_classes, dtype=self.dtype, name="cls")(h.mean(axis=1))
+
+
+class TransformerTagger(nn.Module):
+    """Encoder + per-token head — the FedNLP sequence-tagging family
+    (reference ``app/fednlp/seq_tagging``: BERT token classification for NER).
+    Output (B, T, num_tags); per-token labels ride the shared masked CE
+    (the mask broadcasts over the token dim)."""
+
+    num_tags: int = 9
+    vocab_size: int = 30522
+    dim: int = 256
+    num_heads: int = 8
+    num_layers: int = 4
+    max_len: int = 512
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        h = _encode_tokens(self, tokens)
+        return nn.Dense(self.num_tags, dtype=self.dtype, name="tag_head")(h)
+
+
+class TransformerSpanExtractor(nn.Module):
+    """Encoder + start/end span heads — the FedNLP span-extraction family
+    (reference ``app/fednlp/span_extraction``: SQuAD-style QA, BERT with
+    start/end logits). Output (B, 2, T): two position-classification
+    problems (class dim = sequence positions), so labels (B, 2) =
+    (start_idx, end_idx) ride the shared masked CE unchanged."""
+
+    vocab_size: int = 30522
+    dim: int = 256
+    num_heads: int = 8
+    num_layers: int = 4
+    max_len: int = 512
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        h = _encode_tokens(self, tokens)
+        span = nn.Dense(2, dtype=self.dtype, name="span_head")(h)  # (B, T, 2)
+        return jnp.swapaxes(span, 1, 2)  # (B, 2, T): classes = positions
+
+
+class CrossAttention(nn.Module):
+    """Decoder-side attention over encoder memory (no causal constraint)."""
+
+    dim: int
+    num_heads: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, memory):
+        from ..ops.attention import multihead_attention
+
+        B, T, D = x.shape
+        S = memory.shape[1]
+        H = self.num_heads
+        q = nn.Dense(self.dim, use_bias=False, dtype=self.dtype, name="q")(x)
+        kv = nn.Dense(2 * self.dim, use_bias=False, dtype=self.dtype, name="kv")(memory)
+        k, v = jnp.split(kv, 2, axis=-1)
+        q = q.reshape(B, T, H, D // H)
+        k = k.reshape(B, S, H, D // H)
+        v = v.reshape(B, S, H, D // H)
+        # dense impl: the flash kernel assumes len(q) == len(kv); cross
+        # attention has T != S and S is small in the seq2seq family
+        out = multihead_attention(q, k, v, causal=False, impl="dense")
+        out = out.reshape(B, T, D)
+        return nn.Dense(self.dim, use_bias=False, dtype=self.dtype, name="proj")(out)
+
+
+class DecoderBlock(nn.Module):
+    dim: int
+    num_heads: int
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, memory):
+        x = x + SelfAttention(self.dim, self.num_heads, causal=True,
+                              dtype=self.dtype)(nn.LayerNorm(dtype=self.dtype)(x))
+        x = x + CrossAttention(self.dim, self.num_heads, dtype=self.dtype)(
+            nn.LayerNorm(dtype=self.dtype)(x), memory)
+        x = x + MLPBlock(self.dim, dtype=self.dtype)(nn.LayerNorm(dtype=self.dtype)(x))
+        return x
+
+
+class Seq2SeqTransformer(nn.Module):
+    """Encoder-decoder with cross-attention — the FedNLP seq2seq family
+    (reference ``app/fednlp/seq2seq``: BART-style summarization/generation).
+
+    TPU-shaped I/O contract: the input is ONE rectangle ``(B, src_len +
+    tgt_len)`` = ``[source tokens | shifted decoder-input tokens]`` (teacher
+    forcing packed by the data pipeline — static shapes, no ragged pairs);
+    labels are the (B, tgt_len) target tokens. Output (B, tgt_len, vocab)."""
+
+    vocab_size: int = 30522
+    src_len: int = 64
+    tgt_len: int = 32
+    dim: int = 256
+    num_heads: int = 8
+    num_layers: int = 3
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = False):
+        if tokens.shape[1] != self.src_len + self.tgt_len:
+            # fail fast: Embed silently clamps out-of-range positions, so a
+            # config/data width mismatch would otherwise degrade invisibly
+            raise ValueError(
+                f"Seq2SeqTransformer expects width src_len+tgt_len = "
+                f"{self.src_len}+{self.tgt_len}, got {tokens.shape[1]} — "
+                f"align src_seq_len/tgt_seq_len with the dataset's packing")
+        B = tokens.shape[0]
+        src = tokens[:, : self.src_len]
+        dec_in = tokens[:, self.src_len:]
+        wte = nn.Embed(self.vocab_size, self.dim, dtype=self.dtype, name="wte")
+        # encoder
+        h = wte(src) + nn.Embed(self.src_len, self.dim, dtype=self.dtype,
+                                name="enc_pos")(jnp.arange(src.shape[1])[None, :])
         for i in range(self.num_layers):
             h = Block(self.dim, self.num_heads, causal=False, dtype=self.dtype,
-                      name=f"block_{i}")(h)
-        h = nn.LayerNorm(dtype=self.dtype, name="ln_f")(h)
-        return nn.Dense(self.num_classes, dtype=self.dtype, name="cls")(h.mean(axis=1))
+                      name=f"enc_{i}")(h)
+        memory = nn.LayerNorm(dtype=self.dtype, name="enc_ln")(h)
+        # decoder (causal self-attn + cross-attn into the encoder memory)
+        d = wte(dec_in) + nn.Embed(self.tgt_len, self.dim, dtype=self.dtype,
+                                   name="dec_pos")(jnp.arange(dec_in.shape[1])[None, :])
+        for i in range(self.num_layers):
+            d = DecoderBlock(self.dim, self.num_heads, dtype=self.dtype,
+                             name=f"dec_{i}")(d, memory)
+        d = nn.LayerNorm(dtype=self.dtype, name="dec_ln")(d)
+        return nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype,
+                        name="lm_head")(d)
 
 
 class ViT(nn.Module):

@@ -45,3 +45,26 @@ def masked_accuracy(logits: jax.Array, labels: jax.Array, mask: jax.Array):
     m = jnp.broadcast_to(_broadcast_mask(mask, labels.ndim), labels.shape)
     correct = ((pred == labels) * m).sum()
     return correct, m.sum()
+
+
+def masked_mse(preds: jax.Array, targets: jax.Array, mask: jax.Array) -> jax.Array:
+    """Sum(sq err * mask) / max(sum(mask), 1) — regression tasks (FedGraphNN
+    moleculenet property regression). preds (...,) or (..., 1)."""
+    p = preds.astype(jnp.float32)
+    if p.ndim == targets.ndim + 1 and p.shape[-1] == 1:
+        p = p[..., 0]
+    err = jnp.square(p - targets.astype(jnp.float32))
+    m = jnp.broadcast_to(_broadcast_mask(mask, err.ndim), err.shape)
+    return (err * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+
+def masked_within_tolerance(preds: jax.Array, targets: jax.Array,
+                            mask: jax.Array, tol: float = 0.5):
+    """Regression 'accuracy': count of predictions within ``tol`` of the
+    target (so regression rides the same correct/valid metric plumbing)."""
+    p = preds.astype(jnp.float32)
+    if p.ndim == targets.ndim + 1 and p.shape[-1] == 1:
+        p = p[..., 0]
+    hit = (jnp.abs(p - targets.astype(jnp.float32)) <= tol)
+    m = jnp.broadcast_to(_broadcast_mask(mask, hit.ndim), hit.shape)
+    return (hit * m).sum(), m.sum()

@@ -16,6 +16,7 @@ import jax
 from .. import data as data_mod
 from .. import models as models_mod
 from ..algorithms import LocalTrainConfig, get_algorithm
+from ..algorithms.local_sgd import infer_loss_kind as _infer_loss_kind
 from ..parallel.mesh import AXIS_CLIENT, MeshConfig, create_mesh
 from .fed_sim import FedSimulator, SimConfig, reference_client_sampling
 from .hierarchical import HierarchicalFedSimulator
@@ -69,6 +70,7 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
         ),
         dp_noise_multiplier=float(getattr(args, "dp_noise_multiplier", None)
                                   or 0.0),
+        loss_kind=_infer_loss_kind(args, fed_data),
     )
     needs_dropout = getattr(args, "model", "lr") in ("cnn",)
     optimizer_name = str(getattr(args, "federated_optimizer", "FedAvg"))
@@ -85,6 +87,7 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
         client_dropout_rate=float(getattr(args, "client_dropout_rate", 0.0)),
         cohort_schedule=str(getattr(args, "cohort_schedule", "auto")),
         max_width_buckets=int(getattr(args, "max_width_buckets", 4)),
+        loss_kind=cfg.loss_kind,
     )
 
     attack_type = getattr(args, "attack_type", None)

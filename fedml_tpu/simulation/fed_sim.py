@@ -91,6 +91,8 @@ class SimConfig:
     #              else even.
     cohort_schedule: str = "auto"
     max_width_buckets: int = 4
+    # eval loss family — must match LocalTrainConfig.loss_kind ("ce" | "mse")
+    loss_kind: str = "ce"
 
 
 def _gather_from_device(data: Dict[str, Any], x_all, y_all) -> Dict[str, Any]:
@@ -302,7 +304,7 @@ class FedSimulator:
         return jax.jit(finalize, donate_argnums=(0, 1))
 
     def _build_eval(self, apply_fn):
-        eval_fn = make_eval_fn(apply_fn)
+        eval_fn = make_eval_fn(apply_fn, self.cfg.loss_kind)
 
         def eval_batches(params, xs, ys, ms):
             def body(carry, batch):
