@@ -231,11 +231,19 @@ def make_local_update(
                 grads = tree_add(grads, tree_scale(tree_sub(params, global_params), prox_mu))
             if cfg.use_scaffold:
                 grads = tree_add(grads, tree_sub(c_global, c_local))
-            # zero the update entirely for fully-padded batches
+            # fully-padded batches are NO-OPS: zeroing grads alone is not
+            # enough for stateful optimizers (momentum keeps coasting, adam
+            # advances its count/moments on batches that don't exist), so
+            # params AND optimizer state only advance on real batches
             bweight = (bm.sum() > 0).astype(jnp.float32)
             grads = tree_scale(grads, bweight)
-            updates, opt_state = opt.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
+            updates, new_opt_state = opt.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            params = jax.tree.map(
+                lambda n, o: jnp.where(bweight > 0, n, o), new_params, params)
+            opt_state = jax.tree.map(
+                lambda n, o: jnp.where(bweight > 0, n, o),
+                new_opt_state, opt_state)
             return (params, opt_state, step + 1), (loss, correct, valid, bweight)
 
         def epoch_step(carry, _):
@@ -317,9 +325,15 @@ def _make_bn_local_update(
                 grads = tree_add(grads, tree_scale(tree_sub(params, g_params), prox_mu))
             bweight = (bm.sum() > 0).astype(jnp.float32)
             grads = tree_scale(grads, bweight)
-            updates, opt_state = opt.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            # running stats must not advance on fully-padded batches
+            updates, new_opt_state = opt.update(grads, opt_state, params)
+            new_params = optax.apply_updates(params, updates)
+            # fully-padded batches are no-ops for params, optimizer state,
+            # AND running stats (see make_local_update note)
+            params = jax.tree.map(
+                lambda n, o: jnp.where(bweight > 0, n, o), new_params, params)
+            opt_state = jax.tree.map(
+                lambda n, o: jnp.where(bweight > 0, n, o),
+                new_opt_state, opt_state)
             stats = jax.tree.map(
                 lambda o, n: jnp.where(bweight > 0, n, o), stats, new_stats
             )

@@ -151,6 +151,59 @@ def bucket_schedule(
     ]
 
 
+def lane_schedule(
+    batch_counts: Sequence[int],
+    axis: int,
+    max_lanes: int | None = None,
+) -> Tuple[List[List[int]], int]:
+    """Pack cohort positions into G balanced lanes for the packed executor.
+
+    The packed cohort schedule trains clients BACK-TO-BACK inside one
+    compiled scan (param reset at client boundaries), so the only padding is
+    the lane-length imbalance: cost = G * L where L = max lane load. This
+    searches G over multiples of ``axis`` (lanes shard over the mesh client
+    axis), assigns clients to lanes with LPT (longest-processing-time
+    greedy), and keeps the (G, L) minimizing total padded batch-work —
+    ties broken toward MORE lanes (fatter per-step batches, fewer
+    sequential steps).
+
+    Returns: (lanes, L) — lanes[g] is the ordered list of cohort positions
+    lane g trains; L = max lane length in batches.
+    """
+    counts = np.asarray(batch_counts, dtype=np.int64)
+    n = len(counts)
+    axis = max(1, int(axis))
+    cap = n if max_lanes is None else min(n, int(max_lanes))
+    order = np.argsort(-counts, kind="stable")  # LPT: biggest first
+    best = None
+    # candidate lane counts: axis * powers of two only — every distinct G
+    # is a fresh vmap width and therefore a full XLA recompile of the
+    # training scan, so the candidate set must stay tiny as cohorts
+    # resample round to round (the bucketed schedule bounds its shapes the
+    # same way with pow2 slot counts)
+    candidates = []
+    g = axis
+    while g <= cap:
+        candidates.append(g)
+        g *= 2
+    for g in candidates:
+        loads = np.zeros(g, dtype=np.int64)
+        lanes: List[List[int]] = [[] for _ in range(g)]
+        for pos in order:
+            lane = int(np.argmin(loads))
+            lanes[lane].append(int(pos))
+            loads[lane] += counts[pos]
+        L = int(loads.max())
+        cost = g * L
+        # ties -> larger g (checked last wins on <=)
+        if best is None or cost <= best[0]:
+            best = (cost, lanes, L)
+    if best is None:  # n < axis: one client per lane, pad lanes to axis
+        lanes = [[int(p)] for p in order] + [[] for _ in range(axis - n)]
+        return lanes, int(counts.max(initial=1))
+    return best[1], best[2]
+
+
 def even_client_schedule(client_indexes: Sequence[int], n_shards: int) -> List[np.ndarray]:
     """np.array_split semantics of the reference NCCL simulator's
     ``client_schedule`` (``nccl/base_framework/Server.py:109``): contiguous

@@ -150,7 +150,11 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
     )
     if attack_type:
         alg = _inject_attacker(alg, args)
-    sim = FedSimulator(fed_data, alg, variables, sim_cfg, mesh=mesh)
+    sim = FedSimulator(
+        fed_data, alg, variables, sim_cfg, mesh=mesh,
+        # raw pieces for the packed cohort schedule's in-scan batch step
+        packed_ctx=(apply_fn, cfg, needs_dropout, has_batch_stats),
+    )
     return sim, apply_fn
 
 

@@ -86,9 +86,20 @@ class SimConfig:
     #              max-width padding for every small client (a Dirichlet
     #              CIFAR cohort averages ~8 batches/client but pads to the
     #              ~24-batch max — a 3x compute waste bucketing removes);
-    # "auto"     — bucketed when the dataset's client sizes are skewed
-    #              (max >= 2x median) and the algorithm mean-aggregates,
-    #              else even.
+    # "packed"   — ONE compiled program per round: clients are packed
+    #              back-to-back into a few balanced lanes
+    #              (core.scheduler.lane_schedule) and a single scan trains
+    #              them sequentially per lane, resetting params/opt state at
+    #              client boundaries and accumulating weighted deltas
+    #              in-scan. Padding drops to the lane-length imbalance
+    #              (~5-10% vs ~30% bucketed on Dirichlet cohorts) and the
+    #              4-5 sequential bucket programs collapse to one with
+    #              ~3x fewer, fatter sequential steps. Requires the
+    #              device-resident data path and a plain mean-aggregating,
+    #              stateless algorithm (FedAvg/FedProx family).
+    # "auto"     — packed when eligible and the dataset's client sizes are
+    #              skewed (max >= 2x median); else bucketed when skewed and
+    #              the algorithm mean-aggregates; else even.
     cohort_schedule: str = "auto"
     max_width_buckets: int = 4
     # eval loss family — must match LocalTrainConfig.loss_kind ("ce" | "mse")
@@ -134,6 +145,7 @@ class FedSimulator:
         init_variables: PyTree,
         cfg: SimConfig,
         mesh=None,
+        packed_ctx: Optional[tuple] = None,
     ):
         self.fed = fed_data
         self.alg = algorithm
@@ -180,17 +192,45 @@ class FedSimulator:
         # bucketed partial aggregation needs the plain weighted mean; custom
         # aggregates (median/trimmed...) see the full stacked cohort only in
         # the even path
+        # packed eligibility: one-program-per-round lane execution needs the
+        # raw (apply_fn, LocalTrainConfig) to build its in-scan batch step,
+        # a plain weighted-mean aggregation, params-shaped stateless updates,
+        # device-resident data, and none of the features that hook the
+        # per-client rectangle (SCAFFOLD state, DP-SGD per-example pass,
+        # BatchNorm collection threading).
+        self._packed_ctx = packed_ctx
+        mean_agg = (
+            algorithm.aggregate is None
+            and getattr(algorithm, "update_is_params", True)
+        )
+        packed_ok = (
+            packed_ctx is not None
+            and mean_agg
+            and self._use_device_data
+            and self._client_state_proto == ()
+            and algorithm.prepare_client_state is None
+            and not packed_ctx[1].use_scaffold
+            and packed_ctx[1].dp_l2_clip is None
+            and not packed_ctx[3]  # has_batch_stats
+        )
         schedule = cfg.cohort_schedule
         if schedule == "auto":
             counts = np.asarray(list(self._batch_counts.values()))
             skewed = counts.max() >= 2 * max(np.median(counts), 1)
-            schedule = "bucketed" if skewed else "even"
-        self._bucketed = (
-            schedule == "bucketed"
-            and algorithm.aggregate is None
-            and getattr(algorithm, "update_is_params", True)
-        )
+            if skewed:
+                schedule = "packed" if packed_ok else "bucketed"
+            else:
+                schedule = "even"
+        if schedule == "packed" and not packed_ok:
+            raise ValueError(
+                "cohort_schedule='packed' requires a stateless "
+                "mean-aggregating algorithm, device-resident data, and no "
+                "SCAFFOLD/DP-SGD/BatchNorm (use 'bucketed' or 'auto')")
+        self._packed = schedule == "packed"
+        self._bucketed = schedule == "bucketed" and mean_agg
         self._round_step = self._build_round_step()
+        if self._packed:
+            self._packed_step = self._build_packed_step()
         if self._bucketed:
             self._partial_step = self._build_partial_step()
             self._finalize_step = self._build_finalize_step()
@@ -246,6 +286,120 @@ class FedSimulator:
                 donate_argnums=(0, 1),
             )
         return jax.jit(round_step, donate_argnums=(0, 1))
+
+    def _build_packed_step(self) -> Callable:
+        """ONE compiled program per round: lanes of back-to-back clients.
+
+        Each lane scans its batch sequence; at a client's last batch the
+        lane flushes ``weight * (params - global)`` into an f32 delta
+        accumulator and resets params + optimizer state to global. The
+        weighted mean + server update happen in the same program, so a
+        skewed 10-client round that the bucketed schedule runs as 4-5
+        programs / ~48 sequential steps becomes one program with ~L
+        (= max lane load, ~total/G) fatter steps.
+
+        Numerics: identical per-client training to the even/bucketed paths
+        (same batches, same order, same per-(pos, step) RNG fold for
+        non-dropout models; dropout draws differ only in the step index
+        basis). Aggregation is the same f32 weighted mean modulo summation
+        order. Compiled once per (lanes, padded length) shape — the host
+        quantizes lengths to multiples of 4 to keep that set small.
+        """
+        import optax
+
+        from ..algorithms.local_sgd import make_loss_fn, tree_scale
+
+        apply_fn, lcfg, needs_dropout, _ = self._packed_ctx
+        opt = lcfg.make_optimizer()
+        loss_fn = make_loss_fn(apply_fn, needs_dropout, lcfg.loss_kind)
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+        prox_mu = 0.0 if lcfg.prox_mu is None else lcfg.prox_mu
+        alg = self.alg
+
+        def packed_round(params, server_state, cohort, rng, cohort_n,
+                         x_all, y_all):
+            opt0 = opt.init(params)
+            dsum0 = jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+            def lane_scan(seq):
+                def step(carry, inputs):
+                    lp, lopt, dsum, wsum, closs, csteps, lsum, corr, val = carry
+                    idx_t, mask_t, bnd_t, w_t, pos_t, sic_t = inputs
+                    mb = mask_t.reshape(
+                        mask_t.shape + (1,) * (x_all.ndim - mask_t.ndim))
+                    x = x_all[idx_t] * mb.astype(x_all.dtype)
+                    y = y_all[idx_t] * mask_t.reshape(
+                        mask_t.shape + (1,) * (y_all.ndim - mask_t.ndim)
+                    ).astype(y_all.dtype)
+                    key = jax.random.fold_in(
+                        jax.random.fold_in(rng, pos_t), sic_t)
+                    (loss, (correct, valid)), grads = grad_fn(
+                        lp, x, y, mask_t, key)
+                    if prox_mu > 0.0:
+                        grads = jax.tree.map(
+                            lambda g, p, gp: g + prox_mu * (p - gp),
+                            grads, lp, params)
+                    bw = (mask_t.sum() > 0).astype(jnp.float32)
+                    grads = tree_scale(grads, bw)
+                    updates, lopt = opt.update(grads, lopt, lp)
+                    lp = optax.apply_updates(lp, updates)
+                    closs = closs + loss * bw
+                    csteps = csteps + bw
+                    corr = corr + correct
+                    val = val + valid
+                    # client boundary: flush weighted delta, reset the lane
+                    is_b = bnd_t
+                    dsum = jax.tree.map(
+                        lambda d, p, gp: d + (w_t * is_b) * (
+                            p.astype(jnp.float32) - gp.astype(jnp.float32)),
+                        dsum, lp, params)
+                    wsum = wsum + w_t * is_b
+                    lsum = lsum + is_b * closs / jnp.maximum(csteps, 1.0)
+                    lp = jax.tree.map(
+                        lambda p, gp: jnp.where(is_b > 0, gp, p), lp, params)
+                    lopt = jax.tree.map(
+                        lambda s, s0: jnp.where(is_b > 0, s0, s), lopt, opt0)
+                    closs = closs * (1.0 - is_b)
+                    csteps = csteps * (1.0 - is_b)
+                    return (lp, lopt, dsum, wsum, closs, csteps,
+                            lsum, corr, val), None
+
+                z = jnp.float32(0.0)
+                init = (params, opt0, dsum0, z, z, z, z, z, z)
+                (_, _, dsum, wsum, _, _, lsum, corr, val), _ = jax.lax.scan(
+                    step, init,
+                    (seq["idx"], seq["mask"], seq["boundary"], seq["bweight"],
+                     seq["pos"], seq["sic"]),
+                )
+                return dsum, wsum, lsum, corr, val
+
+            dsum, wsum, lsum, corr, val = jax.vmap(lane_scan)(cohort)
+            total_w = jnp.maximum(wsum.sum(), 1.0)
+            agg = jax.tree.map(
+                lambda d, p: (d.sum(axis=0) / total_w).astype(p.dtype),
+                dsum, params)
+            new_params, new_server_state = alg.server_update(
+                params, agg, server_state)
+            # divisor = FULL cohort size (dropped clients are zero-loss
+            # rows), matching the even/bucketed paths' loss semantics
+            metrics_vec = jnp.stack([
+                (lsum.sum() / jnp.maximum(cohort_n, 1.0)).astype(jnp.float32),
+                (corr.sum() / jnp.maximum(val.sum(), 1.0)).astype(jnp.float32),
+            ])
+            return new_params, new_server_state, metrics_vec
+
+        if self.mesh is not None:
+            mesh = self.mesh
+            cohort_sh = shard_along(mesh, AXIS_CLIENT, 0)
+            rep = replicated(mesh)
+            return jax.jit(
+                packed_round,
+                in_shardings=(rep, rep, cohort_sh, rep, rep, rep, rep),
+                out_shardings=(rep, rep, rep),
+                donate_argnums=(0, 1),
+            )
+        return jax.jit(packed_round, donate_argnums=(0, 1))
 
     def _build_partial_step(self) -> Callable:
         """One width-bucket's local training + weighted partial sums (f32).
@@ -371,6 +525,14 @@ class FedSimulator:
                 drop = pack_rng.random(len(client_ids)) < cfg.client_dropout_rate
                 if drop.all():
                     drop[0] = False  # a round needs at least one survivor
+            if self._packed:
+                metrics_vec = self._run_packed_round(
+                    np.asarray(client_ids), round_idx, drop, step_rng
+                )
+                pending = self._defer_rec(
+                    round_idx, t0, metrics_vec, pending, apply_fn, ckpt, log_fn
+                )
+                continue
             if self._bucketed:
                 metrics_vec = self._run_bucketed_round(
                     np.asarray(client_ids), round_idx, drop, step_rng
@@ -493,6 +655,69 @@ class FedSimulator:
             ).permutation(len(self.fed.train_data_local_dict[int(c)]))
             for c in client_ids
         ]
+
+    def _run_packed_round(self, client_ids: np.ndarray, round_idx: int,
+                          drop, step_rng):
+        """Host side of the packed schedule: lane assignment (LPT over
+        epoch-expanded batch counts), sequence tensors, one dispatch."""
+        from ..core.scheduler import lane_schedule
+
+        cfg = self.cfg
+        bs = cfg.batch_size
+        epochs = int(self._packed_ctx[1].epochs)
+        # dropped clients are excluded BEFORE lane assignment — their drop
+        # mask is known host-side, so training them on zeroed data would
+        # only inflate lane loads (review finding). Metric divisors still
+        # use the FULL cohort size for parity with the even path, which
+        # keeps dropped clients as zero-loss rows.
+        cohort_n = len(client_ids)
+        positions = np.arange(cohort_n)
+        if drop is not None:
+            positions = positions[~drop]
+        counts = [
+            min(self._batch_counts[int(client_ids[p])], self.num_local_batches)
+            for p in positions
+        ]
+        seq_counts = [c * epochs for c in counts]
+        lanes, L = lane_schedule(seq_counts, self._axis_size,
+                                 max_lanes=len(positions))
+        L_pad = -(-L // 4) * 4  # quantize: few compiled (G, L) shapes
+        G = len(lanes)
+        idx = np.zeros((G, L_pad, bs), np.int32)
+        mask = np.zeros((G, L_pad, bs), np.float32)
+        boundary = np.zeros((G, L_pad), np.float32)
+        bweight = np.zeros((G, L_pad), np.float32)
+        pos_arr = np.zeros((G, L_pad), np.uint32)
+        sic = np.zeros((G, L_pad), np.int32)
+        for g, lane in enumerate(lanes):
+            t = 0
+            for i in lane:
+                p = int(positions[i])  # original cohort position (RNG key)
+                cid = int(client_ids[p])
+                c = counts[i]
+                perm = self._client_perms([cid], round_idx)[0]
+                packed = self.fed.pack_client_index([cid], bs, c, perms=[perm])
+                for e in range(epochs):
+                    idx[g, t:t + c] = packed.idx[0]
+                    mask[g, t:t + c] = packed.mask[0]
+                    pos_arr[g, t:t + c] = p
+                    sic[g, t:t + c] = np.arange(e * c, (e + 1) * c)
+                    t += c
+                boundary[g, t - 1] = 1.0
+                bweight[g, t - 1] = float(packed.num_samples[0])
+        cohort = {
+            "idx": jnp.asarray(idx),
+            "mask": jnp.asarray(mask),
+            "boundary": jnp.asarray(boundary),
+            "bweight": jnp.asarray(bweight),
+            "pos": jnp.asarray(pos_arr),
+            "sic": jnp.asarray(sic),
+        }
+        self.params, self.server_state, metrics_vec = self._packed_step(
+            self.params, self.server_state, cohort, step_rng,
+            jnp.float32(cohort_n), self._x_dev, self._y_dev,
+        )
+        return metrics_vec
 
     def _run_bucketed_round(self, client_ids: np.ndarray, round_idx: int,
                             drop, step_rng):

@@ -1,0 +1,152 @@
+"""Packed-lane cohort schedule: numeric parity with the even schedule.
+
+The packed executor trains clients back-to-back inside one scan (param reset
+at boundaries). Per-client training consumes the same batches in the same
+order with the same per-(pos, step) RNG folds as the even path, so final
+params must match up to f32 summation order.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+import fedml_tpu
+from fedml_tpu.core.scheduler import lane_schedule
+from fedml_tpu.simulation import build_simulator
+
+
+def _args(**kw):
+    base = dict(
+        dataset="cifar10", model="lr", partition_method="hetero",
+        partition_alpha=0.3, debug_small_data=True,
+        client_num_in_total=12, client_num_per_round=6, comm_round=3,
+        learning_rate=0.05, epochs=1, batch_size=16,
+        frequency_of_the_test=3, random_seed=0,
+    )
+    base.update(kw)
+    return fedml_tpu.init(config=base)
+
+
+def _flat(params):
+    return np.concatenate(
+        [np.asarray(l, np.float64).ravel() for l in jax.tree.leaves(params)])
+
+
+def test_lane_schedule_covers_exactly_once():
+    counts = [5, 6, 8, 8, 8, 9, 10, 11, 12, 14]
+    lanes, L = lane_schedule(counts, axis=1)
+    seen = sorted(p for lane in lanes for p in lane)
+    assert seen == list(range(10))
+    loads = [sum(counts[p] for p in lane) for lane in lanes]
+    assert max(loads) == L
+    # padded work must beat the trivial one-client-per-lane schedule
+    assert len(lanes) * L <= len(counts) * max(counts)
+
+
+def test_lane_schedule_axis_multiple():
+    lanes, L = lane_schedule([4, 4, 7, 9, 3], axis=4)
+    assert len(lanes) % 4 == 0
+    seen = sorted(p for lane in lanes for p in lane)
+    assert seen == list(range(5))
+
+
+def test_lane_schedule_fewer_clients_than_axis():
+    lanes, L = lane_schedule([6, 3], axis=4)
+    assert len(lanes) == 4
+    assert sorted(p for lane in lanes for p in lane) == [0, 1]
+    assert L >= 6
+
+
+def test_packed_matches_even_sp():
+    args_e = _args(cohort_schedule="even")
+    sim_e, apply_e = build_simulator(args_e)
+    assert not sim_e._packed
+    hist_e = sim_e.run(apply_e, log_fn=None)
+
+    args_p = _args(cohort_schedule="packed")
+    sim_p, apply_p = build_simulator(args_p)
+    assert sim_p._packed
+    hist_p = sim_p.run(apply_p, log_fn=None)
+
+    np.testing.assert_allclose(
+        _flat(sim_e.params), _flat(sim_p.params), rtol=2e-4, atol=2e-6)
+    assert hist_e[-1]["test_acc"] == pytest.approx(
+        hist_p[-1]["test_acc"], abs=5e-3)
+    assert hist_e[-1]["train_loss"] == pytest.approx(
+        hist_p[-1]["train_loss"], rel=2e-3)
+
+
+def test_packed_matches_even_multiepoch():
+    args_e = _args(cohort_schedule="even", epochs=2, comm_round=2)
+    sim_e, apply_e = build_simulator(args_e)
+    sim_e.run(apply_e, log_fn=None)
+
+    args_p = _args(cohort_schedule="packed", epochs=2, comm_round=2)
+    sim_p, apply_p = build_simulator(args_p)
+    sim_p.run(apply_p, log_fn=None)
+
+    np.testing.assert_allclose(
+        _flat(sim_e.params), _flat(sim_p.params), rtol=2e-4, atol=2e-6)
+
+
+def test_packed_on_mesh_matches_sp():
+    from fedml_tpu.parallel import AXIS_CLIENT, MeshConfig, create_mesh
+
+    mesh = create_mesh(MeshConfig(axes=((AXIS_CLIENT, 4),)),
+                       devices=jax.devices()[:4])
+    args_m = _args(cohort_schedule="packed")
+    sim_m, apply_m = build_simulator(args_m, mesh=mesh)
+    assert sim_m._packed
+    hist_m = sim_m.run(apply_m, log_fn=None)
+
+    args_s = _args(cohort_schedule="packed")
+    sim_s, apply_s = build_simulator(args_s)
+    hist_s = sim_s.run(apply_s, log_fn=None)
+
+    np.testing.assert_allclose(
+        _flat(sim_s.params), _flat(sim_m.params), rtol=2e-4, atol=2e-6)
+    assert np.isfinite(hist_m[-1]["test_acc"])
+
+
+def test_packed_with_momentum_and_prox():
+    """Optimizer state reset at client boundaries: momentum must not leak
+    across clients — parity vs the even path proves the reset is right."""
+    for extra in (dict(momentum=0.9), dict(federated_optimizer="FedProx",
+                                           fedprox_mu=0.1)):
+        args_e = _args(cohort_schedule="even", comm_round=2, **extra)
+        sim_e, a_e = build_simulator(args_e)
+        sim_e.run(a_e, log_fn=None)
+        args_p = _args(cohort_schedule="packed", comm_round=2, **extra)
+        sim_p, a_p = build_simulator(args_p)
+        assert sim_p._packed
+        sim_p.run(a_p, log_fn=None)
+        np.testing.assert_allclose(
+            _flat(sim_e.params), _flat(sim_p.params), rtol=2e-4, atol=2e-6)
+
+
+def test_packed_client_dropout_matches_even():
+    """Dropped clients are excluded from lanes host-side; training result
+    AND metric semantics (loss divided by the full cohort, dropped rows
+    zero) must still match the even path, which masks them in-program."""
+    args_e = _args(cohort_schedule="even", client_dropout_rate=0.5,
+                   comm_round=3)
+    sim_e, a_e = build_simulator(args_e)
+    hist_e = sim_e.run(a_e, log_fn=None)
+
+    args_p = _args(cohort_schedule="packed", client_dropout_rate=0.5,
+                   comm_round=3)
+    sim_p, a_p = build_simulator(args_p)
+    hist_p = sim_p.run(a_p, log_fn=None)
+
+    np.testing.assert_allclose(
+        _flat(sim_e.params), _flat(sim_p.params), rtol=2e-4, atol=2e-6)
+    for he, hp in zip(hist_e, hist_p):
+        assert he["train_loss"] == pytest.approx(hp["train_loss"], rel=2e-3)
+
+
+def test_packed_rejects_ineligible():
+    with pytest.raises(ValueError, match="packed"):
+        args = _args(cohort_schedule="packed",
+                     federated_optimizer="SCAFFOLD")
+        build_simulator(args)
