@@ -13,10 +13,9 @@ from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
-import optax
 
-from ..core.algframe import ClientOutput, FedAlgorithm
-from .local_sgd import tree_add, tree_sub
+from ..core.algframe import FedAlgorithm
+from .local_sgd import tree_add
 
 PyTree = Any
 
@@ -24,47 +23,20 @@ PyTree = Any
 def make_ae_local_update(apply_fn: Callable, lr: float = 1e-3, epochs: int = 1) -> Callable:
     """Jittable per-client AE update: minimize masked reconstruction MSE.
 
-    ``apply_fn(params, x) -> x_hat`` with x (B, F).
+    ``apply_fn(params, x) -> x_hat`` with x (B, F). Rides the shared
+    compiled client step (local_sgd.make_local_update) with the
+    reconstruction loss plugged in — the unsupervised task ignores y.
     """
-    opt = optax.adam(lr)
+    from .local_sgd import LocalTrainConfig, make_local_update
 
-    def local_update(global_params, client_state, data, rng) -> ClientOutput:
-        x, mask = data["x"], data["mask"]
+    def loss_fn(params, x, y, mask, rng):
+        recon = apply_fn(params, x)
+        per_sample = jnp.mean(jnp.square(recon - x), axis=-1)
+        loss = (per_sample * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+        return loss, (jnp.float32(0.0), mask.sum())
 
-        def loss_fn(params, bx, bm):
-            recon = apply_fn(params, bx)
-            per_sample = jnp.mean(jnp.square(recon - bx), axis=-1)
-            return (per_sample * bm).sum() / jnp.maximum(bm.sum(), 1.0)
-
-        def batch_step(carry, inputs):
-            params, opt_state = carry
-            bx, bm = inputs
-            loss, grads = jax.value_and_grad(loss_fn)(params, bx, bm)
-            updates, opt_state = opt.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            return (params, opt_state), loss
-
-        def epoch_step(carry, _):
-            carry, losses = jax.lax.scan(batch_step, carry, (x, mask))
-            return carry, losses
-
-        (params, _), losses = jax.lax.scan(
-            epoch_step, (global_params, opt.init(global_params)), None, length=epochs
-        )
-        metrics = {
-            "train_loss": losses.mean(),
-            "train_correct": jnp.float32(0.0),
-            "train_valid": jnp.float32(1.0),
-            "local_steps": jnp.float32(losses.size),
-        }
-        return ClientOutput(
-            update=tree_sub(params, global_params),
-            weight=data["num_samples"].astype(jnp.float32),
-            metrics=metrics,
-            state=client_state,
-        )
-
-    return local_update
+    cfg = LocalTrainConfig(lr=lr, epochs=epochs, client_optimizer="adam")
+    return make_local_update(apply_fn, cfg, loss_fn=loss_fn)
 
 
 def get_fediot_algorithm(apply_fn: Callable, lr: float = 1e-3, epochs: int = 1) -> FedAlgorithm:

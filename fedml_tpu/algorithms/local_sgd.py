@@ -128,12 +128,18 @@ def make_local_update(
     cfg: LocalTrainConfig,
     needs_dropout: bool = False,
     has_batch_stats: bool = False,
+    loss_fn: Optional[Callable] = None,
 ) -> Callable:
     """Build the jittable per-client local update.
 
     ``data`` is one client's rectangle: dict with x (NB,BS,*feat), y (NB,BS),
     mask (NB,BS), num_samples scalar. ``client_state`` is algorithm state
     (SCAFFOLD carries (c_global, c_local); others None/empty).
+
+    ``loss_fn`` overrides the built-in CE/MSE loss with a custom
+    ``(params, x, y, mask, rng) -> (loss, (correct, valid))`` callable
+    (e.g. detection or reconstruction losses), so task families share ONE
+    scan/no-op/metric implementation instead of copying it.
 
     ``has_batch_stats=True`` threads the mutable BatchNorm ``batch_stats``
     collection through the batch scan: the variables dict is
@@ -145,7 +151,9 @@ def make_local_update(
     keys, BN buffers included).
     """
     opt = cfg.make_optimizer()
-    loss_fn = make_loss_fn(apply_fn, needs_dropout, cfg.loss_kind)
+    custom_loss = loss_fn is not None
+    if loss_fn is None:
+        loss_fn = make_loss_fn(apply_fn, needs_dropout, cfg.loss_kind)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
     prox_mu = 0.0 if cfg.prox_mu is None else cfg.prox_mu
     if cfg.dp_noise_multiplier > 0.0 and cfg.dp_l2_clip is None:
@@ -157,6 +165,10 @@ def make_local_update(
         # hard errors, not asserts: silently proceeding would train
         # non-private / non-SCAFFOLD while claiming otherwise (and asserts
         # vanish under python -O)
+        if custom_loss:
+            raise ValueError(
+                "custom loss_fn with BatchNorm models is unwired; use a "
+                "GroupNorm model variant")
         if cfg.loss_kind != "ce":
             raise ValueError(
                 "loss_kind='mse' with BatchNorm models is unwired; use a "

@@ -333,6 +333,47 @@ def load_partition_data(
 
         train, test = gen_seg(n_tr, rng), gen_seg(n_te, rng)
         class_num = 2
+    elif dataset in ("object_detection", "coco_synthetic"):
+        # FedCV object detection stand-in (reference app/fedcv/
+        # object_detection uses COCO/VOC via YOLOv5): images with 1-3
+        # bright axis-aligned rectangles on noise; class 0 = square-ish,
+        # class 1 = elongated. Labels = rasterized (S, S, 6) target grids
+        # (models/detection.rasterize_boxes) so detection rides the
+        # standard rectangular packing.
+        from ..models.detection import rasterize_boxes
+
+        hw = 48 if small else 64  # grid = hw // 8 (detector stride)
+        grid, n_cls = hw // 8, 2
+        n_tr, n_te = (max(int(2400 * scale), 160), max(int(480 * scale), 48))
+
+        def gen_det(n, s):
+            r = np.random.default_rng(s)
+            x = r.normal(0, 0.1, (n, hw, hw, 1)).astype(np.float32)
+            y = np.zeros((n, grid, grid, 6), np.float32)
+            for i in range(n):
+                k = r.integers(1, 4)
+                boxes, classes = [], []
+                for _ in range(k):
+                    if r.random() < 0.5:
+                        w = h = r.integers(8, 14)
+                        c = 0
+                    else:
+                        w, h = r.integers(16, 24), r.integers(5, 8)
+                        c = 1
+                    x0 = r.integers(0, hw - w)
+                    y0 = r.integers(0, hw - h)
+                    x[i, y0:y0 + h, x0:x0 + w, 0] += 1.0
+                    boxes.append([(x0 + w / 2) / hw, (y0 + h / 2) / hw,
+                                  w / hw, h / hw])
+                    classes.append(c)
+                y[i] = rasterize_boxes(np.asarray(boxes), np.asarray(classes),
+                                       grid, n_cls)
+            return ArrayPair(x, y)
+
+        train, test = gen_det(n_tr, 91), gen_det(n_te, 92)
+        class_num = n_cls
+        # partition label: object count per image (y[:, 0] would be a grid)
+        part_labels = train.y[..., 0].sum(axis=(1, 2)).astype(np.int64) - 1
     elif dataset in ("seq_tagging", "wikiner", "w_nut"):
         # FedNLP sequence tagging (reference app/fednlp/seq_tagging: NER over
         # W-NUT/wikiner). Synthetic stand-in with a CONTEXTUAL tag rule —

@@ -1,0 +1,93 @@
+"""FedCV object detection: federated grid detector learns real localization.
+
+Reference app/fedcv/object_detection (YOLOv5 federated); here the compact
+anchor-free grid detector + detection loss ride the shared engine, and the
+test scores IoU-matched detections — not just loss descent.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import fedml_tpu
+from fedml_tpu.algorithms.fedcv_detection import get_detection_algorithm
+from fedml_tpu.models.detection import (
+    GridDetector,
+    box_iou,
+    decode_boxes,
+    rasterize_boxes,
+)
+from fedml_tpu.simulation.fed_sim import FedSimulator, SimConfig
+
+
+def test_rasterize_decode_roundtrip():
+    boxes = np.array([[0.25, 0.25, 0.2, 0.2], [0.75, 0.5, 0.3, 0.1]])
+    classes = np.array([0, 1])
+    t = rasterize_boxes(boxes, classes, grid=8, num_classes=2)
+    assert t[..., 0].sum() == 2
+    # a perfect prediction grid decodes back to the same boxes
+    pred = np.zeros((8, 8, 7), np.float32)
+    pred[..., 0] = -10.0
+    for (cx, cy, w, h), c in zip(boxes, classes):
+        gx, gy = int(cx * 8), int(cy * 8)
+        pred[gy, gx, 0] = 10.0
+        pred[gy, gx, 1] = cx * 8 - gx
+        pred[gy, gx, 2] = cy * 8 - gy
+        pred[gy, gx, 3] = np.log1p(w)
+        pred[gy, gx, 4] = np.log1p(h)
+        pred[gy, gx, 5 + c] = 5.0
+    out_boxes, out_cls, _ = decode_boxes(pred)
+    assert len(out_boxes) == 2
+    for b, c in zip(boxes, classes):
+        ious = [box_iou(b, ob) for ob in out_boxes]
+        j = int(np.argmax(ious))
+        assert ious[j] > 0.95
+        assert out_cls[j] == c
+
+
+def test_federated_detection_learns_localization():
+    args = fedml_tpu.init(config=dict(
+        dataset="object_detection", debug_small_data=True,
+        client_num_in_total=4, client_num_per_round=4,
+        partition_method="homo", random_seed=0))
+    from fedml_tpu import data as data_mod
+
+    fed, _ = data_mod.load(args)
+    model = GridDetector(num_classes=2, width=16)
+
+    def apply_fn(params, x, train=False, rngs=None):
+        return model.apply(params, x, train=train)
+
+    sample = fed.train_data_global.x[:1]
+    variables = model.init(jax.random.PRNGKey(0), jnp.asarray(sample),
+                           train=False)
+    alg = get_detection_algorithm(apply_fn, lr=3e-3, epochs=2)
+    sim = FedSimulator(
+        fed, alg, variables,
+        SimConfig(comm_round=12, client_num_in_total=4, client_num_per_round=4,
+                  batch_size=16, frequency_of_the_test=1000),
+    )
+    hist = sim.run(apply_fn=None, log_fn=None)
+    assert hist[-1]["train_loss"] < hist[0]["train_loss"]
+
+    # IoU-matched detection quality on held-out images
+    test = fed.test_data_global
+    S = test.y.shape[1]
+    preds = np.asarray(apply_fn(sim.params, jnp.asarray(test.x[:48])))
+    matched, total = 0, 0
+    for i in range(48):
+        gt = test.y[i]
+        ys, xs = np.nonzero(gt[..., 0] > 0)
+        pb, pc, _ = decode_boxes(preds[i], obj_threshold=0.5)
+        for y, x in zip(ys, xs):
+            total += 1
+            cx = (x + gt[y, x, 2]) / S
+            cy = (y + gt[y, x, 3]) / S
+            gt_box = np.array([cx, cy, gt[y, x, 4], gt[y, x, 5]])
+            best = max((box_iou(gt_box, b) for b, c in zip(pb, pc)
+                        if c == int(gt[y, x, 1])), default=0.0)
+            if best >= 0.5:
+                matched += 1
+    recall = matched / max(total, 1)
+    assert recall > 0.5, f"IoU>=0.5 class-matched recall {recall:.2f}"
