@@ -177,10 +177,22 @@ def load_partition_data(
         test = ArrayPair(x[-n_te:], y[-n_te:])
         class_num = 10
     elif dataset in ("cifar10", "cifar100", "cinic10", "fed_cifar100"):
-        n_tr, n_te = (int(s * scale) for s in _SIZES[dataset])
-        base = "cifar100" if dataset in ("cifar100", "fed_cifar100") else "cifar10"
-        train, test = _load_cifar_arrays(data_cache_dir, base, n_tr, n_te)
-        class_num = 100 if base == "cifar100" else 10
+        from . import real_formats
+
+        if (
+            dataset == "cinic10"
+            and data_cache_dir
+            and real_formats.image_folder_splits(data_cache_dir)
+        ):
+            # real CINIC-10 ImageFolder tree (reference
+            # cinic10/data_loader.py:252-257)
+            train, test, class_num = real_formats.load_image_folder(
+                data_cache_dir, img_size=32)
+        else:
+            n_tr, n_te = (int(s * scale) for s in _SIZES[dataset])
+            base = "cifar100" if dataset in ("cifar100", "fed_cifar100") else "cifar10"
+            train, test = _load_cifar_arrays(data_cache_dir, base, n_tr, n_te)
+            class_num = 100 if base == "cifar100" else 10
     elif dataset.startswith("synthetic"):
         # synthetic_A_B -> alpha=A beta=B (reference synthetic_1_1 naming)
         parts = dataset.split("_")
@@ -188,12 +200,29 @@ def load_partition_data(
         beta = float(parts[2]) if len(parts) > 2 else 1.0
         return synthetic_alpha_beta(alpha, beta, client_num=client_num)
     elif dataset in _IMG_SPECS:
-        # ImageNet / Google Landmarks: real pipelines need the archives on
-        # disk (zero-egress image); offline the shape/cardinality-faithful
-        # synthetic stand-in keeps configs and models runnable
-        shape, class_num, seed = _IMG_SPECS[dataset]
-        n_tr, n_te = (max(class_num, int(s * scale)) for s in _SIZES[dataset])
-        train, test = make_classification_like(n_tr, n_te, shape, class_num, seed=seed)
+        from . import real_formats
+
+        # real pipelines parse-if-present (zero-egress image): Landmarks
+        # user-mapping csv keeps its NATURAL per-user partition; ImageNet
+        # parses an ImageFolder tree. Offline, the shape/cardinality-
+        # faithful synthetic stand-in keeps configs and models runnable.
+        if (
+            dataset in ("gld23k", "gld160k")
+            and data_cache_dir
+            and real_formats.landmarks_files(data_cache_dir, dataset)
+        ):
+            return real_formats.load_landmarks(data_cache_dir, dataset)
+        if (
+            dataset == "ILSVRC2012"
+            and data_cache_dir
+            and real_formats.image_folder_splits(data_cache_dir)
+        ):
+            train, test, class_num = real_formats.load_image_folder(
+                data_cache_dir, img_size=64)
+        else:
+            shape, class_num, seed = _IMG_SPECS[dataset]
+            n_tr, n_te = (max(class_num, int(s * scale)) for s in _SIZES[dataset])
+            train, test = make_classification_like(n_tr, n_te, shape, class_num, seed=seed)
     elif dataset == "stackoverflow_lr":
         # reference: bag-of-words logistic regression, 10k vocab counts ->
         # 500 tag classes (data/stackoverflow/data_loader.py)
@@ -212,36 +241,68 @@ def load_partition_data(
         train, test = gen_bow(n_tr, 18), gen_bow(n_te, 19)
         class_num = tags
     elif dataset in ("UCI", "uci_adult", "lending_club_loan"):
+        from . import real_formats
+
         # tabular binary classification (reference data/UCI, data/lending_club_loan)
-        n_feat = 14 if dataset != "lending_club_loan" else 90
-        n_tr, n_te = (int(30000 * scale) or 200, int(5000 * scale) or 64)
-        rng = np.random.default_rng(23)
-        w = rng.normal(size=(n_feat,))
+        real = None
+        if data_cache_dir:
+            for fname, parse in (
+                ("SUSY.csv", real_formats.load_susy_csv),
+                ("SUSY.csv.gz", real_formats.load_susy_csv),
+                ("loan.csv", real_formats.load_lending_club_csv),
+            ):
+                p = os.path.join(data_cache_dir, fname)
+                if os.path.exists(p) and (
+                    (fname == "loan.csv") == (dataset == "lending_club_loan")
+                ):
+                    real = parse(p)
+                    break
+        if real is not None:
+            n_te = max(1, len(real.x) // 6)
+            train = ArrayPair(real.x[:-n_te], real.y[:-n_te])
+            test = ArrayPair(real.x[-n_te:], real.y[-n_te:])
+        else:
+            n_feat = 14 if dataset != "lending_club_loan" else 90
+            n_tr, n_te = (int(30000 * scale) or 200, int(5000 * scale) or 64)
+            rng = np.random.default_rng(23)
+            w = rng.normal(size=(n_feat,))
 
-        def gen_tab(n, s):
-            r = np.random.default_rng(s)
-            x = r.normal(size=(n, n_feat)).astype(np.float32)
-            y = ((x @ w + 0.3 * r.normal(size=n)) > 0).astype(np.int32)
-            return ArrayPair(x, y)
+            def gen_tab(n, s):
+                r = np.random.default_rng(s)
+                x = r.normal(size=(n, n_feat)).astype(np.float32)
+                y = ((x @ w + 0.3 * r.normal(size=n)) > 0).astype(np.int32)
+                return ArrayPair(x, y)
 
-        train, test = gen_tab(n_tr, 24), gen_tab(n_te, 25)
+            train, test = gen_tab(n_tr, 24), gen_tab(n_te, 25)
         class_num = 2
     elif dataset == "NUS_WIDE":
+        from . import real_formats
+
         # multi-modal tabular features (reference data/NUS_WIDE feeds vertical
         # FL: 634 low-level image features + 1000 tag features, 2+ parties)
-        n_feat = 634 + 1000 if not small else 64
-        n_tr, n_te = (int(20000 * scale) or 200, int(4000 * scale) or 64)
-        rng = np.random.default_rng(29)
-        w = rng.normal(size=(n_feat, 5))
+        if data_cache_dir and real_formats.nus_wide_files(data_cache_dir):
+            fx, fl, _concepts = real_formats.load_nus_wide(
+                data_cache_dir, "Train")
+            tx, tl, _ = real_formats.load_nus_wide(data_cache_dir, "Test")
+            # single-label view: argmax concept (samples with no concept ->
+            # class 0), the reference's top-k-concept selection role
+            train = ArrayPair(fx, fl.argmax(1).astype(np.int32))
+            test = ArrayPair(tx, tl.argmax(1).astype(np.int32))
+            class_num = fl.shape[1]
+        else:
+            n_feat = 634 + 1000 if not small else 64
+            n_tr, n_te = (int(20000 * scale) or 200, int(4000 * scale) or 64)
+            rng = np.random.default_rng(29)
+            w = rng.normal(size=(n_feat, 5))
 
-        def gen_nus(n, s):
-            r = np.random.default_rng(s)
-            x = r.normal(size=(n, n_feat)).astype(np.float32)
-            y = np.argmax(x @ w + 0.5 * r.normal(size=(n, 5)), axis=1).astype(np.int32)
-            return ArrayPair(x, y)
+            def gen_nus(n, s):
+                r = np.random.default_rng(s)
+                x = r.normal(size=(n, n_feat)).astype(np.float32)
+                y = np.argmax(x @ w + 0.5 * r.normal(size=(n, 5)), axis=1).astype(np.int32)
+                return ArrayPair(x, y)
 
-        train, test = gen_nus(n_tr, 30), gen_nus(n_te, 31)
-        class_num = 5
+            train, test = gen_nus(n_tr, 30), gen_nus(n_te, 31)
+            class_num = 5
     elif dataset in ("fets2021", "FeTS2021"):
         # medical segmentation (reference data/FeTS2021); 2D stand-in with 4
         # tissue classes, per-pixel labels flattened like seg_synthetic
