@@ -114,3 +114,12 @@ def run_hierarchical_cross_silo_client(args: Optional[Arguments] = None):
 
     args = args or _global_args or init()
     return HierarchicalClient(args).run()
+
+
+def run_centralized(args: Optional[Arguments] = None):
+    """Centralized (non-federated) baseline over the same data plane —
+    reference ``centralized/centralized_trainer.py:9``."""
+    from .centralized import run_centralized as _run
+
+    args = args or _global_args or init()
+    return _run(args)

@@ -1,0 +1,59 @@
+"""Centralized trainer: non-federated baseline runs over the same data plane.
+
+Parity: reference ``python/fedml/centralized/centralized_trainer.py:9``
+(``CentralizedTrainer`` — "train federated non-IID dataset in a centralized
+way"; consumes the positional dataset tuple, runs plain epoch SGD, evals per
+epoch). Redesign: the centralized baseline is the FL engine degenerated to
+one client holding everything — ``data.load(centralized=True)`` puts every
+sample on client 0 and one "round" of the compiled simulator is exactly one
+centralized epoch, so the baseline shares the jitted hot loop, eval, and
+metric plumbing instead of duplicating them.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+
+class CentralizedTrainer:
+    """Reference-named facade over the one-client simulator."""
+
+    def __init__(self, dataset=None, model=None, device=None, args=None):
+        import copy
+        import dataclasses
+
+        from .simulation import build_simulator
+
+        assert args is not None, "args required (fedml_tpu.init output)"
+        # work on a copy — the caller's args must stay valid for federated
+        # runs (and repeated centralized ones)
+        args = copy.copy(args)
+        args.centralized = True
+        args.client_num_in_total = 1
+        args.client_num_per_round = 1
+        # one round == one epoch over the full dataset: epochs stays the
+        # per-round epoch count (1), comm_round carries args.epochs
+        epochs = int(getattr(args, "epochs", 1) or 1)
+        args.comm_round = epochs
+        args.epochs = 1
+        self.args = args
+        self.sim, self.apply_fn = build_simulator(args, fed_data=dataset,
+                                                  model=model)
+        # every "round" (= epoch) evaluates, like the reference's per-epoch
+        # eval loop (centralized_trainer.py train/eval cadence)
+        self.sim.cfg = dataclasses.replace(self.sim.cfg,
+                                           frequency_of_the_test=1)
+
+    def train(self) -> List[dict]:
+        """Run the centralized epochs; returns per-epoch history records
+        with train/test loss + accuracy."""
+        return self.sim.run(self.apply_fn)
+
+    @property
+    def params(self):
+        return self.sim.params
+
+
+def run_centralized(args) -> List[dict]:
+    """One-call centralized baseline (dataset/model from the factories)."""
+    return CentralizedTrainer(args=args).train()

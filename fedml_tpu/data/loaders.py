@@ -244,17 +244,16 @@ def load_partition_data(
         from . import real_formats
 
         # tabular binary classification (reference data/UCI, data/lending_club_loan)
+        if dataset == "lending_club_loan":
+            candidates = (("loan.csv", real_formats.load_lending_club_csv),)
+        else:
+            candidates = (("SUSY.csv", real_formats.load_susy_csv),
+                          ("SUSY.csv.gz", real_formats.load_susy_csv))
         real = None
         if data_cache_dir:
-            for fname, parse in (
-                ("SUSY.csv", real_formats.load_susy_csv),
-                ("SUSY.csv.gz", real_formats.load_susy_csv),
-                ("loan.csv", real_formats.load_lending_club_csv),
-            ):
+            for fname, parse in candidates:
                 p = os.path.join(data_cache_dir, fname)
-                if os.path.exists(p) and (
-                    (fname == "loan.csv") == (dataset == "lending_club_loan")
-                ):
+                if os.path.exists(p):
                     real = parse(p)
                     break
         if real is not None:

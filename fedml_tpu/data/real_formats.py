@@ -55,11 +55,17 @@ def image_folder_splits(root: str) -> Optional[Tuple[str, str]]:
     return None
 
 
-def load_image_folder(root: str, img_size: int) -> Tuple[ArrayPair, ArrayPair, int]:
+def load_image_folder(root: str, img_size: int,
+                      max_images: int = 50_000) -> Tuple[ArrayPair, ArrayPair, int]:
     """ImageFolder tree -> (train, test, class_num). Classes are the sorted
     union of the split subdirectories (torchvision semantics) — a class
     present only in test/ (partial download) still evaluates instead of
-    silently dropping its samples."""
+    silently dropping its samples.
+
+    ``max_images`` bounds the eager float32 decode per split (real
+    ImageNet train is ~1.28M images ≈ 60 GB at 64px); truncation is
+    round-robin over classes so every class keeps proportional coverage.
+    """
     splits = image_folder_splits(root)
     assert splits is not None, f"no ImageFolder layout under {root}"
     train_dir, test_dir = splits
@@ -72,15 +78,26 @@ def load_image_folder(root: str, img_size: int) -> Tuple[ArrayPair, ArrayPair, i
     cls_idx = {c: i for i, c in enumerate(classes)}
 
     def load_split(d: str) -> ArrayPair:
-        xs, ys = [], []
+        per_class = []
         for c in classes:
-            pattern = os.path.join(d, c, "*")
-            for p in sorted(glob.glob(pattern)):
-                if os.path.splitext(p)[1].lower() not in (
-                        ".png", ".jpg", ".jpeg", ".bmp"):
-                    continue
-                xs.append(_load_image(p, img_size))
-                ys.append(cls_idx[c])
+            paths = [
+                p for p in sorted(glob.glob(os.path.join(d, c, "*")))
+                if os.path.splitext(p)[1].lower() in (
+                    ".png", ".jpg", ".jpeg", ".bmp")
+            ]
+            per_class.append((cls_idx[c], paths))
+        xs, ys = [], []
+        depth = 0
+        while len(xs) < max_images:
+            advanced = False
+            for ci, paths in per_class:
+                if depth < len(paths) and len(xs) < max_images:
+                    xs.append(_load_image(paths[depth], img_size))
+                    ys.append(ci)
+                    advanced = True
+            if not advanced:
+                break
+            depth += 1
         if not xs:
             return ArrayPair(
                 np.zeros((0, img_size, img_size, 3), np.float32),
@@ -135,16 +152,25 @@ def load_landmarks(root: str, name: str, img_size: int = 64,
 
     all_train_rows = read_rows(train_csv)
     test_rows = read_rows(test_csv)[:max_images]
-    classes = sorted({int(r["class"]) for r in all_train_rows + test_rows})
-    remap = {c: i for i, c in enumerate(classes)}
 
+    by_user: Dict[str, List[dict]] = {}
+    for r in all_train_rows:
+        by_user.setdefault(r["user_id"], []).append(r)
     per_user: Dict[str, List[int]] = {}
     train_rows: List[dict] = []
-    for r in sorted(all_train_rows, key=lambda r: r["user_id"]):
-        if len(train_rows) >= max_images:
+    for user, rows in sorted(by_user.items()):
+        # users stay WHOLE: stop before a user that would blow the budget
+        # (the first user always fits, so the result is never empty)
+        if train_rows and len(train_rows) + len(rows) > max_images:
             break
-        per_user.setdefault(r["user_id"], []).append(len(train_rows))
-        train_rows.append(r)
+        per_user[user] = list(range(len(train_rows),
+                                    len(train_rows) + len(rows)))
+        train_rows.extend(rows)
+
+    # classes from the rows actually kept — a fully-truncated class must
+    # not inflate the model's output dimension
+    classes = sorted({int(r["class"]) for r in train_rows + test_rows})
+    remap = {c: i for i, c in enumerate(classes)}
 
     train_x = np.stack([img(r["image_id"]) for r in train_rows])
     train_y = np.asarray([remap[int(r["class"])] for r in train_rows], np.int32)

@@ -1,0 +1,104 @@
+"""Transport microbenchmark: loopback / gRPC / TRPC round-trip + throughput.
+
+Parity: reference ``test/grpc_benchmark/`` (standalone gRPC throughput bench
+with its own proto and multi-machine launcher — no committed results). Here
+one script covers every in-repo point-to-point backend, measures median
+round-trip latency and payload throughput for model-sized tensors, and
+prints ONE JSON line per backend so results can be committed.
+
+Usage:  python scripts/bench_transport.py [--sizes 1000,1000000] [--repeats 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, __import__("os").path.dirname(
+    __import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+
+from fedml_tpu.comm.message import Message  # noqa: E402
+
+
+class _Collector:
+    def __init__(self):
+        self.event = threading.Event()
+        self.payload = None
+
+    def receive_message(self, msg_type, msg):
+        self.payload = msg.get("tensor")
+        self.event.set()
+
+
+def _bench_pair(send_mgr, recv_mgr, sizes, repeats):
+    col = _Collector()
+    recv_mgr.add_observer(col)
+    loop = threading.Thread(target=recv_mgr.handle_receive_message, daemon=True)
+    loop.start()
+    out = {}
+    for n in sizes:
+        payload = np.arange(n, dtype=np.float32)
+        times = []
+        for _ in range(repeats):
+            col.event.clear()
+            msg = Message(type="bench", sender_id=0, receiver_id=1)
+            msg.add_params("tensor", payload)
+            t0 = time.perf_counter()
+            send_mgr.send_message(msg)
+            assert col.event.wait(timeout=60), "delivery timed out"
+            times.append(time.perf_counter() - t0)
+            np.testing.assert_array_equal(col.payload, payload)
+        times.sort()
+        median = times[len(times) // 2]
+        out[n] = {
+            "latency_ms": round(median * 1e3, 3),
+            "throughput_MBps": round(payload.nbytes / median / 1e6, 1),
+        }
+    return out
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes", default="1000,100000,10000000")
+    ap.add_argument("--repeats", type=int, default=5)
+    args = ap.parse_args()
+    sizes = [int(s) for s in args.sizes.split(",")]
+
+    results = {}
+
+    from fedml_tpu.comm.loopback import LoopbackCommManager, LoopbackHub
+
+    hub = LoopbackHub()
+    lb0 = LoopbackCommManager(rank=0, size=2, hub=hub)
+    lb1 = LoopbackCommManager(rank=1, size=2, hub=hub)
+    results["LOOPBACK"] = _bench_pair(lb0, lb1, sizes, args.repeats)
+    lb0.stop_receive_message(), lb1.stop_receive_message()
+
+    from fedml_tpu.comm.trpc_backend import TRPCCommManager
+
+    t0m = TRPCCommManager(rank=0, size=2, base_port=23890)
+    t1m = TRPCCommManager(rank=1, size=2, base_port=23890)
+    results["TRPC"] = _bench_pair(t0m, t1m, sizes, args.repeats)
+    t0m.stop_receive_message(), t1m.stop_receive_message()
+
+    try:
+        from fedml_tpu.comm.grpc_backend import GRPCCommManager
+
+        g0 = GRPCCommManager(rank=0, size=2, base_port=23990)
+        g1 = GRPCCommManager(rank=1, size=2, base_port=23990)
+        results["GRPC"] = _bench_pair(g0, g1, sizes, args.repeats)
+        g0.stop_receive_message(), g1.stop_receive_message()
+    except ImportError:
+        results["GRPC"] = "grpcio unavailable"
+
+    for backend, r in results.items():
+        print(json.dumps({"backend": backend, "results": r}))
+
+
+if __name__ == "__main__":
+    main()

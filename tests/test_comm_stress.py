@@ -1,0 +1,82 @@
+"""Concurrency stress: interleaved sends on shared transports stay framed.
+
+The reference has no race detection at all (SURVEY.md §5.2 — ad-hoc locks,
+threads killed via PyThreadState_SetAsyncExc). These tests hammer the
+in-repo transports from many threads and assert zero loss/corruption —
+the closest Python gets to a sanitizer pass for the comm plane.
+"""
+
+import threading
+
+import numpy as np
+
+from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.trpc_backend import TRPCCommManager
+
+
+def test_trpc_concurrent_senders_no_interleave():
+    """8 threads x 25 tensor messages over ONE pipe: every frame must
+    arrive intact (the per-receiver send lock is what's under test)."""
+    m0 = TRPCCommManager(rank=0, size=2, base_port=24890)
+    m1 = TRPCCommManager(rank=1, size=2, base_port=24890)
+    n_threads, n_msgs = 8, 25
+    try:
+        def sender(tid):
+            for k in range(n_msgs):
+                msg = Message(type="t", sender_id=0, receiver_id=1)
+                val = tid * 1000 + k
+                msg.add_params("tag", val)
+                msg.add_params("tensor", np.full((500,), val, np.float32))
+                m0.send_message(msg)
+
+        threads = [threading.Thread(target=sender, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        got = {}
+        for _ in range(n_threads * n_msgs):
+            msg = m1._inbox.get(timeout=30)
+            tag = msg.get("tag")
+            arr = msg.get("tensor")
+            np.testing.assert_array_equal(arr, np.full((500,), tag, np.float32))
+            got[tag] = got.get(tag, 0) + 1
+        assert len(got) == n_threads * n_msgs
+        assert all(v == 1 for v in got.values())
+    finally:
+        m0.stop_receive_message()
+        m1.stop_receive_message()
+
+
+def test_pubsub_concurrent_publishers_no_loss():
+    """Filesystem broker: concurrent publishers on one topic — atomic
+    publishes, no dropped or duplicated deliveries."""
+    import tempfile
+
+    from fedml_tpu.comm.pubsub import FileSystemBroker
+
+    with tempfile.TemporaryDirectory() as root:
+        broker = FileSystemBroker(root=root)
+        seen = []
+        lock = threading.Lock()
+        broker.subscribe("jobs", lambda topic, payload: (
+            lock.__enter__(), seen.append(bytes(payload)), lock.__exit__(None, None, None)))
+
+        def pub(tid):
+            for k in range(20):
+                broker.publish("jobs", f"{tid}:{k}".encode())
+
+        threads = [threading.Thread(target=pub, args=(t,)) for t in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        deadline = threading.Event()
+        for _ in range(200):
+            if len(seen) >= 120:
+                break
+            deadline.wait(0.05)
+        assert sorted(seen) == sorted(
+            f"{t}:{k}".encode() for t in range(6) for k in range(20))
+        broker.close()
