@@ -39,15 +39,25 @@ class SelfAttention(nn.Module):
     num_heads: int
     causal: bool = True
     dtype: jnp.dtype = jnp.float32
-    # sequence parallelism: when set (with ``mesh``), attention runs as ring
-    # attention inside shard_map over this mesh axis — K/V blocks rotate via
-    # ppermute, memory stays O(T/n) per device (ops/attention.py)
+    # sequence parallelism: when set (with ``mesh``), attention runs
+    # sequence-sharded inside shard_map over this mesh axis.
+    # ``sp_impl`` picks the collective pattern (ops/attention.py):
+    #   "ring"    — K/V blocks rotate via ppermute, online softmax;
+    #               O(T/n) memory per device (extreme context lengths).
+    #   "ulysses" — two all-to-alls re-shard seq<->heads; full-sequence
+    #               attention runs locally (flash-kernel eligible);
+    #               needs num_heads % axis_size == 0.
     seq_axis: Optional[str] = None
     mesh: Optional[object] = None
+    sp_impl: str = "ring"
 
     @nn.compact
     def __call__(self, x):
-        from ..ops.attention import multihead_attention, ring_attention
+        from ..ops.attention import (
+            multihead_attention,
+            ring_attention,
+            ulysses_attention,
+        )
 
         B, T, D = x.shape
         H = self.num_heads
@@ -59,11 +69,17 @@ class SelfAttention(nn.Module):
             from jax import shard_map
             from jax.sharding import PartitionSpec as P
 
+            if self.sp_impl == "ulysses":
+                sp_fn = lambda q, k, v: ulysses_attention(  # noqa: E731
+                    q, k, v, self.seq_axis, causal=self.causal)
+            elif self.sp_impl == "ring":
+                sp_fn = lambda q, k, v: ring_attention(  # noqa: E731
+                    q, k, v, self.seq_axis, causal=self.causal)
+            else:
+                raise ValueError(f"unknown sp_impl '{self.sp_impl}'")
             spec = P(None, self.seq_axis, None, None)
             out = shard_map(
-                lambda q, k, v: ring_attention(
-                    q, k, v, self.seq_axis, causal=self.causal
-                ),
+                sp_fn,
                 mesh=self.mesh,
                 in_specs=(spec, spec, spec),
                 out_specs=spec,
@@ -82,12 +98,13 @@ class Block(nn.Module):
     dtype: jnp.dtype = jnp.float32
     seq_axis: Optional[str] = None
     mesh: Optional[object] = None
+    sp_impl: str = "ring"
 
     @nn.compact
     def __call__(self, x):
         x = x + SelfAttention(
             self.dim, self.num_heads, self.causal, self.dtype,
-            seq_axis=self.seq_axis, mesh=self.mesh,
+            seq_axis=self.seq_axis, mesh=self.mesh, sp_impl=self.sp_impl,
         )(nn.LayerNorm(dtype=self.dtype)(x))
         x = x + MLPBlock(self.dim, dtype=self.dtype)(nn.LayerNorm(dtype=self.dtype)(x))
         return x
@@ -104,6 +121,7 @@ class TransformerLM(nn.Module):
     dtype: jnp.dtype = jnp.float32
     seq_axis: Optional[str] = None
     mesh: Optional[object] = None
+    sp_impl: str = "ring"
 
     @nn.compact
     def __call__(self, tokens, train: bool = False):
@@ -115,7 +133,8 @@ class TransformerLM(nn.Module):
         h = h + pos
         for i in range(self.num_layers):
             h = Block(self.dim, self.num_heads, causal=True, dtype=self.dtype,
-                      seq_axis=self.seq_axis, mesh=self.mesh, name=f"block_{i}")(h)
+                      seq_axis=self.seq_axis, mesh=self.mesh,
+                      sp_impl=self.sp_impl, name=f"block_{i}")(h)
         h = nn.LayerNorm(dtype=self.dtype, name="ln_f")(h)
         return nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype, name="head")(h)
 

@@ -46,6 +46,10 @@ class DistTrainConfig:
     lr: float = 3e-4
     weight_decay: float = 0.01
     use_remat: bool = True   # jax.checkpoint the blocks: FLOPs for HBM
+    # sequence-parallel collective pattern: "ring" (ppermute blockwise,
+    # O(T/sp) memory) or "ulysses" (all-to-all seq<->heads re-shard,
+    # full-sequence flash-eligible attention; heads % sp == 0)
+    sp_impl: str = "ring"
 
 
 def make_lm_mesh(cfg: DistTrainConfig, devices=None) -> Mesh:
@@ -105,6 +109,7 @@ class DistributedLMTrainer:
             num_layers=num_layers, max_len=max_len, dtype=dtype,
             seq_axis=AXIS_SEQ if cfg.sp > 1 else None,
             mesh=self.mesh if cfg.sp > 1 else None,
+            sp_impl=cfg.sp_impl,
         )
         # init on host with a tiny batch, then place with TP shardings; the
         # init token length must divide by sp (ring attention shards T)
