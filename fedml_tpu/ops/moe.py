@@ -18,6 +18,23 @@ import jax
 import jax.numpy as jnp
 
 
+def _rank_queue(onehot: jax.Array, capacity: int, offset=0.0):
+    """One choice-rank's capacity queue: (N, E) routing one-hot ->
+    (dispatch slice (N, E, C), keep mask (N, E)). ``offset`` shifts queue
+    positions (second choices append after first choices)."""
+    pos = (jnp.cumsum(onehot, axis=0) - 1.0) * onehot + offset * onehot
+    keep = (pos < capacity) * onehot
+    p = jnp.clip(pos.astype(jnp.int32), 0, capacity - 1)
+    return jax.nn.one_hot(p, capacity) * keep[..., None], keep
+
+
+def _balance_aux(first_onehot: jax.Array, probs: jax.Array,
+                 num_experts: int) -> jax.Array:
+    """Shazeer/GShard load-balance loss: E * <fraction routed, mean prob>."""
+    return num_experts * jnp.sum(
+        first_onehot.mean(axis=0) * probs.mean(axis=0))
+
+
 def top1_routing(
     gate_logits: jax.Array, num_experts: int, capacity: int
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
@@ -27,34 +44,54 @@ def top1_routing(
     position-in-expert computed with a cumulative sum, everything static-shape.
     """
     probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
-    expert_idx = jnp.argmax(probs, axis=-1)                      # (N,)
-    expert_onehot = jax.nn.one_hot(expert_idx, num_experts)      # (N, E)
-    # position of each token within its expert's queue
-    pos_in_expert = (jnp.cumsum(expert_onehot, axis=0) - 1.0) * expert_onehot
-    keep = (pos_in_expert < capacity) * expert_onehot            # (N, E)
-    pos = jnp.clip(pos_in_expert.astype(jnp.int32), 0, capacity - 1)
-    pos_onehot = jax.nn.one_hot(pos, capacity) * keep[..., None]  # (N, E, C)
+    expert_onehot = jax.nn.one_hot(jnp.argmax(probs, axis=-1), num_experts)
+    dispatch, keep = _rank_queue(expert_onehot, capacity)
     gate = (probs * keep).sum(axis=-1, keepdims=True)            # (N, 1)
-    dispatch = pos_onehot
-    combine = pos_onehot * gate[..., None]
-    # aux load-balance loss: E * <fraction routed, mean gate prob>
-    frac = expert_onehot.mean(axis=0)
-    mean_prob = probs.mean(axis=0)
-    aux = num_experts * jnp.sum(frac * mean_prob)
-    return dispatch, combine, aux
+    combine = dispatch * gate[..., None]
+    return dispatch, combine, _balance_aux(expert_onehot, probs, num_experts)
+
+
+def top2_routing(
+    gate_logits: jax.Array, num_experts: int, capacity: int
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-2 gating (GShard/Switch-v2 style): each token routes to its two
+    highest-probability experts, gates renormalized over the kept pair,
+    independent capacity queues per choice rank (second choices only use
+    capacity left by first choices). Same (dispatch, combine, aux) contract
+    as :func:`top1_routing` — everything stays static-shape einsum fodder.
+    """
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    oh1 = jax.nn.one_hot(jnp.argmax(probs, axis=-1), num_experts)
+    oh2 = jax.nn.one_hot(jnp.argmax(probs * (1.0 - oh1), axis=-1), num_experts)
+
+    # first choices fill the queues first; second choices append after
+    d1, _ = _rank_queue(oh1, capacity)
+    d2, _ = _rank_queue(oh2, capacity, offset=oh1.sum(axis=0, keepdims=True))
+    dispatch = d1 + d2
+    # gates renormalized over the two choices; d1/d2 already carry the
+    # keep masks, so dropped slots contribute nothing
+    g1 = (probs * oh1).sum(-1)
+    g2 = (probs * oh2).sum(-1)
+    denom = jnp.maximum(g1 + g2, 1e-9)
+    combine = (d1 * (g1 / denom)[:, None, None]
+               + d2 * (g2 / denom)[:, None, None])
+    # aux balance loss on FIRST choices (GShard convention)
+    return dispatch, combine, _balance_aux(oh1, probs, num_experts)
 
 
 class MoEBlock(nn.Module):
-    """Top-1 MoE FFN. Input (B, T, D) -> ``(out (B, T, D), aux_loss scalar)``;
-    stacked expert kernels (E, D, H)/(E, H, D) are the leaves to shard over
-    ``AXIS_EXPERT``. Callers must add ``aux_weight * aux_loss`` (typically
-    1e-2) to their objective — without it the router has no balancing
-    pressure and can collapse all tokens onto one expert."""
+    """MoE FFN (top-1 or top-2 routing). Input (B, T, D) ->
+    ``(out (B, T, D), aux_loss scalar)``; stacked expert kernels
+    (E, D, H)/(E, H, D) are the leaves to shard over ``AXIS_EXPERT``.
+    Callers must add ``aux_weight * aux_loss`` (typically 1e-2) to their
+    objective — without it the router has no balancing pressure and can
+    collapse all tokens onto one expert."""
 
     num_experts: int = 8
     dim: int = 256
     hidden_mult: int = 4
     capacity_factor: float = 1.25
+    top_k: int = 1
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -63,10 +100,16 @@ class MoEBlock(nn.Module):
         N = B * T
         E = self.num_experts
         H = self.dim * self.hidden_mult
-        C = max(1, int(self.capacity_factor * N / E))
+        # top-2 sends ~2x the tokens through the queues
+        C = max(1, int(self.capacity_factor * self.top_k * N / E))
         tokens = x.reshape(N, D)
         gate_logits = nn.Dense(E, use_bias=False, dtype=self.dtype, name="gate")(tokens)
-        dispatch, combine, aux = top1_routing(gate_logits, E, C)
+        if self.top_k == 2:
+            dispatch, combine, aux = top2_routing(gate_logits, E, C)
+        elif self.top_k == 1:
+            dispatch, combine, aux = top1_routing(gate_logits, E, C)
+        else:
+            raise ValueError(f"top_k must be 1 or 2, got {self.top_k}")
 
         w_in = self.param("w_in", nn.initializers.lecun_normal(), (E, D, H), self.dtype)
         w_out = self.param("w_out", nn.initializers.lecun_normal(), (E, H, D), self.dtype)

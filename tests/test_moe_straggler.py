@@ -171,3 +171,61 @@ def test_round_times_out_with_zero_uploads():
     for t in threads:
         t.join(timeout=30)
     assert len(server.history) == 2
+
+
+def test_top2_routing_properties():
+    from fedml_tpu.ops.moe import top2_routing
+
+    rng = np.random.default_rng(3)
+    N, E, C = 64, 4, 40
+    logits = jnp.asarray(rng.normal(size=(N, E)), jnp.float32)
+    dispatch, combine, aux = top2_routing(logits, num_experts=E, capacity=C)
+    assert dispatch.shape == (N, E, C)
+    # with ample capacity every token occupies exactly two expert slots
+    np.testing.assert_allclose(np.asarray(dispatch.sum(axis=(1, 2))), 2.0)
+    # combine gates renormalize over the kept pair -> sum to 1 per token
+    np.testing.assert_allclose(
+        np.asarray(combine.sum(axis=(1, 2))), 1.0, atol=1e-5)
+    # each (expert, slot) queue position holds at most one token
+    assert float(dispatch.sum(axis=0).max()) <= 1.0 + 1e-6
+    assert np.isfinite(float(aux))
+
+
+def test_top2_capacity_drops_second_choices_first():
+    from fedml_tpu.ops.moe import top2_routing
+
+    # all tokens prefer expert 0 then expert 1: tight capacity keeps
+    # expert-0 first choices up to C and drops overflow
+    N, E, C = 16, 4, 4
+    logits = jnp.tile(jnp.asarray([[5.0, 4.0, 0.0, -5.0]]), (N, 1))
+    dispatch, combine, _ = top2_routing(logits, num_experts=E, capacity=C)
+    per_expert = np.asarray(dispatch.sum(axis=(0, 2)))
+    assert per_expert[0] == C  # expert 0 full with first choices
+    assert per_expert[1] == C  # expert 1 full with second choices
+    assert per_expert[2] == per_expert[3] == 0
+
+
+def test_moe_block_top2_learns_routing():
+    """Top-2 block trains end-to-end (gradients flow through both ranks'
+    dispatch/combine and the aux loss)."""
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(size=(4, 8, 8)), jnp.float32)
+    y = jnp.asarray(rng.normal(size=(4, 8, 8)), jnp.float32)
+    block = MoEBlock(num_experts=4, dim=8, hidden_mult=2, top_k=2)
+    params = block.init(jax.random.PRNGKey(0), x)
+
+    def loss_fn(p):
+        out, aux = block.apply(p, x)
+        return jnp.mean((out - y) ** 2) + 1e-2 * aux
+
+    import optax
+
+    opt = optax.adam(1e-2)
+    state = opt.init(params)
+    losses = []
+    for _ in range(60):
+        l, g = jax.value_and_grad(loss_fn)(params)
+        updates, state = opt.update(g, state, params)
+        params = optax.apply_updates(params, updates)
+        losses.append(float(l))
+    assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
