@@ -62,7 +62,12 @@ def top2_routing(
     """
     probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
     oh1 = jax.nn.one_hot(jnp.argmax(probs, axis=-1), num_experts)
-    oh2 = jax.nn.one_hot(jnp.argmax(probs * (1.0 - oh1), axis=-1), num_experts)
+    # second choice masked in LOGIT space: with saturated gates the masked
+    # probs underflow to an all-zero row and argmax would phantom-route to
+    # expert 0, wasting its capacity on zero-gate tokens
+    masked_logits = jnp.where(oh1 > 0, -jnp.inf,
+                              gate_logits.astype(jnp.float32))
+    oh2 = jax.nn.one_hot(jnp.argmax(masked_logits, axis=-1), num_experts)
 
     # first choices fill the queues first; second choices append after
     d1, _ = _rank_queue(oh1, capacity)

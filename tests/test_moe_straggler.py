@@ -229,3 +229,15 @@ def test_moe_block_top2_learns_routing():
         params = optax.apply_updates(params, updates)
         losses.append(float(l))
     assert losses[-1] < losses[0] * 0.6, (losses[0], losses[-1])
+
+
+def test_top2_saturated_gates_no_phantom_routing():
+    """Saturated logits (softmax underflow) must still pick the true
+    second-best expert, not phantom-route to expert 0 (review finding)."""
+    from fedml_tpu.ops.moe import top2_routing
+
+    logits = jnp.tile(jnp.asarray([[-100.0, -100.0, 0.0, -99.0]]), (4, 1))
+    dispatch, _, _ = top2_routing(logits, num_experts=4, capacity=8)
+    per_expert = np.asarray(dispatch.sum(axis=(0, 2)))
+    # first choice expert 2, second choice expert 3 — expert 0 untouched
+    np.testing.assert_array_equal(per_expert, [0.0, 0.0, 4.0, 4.0])
