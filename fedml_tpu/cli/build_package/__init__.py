@@ -1,0 +1,13 @@
+"""Default build-package entry skeletons (reference ``cli/build-package/``).
+
+``fedml_tpu build`` falls back to this directory as the source folder when
+the caller passes ``--source_folder default`` — packaging the stock
+client/server entries exactly like the reference platform does when the
+user brings only a config.
+"""
+
+import os
+
+SKELETON_DIR = os.path.dirname(os.path.abspath(__file__))
+CLIENT_ENTRY = "tpu_client.py"
+SERVER_ENTRY = "tpu_server.py"

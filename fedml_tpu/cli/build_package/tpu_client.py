@@ -1,0 +1,9 @@
+"""Minimal client entry for built packages (reference
+``cli/build-package/mlops-core/.../torch_client.py`` — a 5-line entry the
+platform packages when the user supplies no custom source)."""
+
+import fedml_tpu
+
+if __name__ == "__main__":
+    args = fedml_tpu.init()
+    fedml_tpu.run_cross_silo_client(args)

@@ -1,0 +1,8 @@
+"""Minimal server entry for built packages (reference
+``cli/build-package/mlops-core/.../torch_server.py``)."""
+
+import fedml_tpu
+
+if __name__ == "__main__":
+    args = fedml_tpu.init()
+    fedml_tpu.run_cross_silo_server(args)

@@ -113,18 +113,35 @@ def logs(client, server, lines):
 @click.option("--dest_folder", "-df", required=True)
 def build(pkg_type, source_folder, entry_point, config_folder, dest_folder):
     """Reference ``fedml build`` (cli.py:351 ``build_mlops_package:434``):
-    zips entry + source + config into a deployable package."""
+    zips entry + source + config into a deployable package.
+
+    ``--source_folder default`` packages the stock skeleton entries
+    (cli/build_package — reference ``cli/build-package/mlops-core``); a
+    real directory named ``default`` takes precedence over the sentinel."""
+    if source_folder == "default" and not os.path.isdir(source_folder):
+        from . import build_package as _bp
+
+        source_folder = _bp.SKELETON_DIR
+        entry_point = (_bp.SERVER_ENTRY if pkg_type == "server"
+                       else _bp.CLIENT_ENTRY)
+        click.echo(f"using stock skeleton source (entry {entry_point})")
     os.makedirs(dest_folder, exist_ok=True)
     out = os.path.join(dest_folder, f"fedml_tpu-{pkg_type}-package.zip")
+
+    def _walk_clean(top):
+        # no bytecode in deployable packages: contents must be
+        # deterministic across build hosts
+        for root, dirs, files in os.walk(top):
+            dirs[:] = [d for d in dirs if d != "__pycache__"]
+            for name in files:
+                if not name.endswith((".pyc", ".pyo")):
+                    yield os.path.join(root, name)
+
     with zipfile.ZipFile(out, "w", zipfile.ZIP_DEFLATED) as z:
-        for root, _, files in os.walk(source_folder):
-            for name in files:
-                full = os.path.join(root, name)
-                z.write(full, os.path.join("source", os.path.relpath(full, source_folder)))
-        for root, _, files in os.walk(config_folder):
-            for name in files:
-                full = os.path.join(root, name)
-                z.write(full, os.path.join("config", os.path.relpath(full, config_folder)))
+        for full in _walk_clean(source_folder):
+            z.write(full, os.path.join("source", os.path.relpath(full, source_folder)))
+        for full in _walk_clean(config_folder):
+            z.write(full, os.path.join("config", os.path.relpath(full, config_folder)))
         z.writestr(
             "package.json",
             json.dumps({"type": pkg_type, "entry_point": entry_point,

@@ -1,0 +1,40 @@
+"""Comm benchmarking hooks: greppable tick/tock + round markers.
+
+Parity: reference ``core/distributed/communication/utils.py:5-33`` —
+``log_communication_tick/tock`` and ``log_round_start/end`` emit stable
+prefixed log lines that benchmarking scripts grep out of run logs. Same
+prefixes here, plus the measured latency on the tock line (the reference
+leaves pairing tick->tock to the log consumer; we do both)."""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, Tuple
+
+_PENDING: Dict[Tuple[int, int], float] = {}
+
+
+def log_communication_tick(sender: int, receiver: int) -> None:
+    """Mark a send about to happen (pairs with the next tock)."""
+    _PENDING[(int(sender), int(receiver))] = time.perf_counter()
+    logging.info("--Benchmark tick: %s to %s", sender, receiver)
+
+
+def log_communication_tock(sender: int, receiver: int) -> None:
+    """Mark the matching completion; logs the measured latency when the
+    tick was seen in this process."""
+    t0 = _PENDING.pop((int(sender), int(receiver)), None)
+    if t0 is None:
+        logging.info("--Benchmark tock: %s to %s", sender, receiver)
+    else:
+        logging.info("--Benchmark tock: %s to %s latency_ms=%.3f",
+                     sender, receiver, (time.perf_counter() - t0) * 1e3)
+
+
+def log_round_start(rank: int, round_idx: int) -> None:
+    logging.info("--Benchmark start round %s on rank %s", round_idx, rank)
+
+
+def log_round_end(rank: int, round_idx: int) -> None:
+    logging.info("--Benchmark end round %s on rank %s", round_idx, rank)

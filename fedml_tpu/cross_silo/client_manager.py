@@ -13,6 +13,7 @@ import logging
 
 
 from ..comm import Message, ClientManager
+from ..comm.utils import log_communication_tick, log_communication_tock
 from .message_define import MyMessage
 
 
@@ -70,4 +71,8 @@ class FedMLClientManager(ClientManager):
         msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, update)
         msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, local_sample_num)
         msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
+        # greppable comm benchmark markers around the model upload
+        # (reference communication/utils.py tick/tock role)
+        log_communication_tick(self.rank, 0)
         self.send_message(msg)
+        log_communication_tock(self.rank, 0)

@@ -19,6 +19,7 @@ import time
 from typing import Dict, List, Optional
 
 from ..comm import Message, ServerManager
+from ..comm.utils import log_round_end, log_round_start
 from .message_define import MyMessage
 
 
@@ -76,6 +77,7 @@ class FedMLServerManager(ServerManager):
         self._on_connection_ready(None)
 
     def send_init_msg(self) -> None:
+        log_round_start(self.rank, self.round_idx)
         self.start_running_time = time.time()
         self.aggregator.set_expected_this_round(len(self.client_id_list_in_this_round))
         global_model_params = self.aggregator.get_global_model_params()
@@ -217,6 +219,7 @@ class FedMLServerManager(ServerManager):
             self.mlops_event.log_event_ended("server.agg_and_eval",
                                              event_value=str(self.round_idx))
         self.history.append({"round": self.round_idx, **metrics})
+        log_round_end(self.rank, self.round_idx)
 
         self.round_idx += 1
         if self.round_idx >= self.round_num:
@@ -236,6 +239,7 @@ class FedMLServerManager(ServerManager):
             len(self.client_id_list_in_this_round),
         )
         self.aggregator.set_expected_this_round(len(self.client_id_list_in_this_round))
+        log_round_start(self.rank, self.round_idx)
         global_model_params = self.aggregator.get_global_model_params()
         msgs = []
         for idx, client_id in enumerate(self.client_id_list_in_this_round):

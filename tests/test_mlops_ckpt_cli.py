@@ -131,3 +131,52 @@ validation_args:
     assert out.exit_code == 0, out.output
     status = json.loads((tmp_path / "state" / "status.json").read_text())
     assert status["status"] == "FINISHED"
+
+
+def test_cli_build_default_skeleton(tmp_path):
+    """--source_folder default packages the stock entries (reference
+    cli/build-package skeletons)."""
+    import zipfile
+
+    from click.testing import CliRunner
+    import fedml_tpu.cli.main as cli_main
+
+    cfgd = tmp_path / "cfg"; cfgd.mkdir(); (cfgd / "c.yaml").write_text("a: 1")
+    runner = CliRunner()
+    out = runner.invoke(cli_main.cli, [
+        "build", "-t", "server", "-sf", "default", "-ep", "ignored.py",
+        "-cf", str(cfgd), "-df", str(tmp_path / "dist"),
+    ])
+    assert out.exit_code == 0, out.output
+    pkg = tmp_path / "dist" / "fedml_tpu-server-package.zip"
+    with zipfile.ZipFile(pkg) as z:
+        names = z.namelist()
+        assert "source/tpu_server.py" in names
+        import json as _json
+
+        meta = _json.loads(z.read("package.json"))
+        assert meta["entry_point"] == "tpu_server.py"
+
+
+def test_comm_benchmark_hooks_emit_greppable_lines(caplog):
+    """Reference communication/utils.py parity: tick/tock + round markers
+    produce stable greppable prefixes."""
+    import logging
+
+    from fedml_tpu.comm.utils import (
+        log_communication_tick,
+        log_communication_tock,
+        log_round_end,
+        log_round_start,
+    )
+
+    with caplog.at_level(logging.INFO):
+        log_round_start(0, 3)
+        log_communication_tick(1, 0)
+        log_communication_tock(1, 0)
+        log_round_end(0, 3)
+    text = caplog.text
+    assert "--Benchmark start round 3 on rank 0" in text
+    assert "--Benchmark tick: 1 to 0" in text
+    assert "--Benchmark tock: 1 to 0 latency_ms=" in text
+    assert "--Benchmark end round 3 on rank 0" in text
