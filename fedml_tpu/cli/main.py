@@ -129,11 +129,11 @@ def build(pkg_type, source_folder, entry_point, config_folder, dest_folder):
     out = os.path.join(dest_folder, f"fedml_tpu-{pkg_type}-package.zip")
 
     def _walk_clean(top):
-        # no bytecode in deployable packages: contents must be
-        # deterministic across build hosts
+        # no bytecode, sorted traversal: package bytes must be
+        # deterministic across build hosts (readdir order varies)
         for root, dirs, files in os.walk(top):
-            dirs[:] = [d for d in dirs if d != "__pycache__"]
-            for name in files:
+            dirs[:] = sorted(d for d in dirs if d != "__pycache__")
+            for name in sorted(files):
                 if not name.endswith((".pyc", ".pyo")):
                     yield os.path.join(root, name)
 

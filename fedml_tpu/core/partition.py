@@ -33,10 +33,19 @@ def non_iid_partition_with_dirichlet_distribution(
     # reference parity: retry until every client holds >= 10 samples
     # (noniid_partition.py:14). When the dataset itself cannot give every
     # client 10 (N // client_num < 10, e.g. tiny test fixtures), that loop
-    # would spin forever — degrade the target to what is feasible.
+    # would spin forever — degrade the target to what is feasible. A retry
+    # CAP guards the statistically-unreachable case (many clients, few
+    # samples, low alpha: each draw leaves someone near-empty), falling
+    # back to deterministic rebalancing — the reference would spin.
     target = min(10, max(1, N // client_num))
+    max_retries = 500
+    attempts = 0
     min_size = 0
     while min_size < target:
+        attempts += 1
+        if attempts > max_retries:
+            _rebalance_to_min(idx_batch, target)
+            break
         idx_batch: List[List[int]] = [[] for _ in range(client_num)]
         if task == "segmentation":
             # label_list here is (classes, samples) of per-class presence
@@ -57,6 +66,18 @@ def non_iid_partition_with_dirichlet_distribution(
         np.random.shuffle(idx_batch[i])
         net_dataidx_map[i] = idx_batch[i]
     return net_dataidx_map
+
+
+def _rebalance_to_min(idx_batch: List[List[int]], target: int) -> None:
+    """Deterministically move samples from the largest clients to those
+    below ``target`` until everyone meets it (retry-cap fallback)."""
+    while True:
+        sizes = [len(b) for b in idx_batch]
+        lo = int(np.argmin(sizes))
+        hi = int(np.argmax(sizes))
+        if sizes[lo] >= target or sizes[hi] <= max(target, 1):
+            return
+        idx_batch[lo].append(idx_batch[hi].pop())
 
 
 def partition_class_samples_with_dirichlet_distribution(

@@ -152,9 +152,7 @@ def test_cli_build_default_skeleton(tmp_path):
     with zipfile.ZipFile(pkg) as z:
         names = z.namelist()
         assert "source/tpu_server.py" in names
-        import json as _json
-
-        meta = _json.loads(z.read("package.json"))
+        meta = json.loads(z.read("package.json"))
         assert meta["entry_point"] == "tpu_server.py"
 
 
