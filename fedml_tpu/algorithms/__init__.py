@@ -149,11 +149,24 @@ def get_algorithm(
 
     if name_l == FEDML_FEDERATED_OPTIMIZER_FEDOPT.lower():
         # Reference: simulation/sp/fedopt (server optimizer on pseudo-gradient,
-        # _set_model_global_grads:185). Pseudo-grad = -mean_delta.
-        if server_optimizer == "adam":
+        # _set_model_global_grads:185; OptRepo reflects over every torch
+        # optimizer, optrepo.py:10). The adaptive-federated-optimization trio
+        # (FedAdam / FedYogi / FedAdagrad, Reddi et al.) plus momentum SGD:
+        # case-insensitive; empty/None-ish configs mean the sgd default
+        # (callers stringify YAML values, so None arrives as "None")
+        sopt_name = str(server_optimizer or "sgd").strip().lower()
+        if sopt_name == "adam":
             sopt = optax.adam(server_lr)
-        else:
+        elif sopt_name == "yogi":
+            sopt = optax.yogi(server_lr)
+        elif sopt_name == "adagrad":
+            sopt = optax.adagrad(server_lr)
+        elif sopt_name in ("sgd", "", "none"):
             sopt = optax.sgd(server_lr, momentum=server_momentum or None)
+        else:
+            raise ValueError(
+                f"unknown server_optimizer '{server_optimizer}' "
+                f"(sgd | adam | yogi | adagrad)")
 
         def _split(tree):
             # server optimizer sees params only; BatchNorm running stats are

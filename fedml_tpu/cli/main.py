@@ -129,8 +129,9 @@ def build(pkg_type, source_folder, entry_point, config_folder, dest_folder):
     out = os.path.join(dest_folder, f"fedml_tpu-{pkg_type}-package.zip")
 
     def _walk_clean(top):
-        # no bytecode, sorted traversal: package bytes must be
-        # deterministic across build hosts (readdir order varies)
+        # no bytecode, sorted traversal: entry ORDER is deterministic
+        # across hosts (readdir order varies). Full byte-reproducibility
+        # would also need fixed zip mtimes + dropping built_at.
         for root, dirs, files in os.walk(top):
             dirs[:] = sorted(d for d in dirs if d != "__pycache__")
             for name in sorted(files):

@@ -112,3 +112,34 @@ def test_batchnorm_rejected_for_stats_corrupting_optimizers():
     ))
     with pytest.raises(ValueError, match="norm='batch'"):
         build_simulator(args)
+
+
+@pytest.mark.parametrize("sopt", ["adam", "yogi", "adagrad"])
+def test_fedopt_adaptive_server_optimizers_learn(sopt):
+    """The adaptive federated-optimization trio (Reddi et al.) on the
+    server pseudo-gradient — each must actually learn, not just run.
+    Adagrad's accumulating denominator wants a larger server lr."""
+    args = small_args(federated_optimizer="FedOpt", server_optimizer=sopt,
+                      server_lr=0.3 if sopt == "adagrad" else 0.05,
+                      comm_round=8, frequency_of_the_test=8)
+    sim, apply_fn = build_simulator(args)
+    hist = sim.run(apply_fn, log_fn=None)
+    assert hist[-1]["test_acc"] > 0.8, (sopt, hist[-1])
+
+
+def test_fedopt_unknown_server_optimizer_rejected():
+    args = small_args(federated_optimizer="FedOpt", server_optimizer="lamb")
+    with pytest.raises(ValueError, match="server_optimizer"):
+        build_simulator(args)
+
+
+def test_fedopt_server_optimizer_case_and_none_tolerant():
+    """YAML-sourced values arrive stringified: 'Adam' and None must keep
+    working (None falls back to the sgd default)."""
+    for sopt in ("Adam", "None"):
+        args = small_args(federated_optimizer="FedOpt",
+                          server_optimizer=sopt, comm_round=1,
+                          frequency_of_the_test=10)
+        sim, apply_fn = build_simulator(args)
+        hist = sim.run(apply_fn, log_fn=None)
+        assert np.isfinite(hist[-1]["train_loss"])
