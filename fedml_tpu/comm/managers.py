@@ -53,13 +53,20 @@ def create_comm_backend(backend: str, rank: int, size: int, args=None, **kw) -> 
         broker = kw.get("broker")
         store = kw.get("store")
         owns_broker = broker is None
+        if broker is None or store is None:
+            # endpoint resolution chain (cached file -> env -> defaults):
+            # reference fetches these from the platform, MLOpsConfigs
+            # (core/mlops_configs.py:15 role)
+            from ..core.mlops import MLOpsConfigs
+
+            mqtt_cfg, s3_cfg = MLOpsConfigs(args).fetch_configs()
         if broker is None:
             broker = FileSystemBroker(
-                root=getattr(args, "mqtt_broker_dir", None) or kw.get("broker_dir")
+                root=kw.get("broker_dir") or mqtt_cfg.get("broker_dir")
             )
         if store is None:
             store = FileSystemBlobStore(
-                root=getattr(args, "blob_store_dir", None) or kw.get("store_dir")
+                root=kw.get("store_dir") or s3_cfg.get("store_dir")
             )
         cls = (MqttS3MnnCommManager
                if backend == constants.COMM_BACKEND_MQTT_S3_MNN

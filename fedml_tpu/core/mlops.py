@@ -209,3 +209,80 @@ class SysStats:
 
 def generate_run_id() -> str:
     return uuid.uuid4().hex[:12]
+
+
+class MLOpsConfigs:
+    """Comm-plane credential/endpoint resolution (reference
+    ``core/mlops/mlops_configs.py:15`` — fetches MQTT/S3 configs from the
+    hosted platform over cert-pinned HTTPS). Resolution order here:
+
+    1. ``args.mlops_config_path`` — a local JSON/YAML file with
+       ``mqtt_config`` / ``s3_config`` sections (the platform response
+       format, cached on disk);
+    2. ``FEDML_TPU_MQTT_DIR`` / ``FEDML_TPU_STORE_DIR`` environment
+       variables (filesystem broker/store roots);
+    3. defaults under ``~/.fedml_tpu``.
+
+    Per-key precedence: explicit args attribute (most user-proximate) >
+    cached config file > environment > home-dir default — so a stale
+    exported env var can never hijack a run that passed its dirs
+    explicitly.
+
+    ``fetch_remote`` keeps the reference's pinned-HTTPS path for deployments
+    with a config service: ``verify`` takes the CA bundle path (the
+    pinning role of the reference's ``core/mlops/ssl/*.crt``).
+    """
+
+    def __init__(self, args=None):
+        self.args = args
+
+    def fetch_configs(self):
+        """-> (mqtt_config, s3_config) dicts; ``broker_dir``/``store_dir``
+        are always resolved."""
+        doc = {}
+        path = getattr(self.args, "mlops_config_path", None)
+        if path and os.path.exists(path):
+            try:
+                with open(path) as f:
+                    if path.endswith((".yaml", ".yml")):
+                        import yaml
+
+                        doc = yaml.safe_load(f) or {}
+                    else:
+                        doc = json.load(f)
+            except Exception as e:  # corrupt cache must name itself
+                raise ValueError(
+                    f"unparseable mlops config {path}: {e}") from e
+        home = os.path.expanduser(os.environ.get("FEDML_TPU_HOME",
+                                                 "~/.fedml_tpu"))
+
+        def resolve(args_attr, section, key, env_var, default):
+            v = getattr(self.args, args_attr, None)
+            if v:
+                return v
+            v = (doc.get(section) or {}).get(key)
+            if v:
+                return v
+            return os.environ.get(env_var) or default
+
+        mqtt = dict(doc.get("mqtt_config") or {})
+        s3 = dict(doc.get("s3_config") or {})
+        mqtt["broker_dir"] = resolve(
+            "mqtt_broker_dir", "mqtt_config", "broker_dir",
+            "FEDML_TPU_MQTT_DIR", os.path.join(home, "broker"))
+        s3["store_dir"] = resolve(
+            "blob_store_dir", "s3_config", "store_dir",
+            "FEDML_TPU_STORE_DIR", os.path.join(home, "store"))
+        return mqtt, s3
+
+    @staticmethod
+    def fetch_remote(url: str, ca_path: Optional[str] = None,
+                     timeout: float = 10.0):
+        """Pinned-HTTPS config fetch (reference ``fetch_configs`` over
+        ``https://open.fedml.ai`` with bundled certs). Returns the parsed
+        JSON body; ``ca_path`` pins the trust root."""
+        import requests
+
+        resp = requests.get(url, verify=ca_path or True, timeout=timeout)
+        resp.raise_for_status()
+        return resp.json()

@@ -178,3 +178,58 @@ def test_comm_benchmark_hooks_emit_greppable_lines(caplog):
     assert "--Benchmark tick: 1 to 0" in text
     assert "--Benchmark tock: 1 to 0 latency_ms=" in text
     assert "--Benchmark end round 3 on rank 0" in text
+
+
+def test_mlops_configs_resolution(tmp_path, monkeypatch):
+    """Reference MLOpsConfigs parity with per-key precedence:
+    explicit args > cached file > env > home defaults."""
+    from fedml_tpu.core.mlops import MLOpsConfigs
+
+    cfgf = tmp_path / "mlops.json"
+    cfgf.write_text(json.dumps({
+        "mqtt_config": {"broker_dir": "/tmp/b1"},
+        "s3_config": {"store_dir": "/tmp/s1"},
+    }))
+
+    class A:
+        mlops_config_path = str(cfgf)
+
+    # cached file supplies both keys
+    mqtt, s3 = MLOpsConfigs(A()).fetch_configs()
+    assert mqtt["broker_dir"] == "/tmp/b1" and s3["store_dir"] == "/tmp/s1"
+
+    # explicit args BEAT the file AND a stale env var
+    monkeypatch.setenv("FEDML_TPU_MQTT_DIR", str(tmp_path / "stale"))
+
+    class B(A):
+        mqtt_broker_dir = str(tmp_path / "explicit")
+
+    mqtt, _ = MLOpsConfigs(B()).fetch_configs()
+    assert mqtt["broker_dir"] == str(tmp_path / "explicit")
+
+    # env applies when neither args nor file give the key
+    partial = tmp_path / "partial.json"
+    partial.write_text(json.dumps({"s3_config": {"store_dir": "/tmp/s9"}}))
+
+    class C:
+        mlops_config_path = str(partial)
+
+    mqtt, s3 = MLOpsConfigs(C()).fetch_configs()
+    assert mqtt["broker_dir"] == str(tmp_path / "stale")
+    assert s3["store_dir"] == "/tmp/s9"
+
+    # defaults under the home dir
+    monkeypatch.delenv("FEDML_TPU_MQTT_DIR")
+    monkeypatch.setenv("FEDML_TPU_HOME", str(tmp_path / "home"))
+    mqtt, s3 = MLOpsConfigs(None).fetch_configs()
+    assert mqtt["broker_dir"].startswith(str(tmp_path / "home"))
+
+    # corrupt cache names itself instead of silently falling through
+    bad = tmp_path / "bad.json"
+    bad.write_text("{truncated")
+
+    class D:
+        mlops_config_path = str(bad)
+
+    with pytest.raises(ValueError, match="bad.json"):
+        MLOpsConfigs(D()).fetch_configs()
