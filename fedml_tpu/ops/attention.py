@@ -30,9 +30,21 @@ def multihead_attention(
     """
     T, Dh = q.shape[1], q.shape[-1]
     if impl is None:
-        from .pallas import flash_shapes_ok
+        from .pallas import flash_shapes_ok, flash_vmem_ok
 
-        impl = "flash" if flash_shapes_ok(T, Dh) else "dense"
+        itemsize = jnp.dtype(q.dtype).itemsize
+        impl = "flash" if flash_shapes_ok(T, Dh, itemsize=itemsize) else "dense"
+        if impl == "dense" and not flash_vmem_ok(T, Dh, itemsize):
+            # loud, not silent: dense materializes O(T^2) logits — at the
+            # lengths that trip the flash VMEM ceiling that can be an HBM
+            # blowup with a generic allocation error. Point at the fix.
+            import logging
+
+            logging.warning(
+                "attention auto-dispatch: T=%d exceeds the flash kernel's "
+                "VMEM ceiling, falling back to DENSE O(T^2) attention — "
+                "expect large HBM use; shard the sequence with "
+                "ring/ulysses attention for contexts this long", T)
     if impl == "flash":
         from .pallas import flash_attention
 

@@ -1,5 +1,5 @@
 """Pallas TPU kernels for the hot ops (see /opt guide; pallas_guide.md)."""
 
-from .flash_attention import flash_attention, flash_shapes_ok
+from .flash_attention import flash_attention, flash_shapes_ok, flash_vmem_ok
 
-__all__ = ["flash_attention", "flash_shapes_ok"]
+__all__ = ["flash_attention", "flash_shapes_ok", "flash_vmem_ok"]

@@ -281,8 +281,23 @@ def _bwd(causal, block_q, block_k, res, g):
 flash_attention.defvjp(_fwd, _bwd)
 
 
+def flash_vmem_ok(T: int, Dh: int, itemsize: int = 2) -> bool:
+    """The kernels stage one head's FULL K/V in VMEM (BlockSpec (1, T, Dh))
+    and only block over queries, so T is bounded by the ~16 MB scoped-VMEM
+    budget: measured on v5e with Dh=64 bf16, T=12288 compiles and T=16384
+    exceeds the limit by 128 KB (~1 KB of scoped VMEM per position at
+    itemsize 2 — the staging buffers hold the INPUT dtype, so f32 halves
+    the reachable T). A K-blocked 3D-grid kernel lifts this later; beyond
+    it, ring/Ulysses sequence parallelism shards T across chips."""
+    return T * Dh * itemsize <= 12288 * 64 * 2
+
+
 def flash_shapes_ok(T: int, Dh: int, block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K) -> bool:
+                    block_k: int = DEFAULT_BLOCK_K,
+                    itemsize: int = 2) -> bool:
     """Static dispatch guard used by ops.attention.multihead_attention: the
-    sequence must tile into whole blocks and Dh must fill lanes reasonably."""
-    return T % block_q == 0 and T % block_k == 0 and (Dh % 128 == 0 or Dh == 64)
+    sequence must tile into whole blocks, Dh must fill lanes reasonably,
+    and the full-K/V VMEM staging must fit (see :func:`flash_vmem_ok`)."""
+    return (T % block_q == 0 and T % block_k == 0
+            and (Dh % 128 == 0 or Dh == 64)
+            and flash_vmem_ok(T, Dh, itemsize))

@@ -74,3 +74,30 @@ def test_auto_dispatch_guard():
     assert flash_shapes_ok(1024, 128)
     assert not flash_shapes_ok(100, 64)   # ragged T
     assert not flash_shapes_ok(256, 48)   # lane-hostile Dh
+
+
+def test_vmem_gate_boundaries():
+    """The full-K/V VMEM staging bound: measured-good shapes pass, the
+    measured-failing one is rejected, and f32 halves the reachable T."""
+    from fedml_tpu.ops.pallas import flash_shapes_ok, flash_vmem_ok
+
+    assert flash_shapes_ok(12288, 64, itemsize=2)   # largest verified (bf16)
+    assert not flash_shapes_ok(16384, 64, itemsize=2)  # measured VMEM fail
+    assert not flash_shapes_ok(12288, 64, itemsize=4)  # f32 doubles staging
+    assert flash_shapes_ok(6144, 64, itemsize=4)
+    assert flash_vmem_ok(12288, 64) and not flash_vmem_ok(12289 * 2, 64)
+
+
+def test_auto_dispatch_warns_on_vmem_fallback(caplog):
+    import logging
+
+    import jax.numpy as jnp
+
+    from fedml_tpu.ops.attention import multihead_attention
+
+    q = jnp.zeros((1, 16384, 1, 64), jnp.bfloat16)
+    with caplog.at_level(logging.WARNING):
+        multihead_attention(q[:, :128], q[:, :128], q[:, :128])  # small: no warn
+        assert "VMEM ceiling" not in caplog.text
+        multihead_attention(q, q, q)
+    assert "VMEM ceiling" in caplog.text
