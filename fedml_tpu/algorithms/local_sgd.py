@@ -100,7 +100,19 @@ def infer_loss_kind(args, fed_data) -> str:
     import numpy as np
 
     y = np.asarray(fed_data.train_data_global.y)
-    return "mse" if np.issubdtype(y.dtype, np.floating) else "ce"
+    if np.issubdtype(y.dtype, np.floating):
+        # Only scalar-per-example float targets auto-select mse. Structured
+        # float labels (e.g. the object-detection rasterized (S,S,6) grids)
+        # need a task-specific loss — routing them through the generic
+        # regression path would die later with an opaque broadcast error.
+        if y.ndim > 2:
+            raise ValueError(
+                f"float label tensor with shape {y.shape} is structured, not "
+                "scalar-per-example regression; use the task-specific entry "
+                "point (e.g. algorithms.detection) or set args.loss_kind "
+                "explicitly")
+        return "mse"
+    return "ce"
 
 
 def make_loss_fn(apply_fn: Callable, needs_dropout: bool = False,

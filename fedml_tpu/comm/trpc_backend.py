@@ -66,8 +66,12 @@ def _flatten_tensors(obj: Any, specs: List[Tuple[str, tuple, int]],
         idx = len(specs)
         specs.append((_dtype_token(arr.dtype), arr.shape, arr.nbytes))
         # ml_dtypes arrays (bfloat16/...) reject the buffer protocol; a uint8
-        # view exposes the same memory without a copy
-        buffers.append(memoryview(arr.view(np.uint8)).cast("B"))
+        # view exposes the same memory without a copy. Zero-size arrays can't
+        # be cast (zeros in shape/strides) — ship the empty buffer directly.
+        if arr.size == 0:
+            buffers.append(memoryview(b""))
+        else:
+            buffers.append(memoryview(arr.view(np.uint8)).cast("B"))
         return msgpack.ExtType(_EXT_TENSOR_REF, struct.pack(">I", idx))
     if hasattr(obj, "__array__") and not isinstance(obj, (bool, int, float, str, bytes)):
         return _flatten_tensors(np.asarray(obj), specs, buffers)
@@ -100,9 +104,19 @@ def _unflatten_tensors(obj: Any, tensors: List[np.ndarray]) -> Any:
 # exact byte where the kernel stopped.
 _IOV_BATCH = 512
 
+# Receive-side bounds on peer-supplied frame headers. Large enough for any
+# real model payload, small enough that a corrupt length field can't OOM.
+MAX_HEADER_BYTES = 64 << 20
+MAX_FRAME_BYTES = 16 << 30
+
 
 def sendmsg_all(sock: socket.socket, chunks: List[Union[bytes, memoryview]]) -> None:
-    views = [c if isinstance(c, memoryview) else memoryview(c) for c in chunks]
+    # Zero-length views (e.g. a zero-size ndarray param) must be dropped:
+    # sendmsg([b""]) returns 0, which the resume loop would read as "no
+    # progress" and spin on forever.
+    views = [v for c in chunks
+             for v in (c if isinstance(c, memoryview) else memoryview(c),)
+             if len(v)]
     i, off = 0, 0
     while i < len(views):
         batch = [views[i][off:]]
@@ -155,13 +169,44 @@ def read_frame(sock: socket.socket) -> Optional[Dict[str, Any]]:
     if magic != _MAGIC:
         raise ValueError(f"bad frame magic {magic!r}")
     (hlen,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    if hlen > MAX_HEADER_BYTES:
+        raise ValueError(f"frame header {hlen} bytes exceeds cap {MAX_HEADER_BYTES}")
     header = msgpack.unpackb(_recv_exact(sock, hlen), strict_map_key=False,
                              ext_hook=_ref_hook)
+    # Validate every peer-supplied spec BEFORE allocating: a corrupt or
+    # hostile header must surface as ValueError (never a strippable assert,
+    # never an uncaught OverflowError/KeyError that kills the reader thread),
+    # and every allocation is bounded so a bad shape can't OOM the receiver.
+    total = 0
+    try:
+        specs = header["specs"]
+        for dtype_str, shape, nbytes in specs:
+            if int(nbytes) < 0 or any(int(d) < 0 for d in shape):
+                raise ValueError(
+                    f"frame spec negative dim/size: shape={tuple(shape)} "
+                    f"nbytes={nbytes}")
+            if int(nbytes) > MAX_FRAME_BYTES:
+                raise ValueError(
+                    f"frame tensor {nbytes} bytes exceeds cap {MAX_FRAME_BYTES}")
+            expect = int(np.prod(shape, dtype=np.int64)) * np.dtype(
+                _resolve_dtype(dtype_str)).itemsize
+            if expect != nbytes:
+                raise ValueError(
+                    f"frame spec mismatch: dtype={dtype_str} "
+                    f"shape={tuple(shape)} implies {expect} bytes, header "
+                    f"claims {nbytes}")
+            total += nbytes
+    except ValueError:
+        raise
+    except Exception as exc:  # malformed structure, dtype token, huge ints
+        raise ValueError(f"malformed frame header: {exc!r}") from exc
+    if total > MAX_FRAME_BYTES:
+        raise ValueError(f"frame tensors {total} bytes exceed cap {MAX_FRAME_BYTES}")
     tensors: List[np.ndarray] = []
-    for dtype_str, shape, nbytes in header["specs"]:
+    for dtype_str, shape, nbytes in specs:
         arr = np.empty(tuple(shape), dtype=_resolve_dtype(dtype_str))
-        _recv_exact_into(sock, memoryview(arr.view(np.uint8)).cast("B"))
-        assert arr.nbytes == nbytes
+        if arr.size:  # zero-size arrays carry no wire bytes (and can't cast)
+            _recv_exact_into(sock, memoryview(arr.view(np.uint8)).cast("B"))
         tensors.append(arr)
     return _unflatten_tensors(header["meta"], tensors)
 

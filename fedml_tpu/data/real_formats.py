@@ -159,10 +159,13 @@ def load_landmarks(root: str, name: str, img_size: int = 64,
     per_user: Dict[str, List[int]] = {}
     train_rows: List[dict] = []
     for user, rows in sorted(by_user.items()):
-        # users stay WHOLE: stop before a user that would blow the budget
-        # (the first user always fits, so the result is never empty)
-        if train_rows and len(train_rows) + len(rows) > max_images:
-            break
+        # users stay WHOLE: stop before a user that would blow the budget.
+        # The first user is truncated to the budget instead of exempted, so
+        # the result is never empty and the memory cap always holds.
+        if len(train_rows) + len(rows) > max_images:
+            if train_rows:
+                break
+            rows = rows[:max_images]
         per_user[user] = list(range(len(train_rows),
                                     len(train_rows) + len(rows)))
         train_rows.extend(rows)

@@ -160,6 +160,61 @@ def test_frame_tensor_placeholder_no_collision():
     np.testing.assert_array_equal(got["w"], np.ones(2, np.float32))
 
 
+def test_frame_zero_length_tensor():
+    """Advisor regression: a zero-size ndarray param used to make
+    sendmsg_all busy-spin forever (sendmsg([b'']) returns 0)."""
+    a, b = socket.socketpair()
+    params = {"empty": np.zeros((0, 4), np.float32), "w": np.ones(2, np.float32)}
+    a.sendmsg(encode_frames(params))  # would hang pre-fix via sendmsg_all path
+    from fedml_tpu.comm.trpc_backend import sendmsg_all
+
+    c, d = socket.socketpair()
+    sendmsg_all(c, encode_frames(params))
+    got = read_frame(b)
+    got2 = read_frame(d)
+    a.close(), b.close(), c.close(), d.close()
+    for g in (got, got2):
+        assert g["empty"].shape == (0, 4)
+        np.testing.assert_array_equal(g["w"], np.ones(2, np.float32))
+
+
+def test_frame_corrupt_header_raises():
+    """Advisor regression: nbytes/shape mismatch and oversized claims must
+    raise ValueError (not a strippable assert, not an unbounded alloc)."""
+    import msgpack
+
+    from fedml_tpu.comm.trpc_backend import _HDR, _MAGIC
+
+    def send_raw(header_obj):
+        a, b = socket.socketpair()
+        header = msgpack.packb(header_obj, strict_types=False)
+        a.sendall(_MAGIC + _HDR.pack(len(header)) + header)
+        a.close()
+        try:
+            return read_frame(b)
+        finally:
+            b.close()
+
+    with pytest.raises(ValueError, match="spec mismatch"):
+        send_raw({"meta": None, "specs": [["float32", [2, 3], 999]]})
+    with pytest.raises(ValueError, match="exceeds cap"):
+        send_raw({"meta": None,
+                  "specs": [["float32", [1 << 20, 1 << 20], 1 << 42]]})
+    with pytest.raises(ValueError, match="negative"):
+        send_raw({"meta": None,
+                  "specs": [["float32", [-(1 << 40)], -4398046511104]]})
+    # huge dims wrap in int64 np.prod -> caught as spec mismatch, never
+    # an uncaught OverflowError and never a huge np.empty
+    with pytest.raises(ValueError):
+        send_raw({"meta": None, "specs": [["float32", [1 << 63], 4]]})
+    with pytest.raises(ValueError):
+        send_raw({"meta": None, "specs": [["float32", [(1 << 64) - 1], 4]]})
+    with pytest.raises(ValueError, match="malformed frame header"):
+        send_raw({"meta": None, "specs": [["nosuchdtype", [2], 8]]})
+    with pytest.raises(ValueError, match="malformed frame header"):
+        send_raw({"meta": None})
+
+
 def test_trpc_latency_harness():
     m0, m1 = _pair(20090)
     try:
