@@ -178,24 +178,30 @@ def read_frame(sock: socket.socket) -> Optional[Dict[str, Any]]:
     # never an uncaught OverflowError/KeyError that kills the reader thread),
     # and every allocation is bounded so a bad shape can't OOM the receiver.
     total = 0
+    specs: List[Tuple[np.dtype, tuple, int]] = []
     try:
-        specs = header["specs"]
-        for dtype_str, shape, nbytes in specs:
-            if int(nbytes) < 0 or any(int(d) < 0 for d in shape):
+        meta = header["meta"]
+        for dtype_str, raw_shape, raw_nbytes in header["specs"]:
+            # coerce ONCE and allocate from the same coerced values — a float
+            # dim that validates but fails np.empty would kill the reader
+            shape = tuple(int(d) for d in raw_shape)
+            nbytes = int(raw_nbytes)
+            if nbytes < 0 or any(d < 0 for d in shape):
                 raise ValueError(
-                    f"frame spec negative dim/size: shape={tuple(shape)} "
+                    f"frame spec negative dim/size: shape={shape} "
                     f"nbytes={nbytes}")
-            if int(nbytes) > MAX_FRAME_BYTES:
+            if nbytes > MAX_FRAME_BYTES:
                 raise ValueError(
                     f"frame tensor {nbytes} bytes exceeds cap {MAX_FRAME_BYTES}")
-            expect = int(np.prod(shape, dtype=np.int64)) * np.dtype(
-                _resolve_dtype(dtype_str)).itemsize
+            dtype = np.dtype(_resolve_dtype(dtype_str))
+            expect = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
             if expect != nbytes:
                 raise ValueError(
                     f"frame spec mismatch: dtype={dtype_str} "
-                    f"shape={tuple(shape)} implies {expect} bytes, header "
+                    f"shape={shape} implies {expect} bytes, header "
                     f"claims {nbytes}")
             total += nbytes
+            specs.append((dtype, shape, nbytes))
     except ValueError:
         raise
     except Exception as exc:  # malformed structure, dtype token, huge ints
@@ -203,12 +209,12 @@ def read_frame(sock: socket.socket) -> Optional[Dict[str, Any]]:
     if total > MAX_FRAME_BYTES:
         raise ValueError(f"frame tensors {total} bytes exceed cap {MAX_FRAME_BYTES}")
     tensors: List[np.ndarray] = []
-    for dtype_str, shape, nbytes in specs:
-        arr = np.empty(tuple(shape), dtype=_resolve_dtype(dtype_str))
+    for dtype, shape, nbytes in specs:
+        arr = np.empty(shape, dtype=dtype)
         if arr.size:  # zero-size arrays carry no wire bytes (and can't cast)
             _recv_exact_into(sock, memoryview(arr.view(np.uint8)).cast("B"))
         tensors.append(arr)
-    return _unflatten_tensors(header["meta"], tensors)
+    return _unflatten_tensors(meta, tensors)
 
 
 class TRPCCommManager(BaseCommunicationManager):

@@ -150,6 +150,8 @@ def load_landmarks(root: str, name: str, img_size: int = 64,
     def img(image_id: str) -> np.ndarray:
         return _load_image(os.path.join(images, image_id + ".jpg"), img_size)
 
+    if max_images <= 0:
+        raise ValueError(f"max_images must be positive, got {max_images}")
     all_train_rows = read_rows(train_csv)
     test_rows = read_rows(test_csv)[:max_images]
 
