@@ -19,8 +19,9 @@ from .message import (
 from .loopback import LoopbackCommManager, LoopbackHub, get_default_hub
 from .managers import ClientManager, FedMLCommManager, ServerManager, create_comm_backend
 from .mqtt_s3 import MqttS3CommManager, MqttS3MnnCommManager
+from .mqtt_wire import MqttBroker, MqttClient, MqttWireBroker
 from .pubsub import FileSystemBroker, InProcessBroker, PubSubBroker
-from .store import BlobStore, FileSystemBlobStore, InMemoryBlobStore
+from .store import BlobStore, FileSystemBlobStore, InMemoryBlobStore, S3BlobStore
 from .topology import (
     AsymmetricTopologyManager,
     BaseTopologyManager,
@@ -35,7 +36,8 @@ __all__ = [
     "LoopbackCommManager", "LoopbackHub", "get_default_hub",
     "ClientManager", "FedMLCommManager", "ServerManager", "create_comm_backend",
     "MqttS3CommManager", "MqttS3MnnCommManager", "PubSubBroker", "InProcessBroker", "FileSystemBroker",
-    "BlobStore", "FileSystemBlobStore", "InMemoryBlobStore",
+    "MqttBroker", "MqttClient", "MqttWireBroker",
+    "BlobStore", "FileSystemBlobStore", "InMemoryBlobStore", "S3BlobStore",
     "BaseTopologyManager", "SymmetricTopologyManager", "AsymmetricTopologyManager",
     "ring_mixing_matrix",
 ]

@@ -8,9 +8,10 @@ restores the payload. Topic scheme parity (``:234-243``): server publishes on
 ``{prefix}{run_id}_0_{client_id}``, clients on ``{prefix}{run_id}_{client_id}``.
 
 Redesign: the broker and store are *interfaces* (``pubsub.PubSubBroker``,
-``store.BlobStore``) with filesystem drivers that need zero extra
-dependencies — paho-mqtt/boto3 become optional drivers rather than hard
-requirements, and the control payload is msgpack, not JSON+pickle.
+``store.BlobStore``). Drivers: filesystem (zero dependencies), real wire
+MQTT 3.1.1 (``mqtt_wire.MqttWireBroker`` — first-party client+broker over
+TCP), and S3 (``store.S3BlobStore`` — boto3 surface, stub-testable). The
+control payload is msgpack, not JSON+pickle.
 """
 
 from __future__ import annotations

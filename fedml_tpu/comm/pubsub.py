@@ -5,8 +5,9 @@ mqtt_comm_manager.py:14`` and the MQTT half of ``mqtt_s3``): actors publish
 small control messages on topics and subscribe with callbacks. Redesign: a
 broker *interface* so the transport is pluggable — an in-process broker for
 tests, a filesystem broker that works across processes on one host (or an
-NFS mount) with zero extra dependencies, and paho-mqtt as a drop-in driver
-whenever it exists (same publish/subscribe surface).
+NFS mount) with zero extra dependencies, and real wire MQTT 3.1.1 via
+``mqtt_wire.MqttWireBroker`` (first-party client + broker speaking actual
+protocol frames over TCP — no paho required, but wire-compatible with it).
 """
 
 from __future__ import annotations

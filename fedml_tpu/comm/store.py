@@ -84,6 +84,73 @@ class FileSystemBlobStore(BlobStore):
         return "file://" + self._path(key)
 
 
+class S3BlobStore(BlobStore):
+    """S3 driver (reference ``remote_storage.py:39 write_model`` /
+    ``:59 read_model`` — pickle replaced by the caller's msgpack bytes).
+
+    boto3 is imported lazily and only when no client is injected, so the
+    driver exists (and is testable against a stub client) in zero-egress
+    images that don't ship boto3. The injected ``client`` must provide the
+    boto3 S3 client surface: ``put_object``, ``get_object``,
+    ``delete_object``, ``list_objects_v2``.
+    """
+
+    def __init__(self, bucket: str, prefix: str = "", client=None,
+                 region_name: Optional[str] = None,
+                 endpoint_url: Optional[str] = None,
+                 aws_access_key_id: Optional[str] = None,
+                 aws_secret_access_key: Optional[str] = None):
+        if client is None:
+            try:
+                import boto3  # noqa: F401 — optional dependency
+            except ImportError as exc:
+                raise RuntimeError(
+                    "S3BlobStore needs boto3 (not bundled in this image) or "
+                    "an injected client with the boto3 S3 surface"
+                ) from exc
+            client = boto3.client(
+                "s3", region_name=region_name, endpoint_url=endpoint_url,
+                aws_access_key_id=aws_access_key_id,
+                aws_secret_access_key=aws_secret_access_key,
+            )
+        self._s3 = client
+        self.bucket = bucket
+        self.prefix = prefix.strip("/")
+
+    def _key(self, key: str) -> str:
+        return f"{self.prefix}/{key}" if self.prefix else key
+
+    def put(self, key: str, data: bytes) -> str:
+        self._s3.put_object(Bucket=self.bucket, Key=self._key(key), Body=data)
+        return self.url_for(key)
+
+    def get(self, key: str) -> bytes:
+        resp = self._s3.get_object(Bucket=self.bucket, Key=self._key(key))
+        body = resp["Body"]
+        return body.read() if hasattr(body, "read") else bytes(body)
+
+    def delete(self, key: str) -> None:
+        self._s3.delete_object(Bucket=self.bucket, Key=self._key(key))
+
+    def list_keys(self, prefix: str = "") -> List[str]:
+        full = self._key(prefix)
+        strip = len(self.prefix) + 1 if self.prefix else 0
+        keys: List[str] = []
+        token = None
+        while True:
+            kwargs = dict(Bucket=self.bucket, Prefix=full)
+            if token:
+                kwargs["ContinuationToken"] = token
+            resp = self._s3.list_objects_v2(**kwargs)
+            keys.extend(o["Key"][strip:] for o in resp.get("Contents", ()))
+            if not resp.get("IsTruncated"):
+                return sorted(keys)
+            token = resp.get("NextContinuationToken")
+
+    def url_for(self, key: str) -> str:
+        return f"s3://{self.bucket}/{self._key(key)}"
+
+
 class InMemoryBlobStore(BlobStore):
     """Dict-backed store for single-process tests."""
 
