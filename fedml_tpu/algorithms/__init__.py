@@ -134,6 +134,19 @@ def get_algorithm(
     if name_l == FEDML_FEDERATED_OPTIMIZER_SCAFFOLD.lower():
         cfg = LocalTrainConfig(**{**cfg.__dict__, "use_scaffold": True})
 
+    if name_l == "fednas":
+        # bilevel DARTS search (reference simulation/mpi/fednas); weight
+        # lr/momentum come from the shared cfg verbatim (an explicit
+        # momentum=0.0 ablation is honored — set 0.9 for reference parity),
+        # arch hyperparams from FedNASConfig
+        from .fednas import FedNASConfig, get_fednas_algorithm
+
+        return get_fednas_algorithm(
+            apply_fn,
+            FedNASConfig(lr=cfg.lr, momentum=cfg.momentum,
+                         epochs=cfg.epochs),
+        )
+
     local_update = make_local_update(apply_fn, cfg, needs_dropout, has_batch_stats)
 
     if name_l in (FEDML_FEDERATED_OPTIMIZER_FEDAVG.lower(), "fedavg_core", "fedavg"):

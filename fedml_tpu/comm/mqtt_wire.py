@@ -350,7 +350,8 @@ class MqttClient:
         self._reader = threading.Thread(target=self._read_loop, daemon=True,
                                         name=f"mqtt-client-{self.client_id}")
         self._reader.start()
-        if not self._connected.wait(timeout):
+        ok = self._connected.wait(timeout)
+        if not ok or self._conn_error:
             self._running = False
             self._dispatch_q.put(None)
             try:
@@ -358,6 +359,9 @@ class MqttClient:
             except OSError:
                 pass
             raise ConnectionError(self._conn_error or "CONNACK timeout")
+        # drop the connect timeout: an idle-but-healthy connection must not
+        # be killed by recv timeouts between keepalive pings
+        self._sock.settimeout(keepalive * 1.5 if keepalive else None)
         self._pinger = threading.Thread(target=self._ping_loop, daemon=True)
         self._pinger.start()
 
@@ -395,6 +399,7 @@ class MqttClient:
                 if ptype == CONNACK:
                     if body[1] != 0:
                         self._conn_error = f"CONNACK refused rc={body[1]}"
+                        self._connected.set()  # unblock the constructor NOW
                         raise ConnectionError(self._conn_error)
                     self._connected.set()
                 elif ptype == PUBLISH:
@@ -449,6 +454,10 @@ class MqttClient:
     # -- surface
     def publish(self, topic: str, payload: bytes, qos: int = 0,
                 retain: bool = False) -> None:
+        if qos not in (0, 1):
+            raise ValueError(
+                f"publish supports qos 0/1 (got {qos}); outbound QoS2's "
+                "PUBREC/PUBREL leg is not implemented (module docstring)")
         flags = (qos << 1) | (1 if retain else 0)
         vh = _encode_string(topic)
         if qos > 0:
@@ -511,6 +520,8 @@ class MqttWireBroker(PubSubBroker):
     def __init__(self, host: str = "127.0.0.1", port: int = 1883,
                  client_id: Optional[str] = None, qos: int = 1,
                  keepalive: int = 60):
+        if qos not in (0, 1):
+            raise ValueError(f"MqttWireBroker supports qos 0/1, got {qos}")
         self._client = MqttClient(host, port, client_id=client_id,
                                   keepalive=keepalive)
         self._qos = qos

@@ -4,9 +4,10 @@ Parity: reference ``model/cv/darts/`` (``model_search.py:377`` mixed-op cells
 with architecture parameters alpha) used by FedNAS
 (``simulation/mpi/fednas/``). Redesign: a compact search space — each
 ``MixedOp`` is a softmax(alpha)-weighted sum of {conv3x3, conv5x5, avgpool,
-identity} — with the alphas as ordinary Flax params, so FedNAS = FedAvg over
-the joint (weights, alphas) pytree and the whole bilevel-ish update stays one
-compiled program. ``derive_genotype`` reads off argmax(alpha) after search.
+identity}. The bilevel search itself lives in ``algorithms/fednas.py``
+(alpha steps on a val split alternating with weight steps, compiled into one
+scan); ``derive_genotype`` reads off argmax(alpha) after search and
+``DerivedNet`` retrains the fixed architecture (reference ``train.py``).
 """
 
 from __future__ import annotations
@@ -67,6 +68,76 @@ class DARTSSearchNet(nn.Module):
             x = nn.max_pool(x, (2, 2), strides=(2, 2))
         x = x.mean(axis=(1, 2))
         return nn.Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+class FixedOp(nn.Module):
+    """One op from the search space, selected by genotype (reference
+    ``model.py`` builds cells from the derived genotype the same way)."""
+
+    channels: int
+    op: str
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.op == "conv3":
+            return nn.Conv(self.channels, (3, 3), dtype=self.dtype)(x)
+        if self.op == "conv5":
+            return nn.Conv(self.channels, (5, 5), dtype=self.dtype)(x)
+        if self.op == "avgpool":
+            return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        if self.op == "identity":
+            return x
+        raise ValueError(f"unknown op '{self.op}'")
+
+
+class DerivedCell(nn.Module):
+    channels: int
+    ops: tuple  # (op_a, op_b)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Conv(self.channels, (1, 1), dtype=self.dtype)(x)
+        h = nn.relu(nn.GroupNorm(num_groups=8, dtype=self.dtype)(h))
+        a = FixedOp(self.channels, self.ops[0], dtype=self.dtype)(h, train)
+        b = FixedOp(self.channels, self.ops[1], dtype=self.dtype)(nn.relu(a), train)
+        return nn.relu(a + b)
+
+
+class DerivedNet(nn.Module):
+    """Fixed net built from a derived genotype — the retrain phase
+    (reference ``train.py`` retrains ``NetworkCIFAR(genotype)``)."""
+
+    genotype: tuple  # ((op_a, op_b), ...) one pair per cell
+    num_classes: int = 10
+    channels: int = 16
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.channels, (3, 3), dtype=self.dtype)(x)
+        for i, ops in enumerate(self.genotype):
+            x = DerivedCell(self.channels * (2 ** i), tuple(ops),
+                            dtype=self.dtype)(x, train)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.mean(axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)
+
+
+def genotype_to_cells(genotype: List[Dict[str, str]],
+                      n_cells: int) -> tuple:
+    """Group the flat ``derive_genotype`` output into per-cell op pairs for
+    ``DerivedNet`` (paths look like ``params/SearchCell_i/MixedOp_j``)."""
+    import re
+
+    cells = [["identity", "identity"] for _ in range(n_cells)]
+    for entry in genotype:
+        m = re.search(r"SearchCell_(\d+)/MixedOp_(\d+)", entry["path"])
+        if m:
+            cells[int(m.group(1))][int(m.group(2))] = entry["op"]
+    return tuple(tuple(c) for c in cells)
 
 
 def derive_genotype(variables: Any) -> List[Dict[str, str]]:
