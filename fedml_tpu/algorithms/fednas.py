@@ -12,8 +12,8 @@ TPU-first redesign: the bilevel alternation happens INSIDE one compiled
 half and a val half (the reference splits each client's loader 50/50 in
 ``train_search.py``), and every scan step does (1) an Adam update on the
 alpha leaves against the val batch, then (2) an SGD update on the weight
-leaves against the train batch, both via ``optax.masked`` on one params
-pytree. No Python-side architect object, no per-step host sync — the whole
+leaves against the train batch, both via ``optax.multi_transform`` (frozen
+partition set_to_zero) on one params pytree. No Python-side architect object, no per-step host sync — the whole
 cohort's search round is one XLA program, and the alphas ride the same
 weighted-mean aggregation as the weights (exactly the reference server
 semantics).
@@ -32,7 +32,7 @@ import jax.numpy as jnp
 import optax
 
 from ..core.algframe import ClientOutput, FedAlgorithm
-from .local_sgd import make_loss_fn, tree_sub
+from .local_sgd import make_loss_fn, tree_scale, tree_sub
 
 
 def alpha_mask(params: Any) -> Any:
@@ -81,13 +81,15 @@ def make_fednas_local_update(apply_fn: Callable,
         # alphas (reference splits each client's data 50/50, train_search.py)
         tx, ty, tm = x[0::2], y[0::2], mask[0::2]
         vx, vy, vm = x[1::2], y[1::2], mask[1::2]
+        if vx.shape[0] == 0:
+            # single-batch clients have no odd half; reuse the train batch
+            # for the alpha step rather than gather from a size-0 axis
+            # (XLA's out-of-range gather is garbage-fill, not an error)
+            vx, vy, vm = tx, ty, tm
         n_steps = tx.shape[0]
         # cycle the (possibly shorter) val half over the train steps
-        vsel = jnp.arange(n_steps) % jnp.maximum(vx.shape[0], 1)
+        vsel = jnp.arange(n_steps) % vx.shape[0]
         vx, vy, vm = vx[vsel], vy[vsel], vm[vsel]
-
-        def zero_if_empty(g, b):
-            return jax.tree.map(lambda t: t * b, g)
 
         def batch_step(carry, inputs):
             params, w_state, a_state, step = carry
@@ -97,7 +99,7 @@ def make_fednas_local_update(apply_fn: Callable,
             # (1) alpha step on the val batch (first-order: weights frozen)
             (vloss, _), a_grads = grad_fn(params, bvx, bvy, bvm, step_rng)
             a_live = (bvm.sum() > 0).astype(jnp.float32)
-            a_grads = zero_if_empty(a_grads, a_live)
+            a_grads = tree_scale(a_grads, a_live)
             a_updates, new_a_state = a_opt.update(a_grads, a_state, params)
             new_params = optax.apply_updates(params, a_updates)
             params = jax.tree.map(
@@ -109,7 +111,7 @@ def make_fednas_local_update(apply_fn: Callable,
             (loss, (correct, valid)), w_grads = grad_fn(
                 params, bx, by, bm, jax.random.fold_in(step_rng, 1))
             w_live = (bm.sum() > 0).astype(jnp.float32)
-            w_grads = zero_if_empty(w_grads, w_live)
+            w_grads = tree_scale(w_grads, w_live)
             w_updates, new_w_state = w_opt.update(w_grads, w_state, params)
             new_params = optax.apply_updates(params, w_updates)
             params = jax.tree.map(

@@ -359,9 +359,6 @@ class MqttClient:
             except OSError:
                 pass
             raise ConnectionError(self._conn_error or "CONNACK timeout")
-        # drop the connect timeout: an idle-but-healthy connection must not
-        # be killed by recv timeouts between keepalive pings
-        self._sock.settimeout(keepalive * 1.5 if keepalive else None)
         self._pinger = threading.Thread(target=self._ping_loop, daemon=True)
         self._pinger.start()
 
@@ -401,6 +398,13 @@ class MqttClient:
                         self._conn_error = f"CONNACK refused rc={body[1]}"
                         self._connected.set()  # unblock the constructor NOW
                         raise ConnectionError(self._conn_error)
+                    # swap the connect timeout for the keepalive window HERE,
+                    # on the thread that calls recv — doing it from the
+                    # constructor races the already-in-flight recv, which
+                    # would keep the short connect timeout and kill an
+                    # idle-but-healthy connection ~10s after connect
+                    self._sock.settimeout(
+                        self.keepalive * 1.5 if self.keepalive else None)
                     self._connected.set()
                 elif ptype == PUBLISH:
                     qos = (flags >> 1) & 0x03

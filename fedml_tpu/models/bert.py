@@ -1,0 +1,124 @@
+"""BERT encoder + classification head, HF-weight-compatible.
+
+Parity: reference FedNLP fine-tunes HuggingFace BERT/DistilBERT
+(``app/fednlp/text_classification/model/bert_model.py``). This module is a
+Flax re-implementation of ``BertForSequenceClassification`` with *exact*
+HF semantics — learned word/position/token-type embeddings, post-LayerNorm
+residuals (eps 1e-12), erf-gelu intermediate, tanh pooler on [CLS] — so
+weights imported from a torch checkpoint file produce identical logits
+(``utils/torch_import.bert_state_dict_to_flax``), and federated fine-tuning
+starts from the pretrained point exactly as the reference does.
+
+Module names deliberately mirror the HF state_dict paths (word_embeddings,
+attention_output_dense, ...) so the import mapping reads as a rename, not a
+puzzle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    num_labels: int = 2
+    layer_norm_eps: float = 1e-12
+    dropout_rate: float = 0.1
+
+
+class BertSelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, hidden, attn_bias, train: bool = False):
+        c = self.cfg
+        head_dim = c.hidden_size // c.num_attention_heads
+        B, T, H = hidden.shape
+
+        def heads(name):
+            y = nn.Dense(c.hidden_size, name=name)(hidden)
+            return y.reshape(B, T, c.num_attention_heads, head_dim)
+
+        q, k, v = heads("query"), heads("key"), heads("value")
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+            jnp.asarray(head_dim, hidden.dtype))
+        scores = scores + attn_bias  # additive mask, HF-style
+        probs = jax.nn.softmax(scores, axis=-1)
+        if train and c.dropout_rate:
+            probs = nn.Dropout(c.dropout_rate, deterministic=False)(probs)
+        ctx = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, T, H)
+        out = nn.Dense(c.hidden_size, name="output_dense")(ctx)
+        if train and c.dropout_rate:
+            out = nn.Dropout(c.dropout_rate, deterministic=False)(out)
+        return nn.LayerNorm(epsilon=c.layer_norm_eps, name="output_norm")(
+            out + hidden)
+
+
+class BertLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, hidden, attn_bias, train: bool = False):
+        c = self.cfg
+        attn = BertSelfAttention(c, name="attention")(hidden, attn_bias, train)
+        inter = nn.Dense(c.intermediate_size, name="intermediate_dense")(attn)
+        inter = jax.nn.gelu(inter, approximate=False)  # HF "gelu" = erf form
+        out = nn.Dense(c.hidden_size, name="output_dense")(inter)
+        if train and c.dropout_rate:
+            out = nn.Dropout(c.dropout_rate, deterministic=False)(out)
+        return nn.LayerNorm(epsilon=c.layer_norm_eps, name="output_norm")(
+            out + attn)
+
+
+class BertForSequenceClassification(nn.Module):
+    """HF ``BertForSequenceClassification`` forward, flax-native.
+
+    ``__call__(x, ...)`` takes int32 token ids (B, T); ``attention_mask``
+    (B, T) in {0,1} and ``token_type_ids`` default to all-ones/zeros like HF.
+    """
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, attention_mask=None, token_type_ids=None,
+                 train: bool = False, rngs=None):
+        c = self.cfg
+        B, T = x.shape
+        if attention_mask is None:
+            attention_mask = jnp.ones((B, T), jnp.float32)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros((B, T), jnp.int32)
+
+        word = nn.Embed(c.vocab_size, c.hidden_size,
+                        name="word_embeddings")(x)
+        pos = nn.Embed(c.max_position_embeddings, c.hidden_size,
+                       name="position_embeddings")(jnp.arange(T)[None, :])
+        typ = nn.Embed(c.type_vocab_size, c.hidden_size,
+                       name="token_type_embeddings")(token_type_ids)
+        hidden = nn.LayerNorm(epsilon=c.layer_norm_eps,
+                              name="embeddings_norm")(word + pos + typ)
+        if train and c.dropout_rate:
+            hidden = nn.Dropout(c.dropout_rate, deterministic=False)(hidden)
+
+        # HF extended attention mask: (1 - m) * large_negative on key axis
+        attn_bias = (1.0 - attention_mask[:, None, None, :]) * jnp.asarray(
+            jnp.finfo(jnp.float32).min, hidden.dtype)
+        for i in range(c.num_hidden_layers):
+            hidden = BertLayer(c, name=f"layer_{i}")(hidden, attn_bias, train)
+
+        pooled = jnp.tanh(
+            nn.Dense(c.hidden_size, name="pooler_dense")(hidden[:, 0]))
+        if train and c.dropout_rate:
+            pooled = nn.Dropout(c.dropout_rate, deterministic=False)(pooled)
+        return nn.Dense(c.num_labels, name="classifier")(pooled)
