@@ -68,11 +68,13 @@ def main() -> None:
     model = BertForSequenceClassification(CFG)
 
     def apply_fn(v, xx, train=False, rngs=None, mutable=False):
-        return model.apply(v, xx, train=False)
+        # forward train + dropout rngs: fine-tune runs with HF's 0.1 dropout
+        return model.apply(v, xx, train=train, rngs=rngs)
 
     alg = get_algorithm("FedAvg", apply_fn,
                         LocalTrainConfig(lr=1e-3, epochs=1,
-                                         client_optimizer="adam"))
+                                         client_optimizer="adam"),
+                        needs_dropout=True)
     sim = FedSimulator(fed, alg, variables,
                        SimConfig(comm_round=10, client_num_in_total=8,
                                  client_num_per_round=4, batch_size=16,

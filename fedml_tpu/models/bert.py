@@ -92,7 +92,7 @@ class BertForSequenceClassification(nn.Module):
 
     @nn.compact
     def __call__(self, x, attention_mask=None, token_type_ids=None,
-                 train: bool = False, rngs=None):
+                 train: bool = False):
         c = self.cfg
         B, T = x.shape
         if attention_mask is None:

@@ -169,9 +169,17 @@ def import_bert_classifier(state_dict: Dict[str, np.ndarray], cfg) -> Dict:
 
     if isinstance(state_dict, str):
         state_dict = load_torch_state_dict(state_dict)
+    # non-parameter buffers some transformers versions persist (e.g.
+    # bert.embeddings.position_ids in < 4.31 checkpoints, incl. the published
+    # bert-base files) are not weights — drop them before the strict check
+    state_dict = {k: v for k, v in state_dict.items()
+                  if not k.endswith((".position_ids",
+                                     ".num_batches_tracked"))}
     module = BertForSequenceClassification(cfg)
-    template = module.init(
-        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32), train=False)
+    # eval_shape: shapes only, no 100M-param random init to throw away
+    template = jax.eval_shape(
+        lambda k, x: module.init(k, x, train=False),
+        jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
     shapes = flax_shapes(template["params"])
     params = convert_state_dict(
         state_dict, bert_mapping(cfg.num_hidden_layers), shapes)
