@@ -33,18 +33,26 @@ def multihead_attention(
         from .pallas import flash_shapes_ok, flash_vmem_ok
 
         itemsize = jnp.dtype(q.dtype).itemsize
-        impl = "flash" if flash_shapes_ok(T, Dh, itemsize=itemsize) else "dense"
-        if impl == "dense" and not flash_vmem_ok(T, Dh, itemsize):
-            # loud, not silent: dense materializes O(T^2) logits — at the
-            # lengths that trip the flash VMEM ceiling that can be an HBM
-            # blowup with a generic allocation error. Point at the fix.
+        # measured crossover (results/flash_attention_bench.json): XLA's
+        # fused dense attention holds a slight edge below T=4096 on the
+        # v5e (0.88-0.99x); from 4096 the K-blocked kernel wins 2x+ and is
+        # the only option once (T,T) logits stop fitting in HBM
+        impl = ("flash" if T >= 4096 and flash_shapes_ok(T, Dh, itemsize=itemsize)
+                else "dense")
+        if impl == "dense" and T >= 8192:
+            # loud, not silent: dense materializes O(T^2) f32 logits — at
+            # these lengths that's an HBM blowup surfacing as a generic
+            # allocation error. Flash was refused (untileable T or
+            # lane-unfriendly Dh); point at the fix.
             import logging
 
             logging.warning(
-                "attention auto-dispatch: T=%d exceeds the flash kernel's "
-                "VMEM ceiling, falling back to DENSE O(T^2) attention — "
-                "expect large HBM use; shard the sequence with "
-                "ring/ulysses attention for contexts this long", T)
+                "attention auto-dispatch: falling back to DENSE O(T^2) "
+                "attention at T=%d (flash needs T tileable by 128-blocks "
+                "and Dh in {64, k*128}; got Dh=%d) — expect ~%.1f GB of "
+                "logits; pad T to a tileable length or shard the sequence "
+                "with ring/ulysses attention", T, Dh,
+                q.shape[0] * q.shape[2] * T * T * 4 / 2**30)
     if impl == "flash":
         from .pallas import flash_attention
 

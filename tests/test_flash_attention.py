@@ -76,19 +76,62 @@ def test_auto_dispatch_guard():
     assert not flash_shapes_ok(256, 48)   # lane-hostile Dh
 
 
-def test_vmem_gate_boundaries():
-    """The full-K/V VMEM staging bound: measured-good shapes pass, the
-    measured-failing one is rejected, and f32 halves the reachable T."""
+def test_shapes_gate_is_t_independent():
+    """The K-blocked kernel's VMEM use is O(block * Dh), so the gate no
+    longer depends on T (the round-2 full-K/V cap at T~12k is gone) —
+    only block divisibility and lane-friendly Dh matter."""
     from fedml_tpu.ops.pallas import flash_shapes_ok, flash_vmem_ok
 
-    assert flash_shapes_ok(12288, 64, itemsize=2)   # largest verified (bf16)
-    assert not flash_shapes_ok(16384, 64, itemsize=2)  # measured VMEM fail
-    assert not flash_shapes_ok(12288, 64, itemsize=4)  # f32 doubles staging
-    assert flash_shapes_ok(6144, 64, itemsize=4)
-    assert flash_vmem_ok(12288, 64) and not flash_vmem_ok(12289 * 2, 64)
+    assert flash_shapes_ok(12288, 64, itemsize=2)
+    assert flash_shapes_ok(16384, 64, itemsize=2)   # round-2 measured fail
+    assert flash_shapes_ok(65536, 64, itemsize=2)   # long context single chip
+    assert flash_shapes_ok(16384, 64, itemsize=4)   # f32 no longer halves T
+    assert not flash_shapes_ok(12288 + 100, 64)     # block divisibility
+    assert not flash_shapes_ok(12288, 48)           # lane-unfriendly Dh
+    assert flash_vmem_ok(65536, 64) and flash_vmem_ok(65536, 128)
 
 
-def test_auto_dispatch_warns_on_vmem_fallback(caplog):
+def test_auto_block_is_lane_legal():
+    """Blocks must be multiples of 128 (Mosaic lane dim) that divide T."""
+    from fedml_tpu.ops.pallas.flash_attention import auto_block
+
+    assert auto_block(8192) == 1024
+    assert auto_block(1024) == 512    # measured: T<=1024 prefers T//2
+    assert auto_block(12288) == 1024
+    assert auto_block(640) == 128     # 320 divides but is lane-illegal
+    assert auto_block(384) == 128
+    assert auto_block(100) is None
+    for T in (256, 384, 640, 896, 2048, 12288):
+        b = auto_block(T)
+        assert b % 128 == 0 and T % b == 0
+
+
+def test_shapes_gate_rejects_oversized_explicit_blocks():
+    """flash_shapes_ok must veto block sizes the VMEM budget can't hold
+    (2048 blocks fail to compile on the v5e)."""
+    from fedml_tpu.ops.pallas import flash_shapes_ok
+
+    assert flash_shapes_ok(8192, 64, block_q=1024, block_k=1024)
+    assert not flash_shapes_ok(8192, 64, block_q=2048, block_k=2048)
+
+
+def test_auto_dispatch_warns_on_long_dense_fallback(caplog):
+    """An untileable long T falls back to dense LOUDLY (O(T^2) HBM)."""
+    import logging
+
+    import jax.numpy as jnp
+
+    from fedml_tpu.ops.attention import multihead_attention
+
+    q = jnp.zeros((1, 8192 + 8, 1, 64), jnp.bfloat16)  # 8200: no 128-divisor
+    with caplog.at_level(logging.WARNING):
+        multihead_attention(q, q, q)
+    assert "DENSE O(T^2)" in caplog.text
+
+
+def test_auto_dispatch_uses_flash_at_long_t(caplog):
+    """T=16384 — the round-2 dense-fallback length — now dispatches to the
+    K-blocked flash kernel with no VMEM warning."""
     import logging
 
     import jax.numpy as jnp
@@ -97,7 +140,6 @@ def test_auto_dispatch_warns_on_vmem_fallback(caplog):
 
     q = jnp.zeros((1, 16384, 1, 64), jnp.bfloat16)
     with caplog.at_level(logging.WARNING):
-        multihead_attention(q[:, :128], q[:, :128], q[:, :128])  # small: no warn
-        assert "VMEM ceiling" not in caplog.text
-        multihead_attention(q, q, q)
-    assert "VMEM ceiling" in caplog.text
+        out = multihead_attention(q, q, q)
+    assert out.shape == q.shape
+    assert "VMEM ceiling" not in caplog.text
