@@ -44,12 +44,19 @@ def main() -> None:
     from fedml_tpu.simulation import build_simulator
 
     blocks, rounds_per_block = 5, 6
+    # Lane count pinned from an on-chip sweep (results/lane_sweep_r3.json):
+    # the G*L padded-work optimum picks 8 lanes, but per-step cost is
+    # SUPERLINEAR in lane count (per-lane weights lower to grouped convs
+    # whose thin per-group channels starve the 128-wide MXU), so 1-2 lanes
+    # measure ~10-15% faster end-to-end. Override with FEDML_BENCH_LANES.
+    lanes_env = os.environ.get("FEDML_BENCH_LANES", "2")
     args = fedml_tpu.init(config=dict(
         dataset="cifar10", model="resnet56", partition_method="hetero",
         partition_alpha=0.5, client_num_in_total=100, client_num_per_round=10,
         comm_round=6, learning_rate=0.01, epochs=1,
         batch_size=64, frequency_of_the_test=10_000, random_seed=0,
         use_bf16=True,
+        packed_lanes=int(lanes_env) if lanes_env else None,
     ))
     sim, apply_fn = build_simulator(args)
     assert sim._use_device_data, "device-resident data path must engage"

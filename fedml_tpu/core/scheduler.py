@@ -155,6 +155,7 @@ def lane_schedule(
     batch_counts: Sequence[int],
     axis: int,
     max_lanes: int | None = None,
+    force_lanes: int | None = None,
 ) -> Tuple[List[List[int]], int]:
     """Pack cohort positions into G balanced lanes for the packed executor.
 
@@ -182,10 +183,22 @@ def lane_schedule(
     # resample round to round (the bucketed schedule bounds its shapes the
     # same way with pow2 slot counts)
     candidates = []
-    g = axis
-    while g <= cap:
-        candidates.append(g)
-        g *= 2
+    if force_lanes is not None:
+        # caller pins G (bench-swept: per-step cost is superlinear in lane
+        # count because per-lane weights lower to grouped convs); still a
+        # multiple of the mesh axis — both the round-up and the cohort
+        # clamp floor to axis multiples so mesh shards stay even
+        g = max(axis, -(-int(force_lanes) // axis) * axis)
+        g = min(g, max(axis, (cap // axis) * axis))
+        if g <= cap:
+            candidates.append(g)
+        # g > cap (cohort smaller than one axis-multiple) falls through to
+        # the n < axis pad fallback below
+    else:
+        g = axis
+        while g <= cap:
+            candidates.append(g)
+            g *= 2
     for g in candidates:
         loads = np.zeros(g, dtype=np.int64)
         lanes: List[List[int]] = [[] for _ in range(g)]

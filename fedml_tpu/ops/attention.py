@@ -30,7 +30,7 @@ def multihead_attention(
     """
     T, Dh = q.shape[1], q.shape[-1]
     if impl is None:
-        from .pallas import flash_shapes_ok, flash_vmem_ok
+        from .pallas import flash_shapes_ok
 
         itemsize = jnp.dtype(q.dtype).itemsize
         # measured crossover (results/flash_attention_bench.json): XLA's

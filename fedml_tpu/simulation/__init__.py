@@ -86,6 +86,10 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
         resume=bool(getattr(args, "resume", True)),
         client_dropout_rate=float(getattr(args, "client_dropout_rate", 0.0)),
         cohort_schedule=str(getattr(args, "cohort_schedule", "auto")),
+        packed_lanes=(
+            None if getattr(args, "packed_lanes", None) is None
+            else int(args.packed_lanes)
+        ),
         max_width_buckets=int(getattr(args, "max_width_buckets", 4)),
         loss_kind=cfg.loss_kind,
     )

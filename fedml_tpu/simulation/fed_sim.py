@@ -60,6 +60,12 @@ class SimConfig:
     # fix the per-client batch count for a stable compiled shape; None =
     # derive from the largest client (padding+mask covers the rest)
     num_local_batches: Optional[int] = None
+    # packed schedule: force the lane count (None = the G*L cost search in
+    # core/scheduler.lane_schedule). Measured on the v5e: per-step cost is
+    # SUPERLINEAR in lane count (per-lane weights lower to grouped convs,
+    # whose thin per-group channels starve the MXU), so fewer, longer lanes
+    # can beat the padded-work optimum — set from a bench sweep.
+    packed_lanes: Optional[int] = None
     # checkpoint/resume (orbax; the reference has none — SURVEY.md §5.4)
     checkpoint_dir: Optional[str] = None
     checkpoint_frequency: int = 10
@@ -680,7 +686,8 @@ class FedSimulator:
         ]
         seq_counts = [c * epochs for c in counts]
         lanes, L = lane_schedule(seq_counts, self._axis_size,
-                                 max_lanes=len(positions))
+                                 max_lanes=len(positions),
+                                 force_lanes=cfg.packed_lanes)
         L_pad = -(-L // 4) * 4  # quantize: few compiled (G, L) shapes
         G = len(lanes)
         idx = np.zeros((G, L_pad, bs), np.int32)

@@ -77,6 +77,35 @@ def test_packed_matches_even_sp():
         hist_p[-1]["train_loss"], rel=2e-3)
 
 
+def test_packed_forced_lanes_matches_even():
+    """packed_lanes pins the lane count (bench-swept knob; per-step cost is
+    superlinear in lanes on real chips) without changing numerics."""
+    args_e = _args(cohort_schedule="even")
+    sim_e, apply_e = build_simulator(args_e)
+    sim_e.run(apply_e, log_fn=None)
+
+    for lanes in (1, 2):
+        args_p = _args(cohort_schedule="packed", packed_lanes=lanes)
+        sim_p, apply_p = build_simulator(args_p)
+        assert sim_p._packed and sim_p.cfg.packed_lanes == lanes
+        sim_p.run(apply_p, log_fn=None)
+        np.testing.assert_allclose(
+            _flat(sim_e.params), _flat(sim_p.params), rtol=2e-4, atol=2e-6)
+
+
+def test_lane_schedule_force_lanes():
+    from fedml_tpu.core.scheduler import lane_schedule
+
+    lanes, L = lane_schedule([8, 8, 4, 4], axis=1, force_lanes=2)
+    assert len(lanes) == 2 and L == 12
+    # force_lanes is rounded up to a multiple of the mesh axis
+    lanes, L = lane_schedule([8, 8, 4, 4], axis=2, force_lanes=3)
+    assert len(lanes) == 4
+    # and clamped to the cohort size
+    lanes, _ = lane_schedule([8, 8], axis=1, force_lanes=16)
+    assert len(lanes) == 2
+
+
 def test_packed_matches_even_multiepoch():
     args_e = _args(cohort_schedule="even", epochs=2, comm_round=2)
     sim_e, apply_e = build_simulator(args_e)
