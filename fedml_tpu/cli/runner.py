@@ -79,6 +79,24 @@ class FedMLEdgeRunner:
         self._job_history: Dict[str, str] = self._load_history()
         self._report_status(MLOpsMetrics.STATUS_IDLE)
 
+    @classmethod
+    def from_binding(cls, broker: PubSubBroker, bind_url: str,
+                     account_id: str, http_post=None, **kwargs):
+        """Hosted-platform flow (reference ``client_login.py`` →
+        ``bind_account_and_device_id``): register this host under the
+        account, then run the agent as the returned edge id. The transport
+        is injectable; a refused binding raises instead of silently running
+        as edge 0."""
+        from ..core.mlops import bind_account_and_device_id
+
+        edge_id = bind_account_and_device_id(
+            bind_url, account_id, http_post=http_post)
+        if not edge_id:
+            raise RuntimeError(
+                f"device binding refused for account {account_id} at "
+                f"{bind_url}")
+        return cls(edge_id, broker, **kwargs)
+
     def _load_history(self) -> Dict[str, str]:
         try:
             with open(self._history_path) as f:

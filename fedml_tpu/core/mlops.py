@@ -17,6 +17,7 @@ import json
 import logging
 import os
 import sys
+import threading
 import time
 import uuid
 from typing import Any, Dict, List, Optional
@@ -286,3 +287,143 @@ class MLOpsConfigs:
         resp = requests.get(url, verify=ca_path or True, timeout=timeout)
         resp.raise_for_status()
         return resp.json()
+
+
+# --- hosted-agent surface (reference cli/edge_deployment + mlops_runtime_log)
+
+
+def get_device_id() -> str:
+    """Stable device identifier (reference ``client_runner.get_device_id``:
+    the posix branch — ``hex(uuid.getnode())``; the wmic/hal branches are
+    Windows/HAL-specific and out of scope for TPU hosts)."""
+    return hex(uuid.getnode())
+
+
+def _default_http_post(url: str, json_params: Dict[str, Any],
+                       headers: Dict[str, str],
+                       ca_path: Optional[str] = None,
+                       timeout: float = 10.0) -> Dict[str, Any]:
+    import requests
+
+    resp = requests.post(url, json=json_params, headers=headers,
+                         verify=ca_path or True, timeout=timeout)
+    resp.raise_for_status()
+    return resp.json()
+
+
+def bind_account_and_device_id(
+    url: str,
+    account_id: str,
+    device_id: Optional[str] = None,
+    os_name: str = "posix",
+    http_post=None,
+    ca_path: Optional[str] = None,
+) -> int:
+    """Register this host under an account with the hosted platform and get
+    back its edge id (reference ``client_runner.bind_account_and_device_id``
+    :666 — same request/response schema). The transport is injectable so the
+    protocol is testable in zero-egress environments; 0 = refused, matching
+    the reference."""
+    post = http_post or _default_http_post
+    json_params = {
+        "accountid": str(account_id),
+        "deviceid": device_id or get_device_id(),
+        "type": os_name,
+        "gpu": "None", "processor": "", "network": "",
+    }
+    body = post(url, json_params, {"Connection": "close"}, ca_path)
+    if body.get("code") == "SUCCESS":
+        return int((body.get("data") or {}).get("id", 0))
+    return 0
+
+
+class MLOpsRuntimeLogUploader:
+    """Incremental log shipping to the hosted platform (reference
+    ``mlops_runtime_log.py:136 log_upload``: read new lines from the run's
+    log file, post them with the run/edge attribution schema). The cursor
+    only advances on a successful post, so an outage replays, never drops.
+    Transport injectable (zero-egress testable); ``start()`` runs the loop
+    on a daemon thread like the reference's log processor."""
+
+    def __init__(self, run_id, edge_id, log_file_path: str, upload_url: str,
+                 http_post=None, interval: float = 10.0,
+                 ca_path: Optional[str] = None, max_lines_per_post: int = 1000):
+        self.run_id = run_id
+        self.edge_id = edge_id
+        self.log_file_path = log_file_path
+        self.upload_url = upload_url
+        self._post = http_post or _default_http_post
+        self.interval = interval
+        self.ca_path = ca_path
+        self.max_lines = int(max_lines_per_post)
+        self.log_line_index = 0   # total lines shipped (info/parity)
+        self._offset = 0          # byte cursor: O(new bytes) per tick
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._upload_lock = threading.Lock()  # stop()-flush vs loop thread
+
+    def log_read(self):
+        """New complete lines since the byte cursor. Rotation/truncation
+        (file smaller than the cursor) resets to the file head rather than
+        stalling forever."""
+        try:
+            size = os.path.getsize(self.log_file_path)
+        except OSError:
+            return []
+        if size < self._offset:
+            self._offset = 0  # rotated or truncated: start over on the new file
+        with open(self.log_file_path, errors="replace") as f:
+            f.seek(self._offset)
+            lines = f.readlines()
+        # a partial trailing line (no newline yet) waits for the next tick
+        if lines and not lines[-1].endswith("\n"):
+            lines.pop()
+        return lines[: self.max_lines]
+
+    def log_upload(self) -> int:
+        """Ship pending lines; returns how many were uploaded."""
+        with self._upload_lock:
+            lines = self.log_read()
+            if not lines:
+                return 0
+            now = time.time()
+            request = {  # schema parity: mlops_runtime_log.py:143-152
+                "run_id": self.run_id,
+                "edge_id": self.edge_id,
+                "logs": lines,
+                "create_time": now,
+                "update_time": now,
+                "created_by": str(self.edge_id),
+                "updated_by": str(self.edge_id),
+            }
+            self._post(
+                self.upload_url, request,
+                {"Content-Type": "application/json", "Connection": "close"},
+                self.ca_path)
+            # only after a successful post, so an outage replays
+            self._offset += sum(len(ln.encode("utf-8", "replace"))
+                                for ln in lines)
+            self.log_line_index += len(lines)
+            return len(lines)
+
+    def start(self) -> None:
+        def loop():
+            while not self._stop.wait(self.interval):
+                try:
+                    self.log_upload()
+                except Exception:
+                    logging.exception("log upload failed; will retry")
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="mlops-log-upload")
+        self._thread.start()
+
+    def stop(self, flush: bool = True) -> None:
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=5)
+        if flush:
+            try:
+                self.log_upload()
+            except Exception:
+                logging.exception("final log flush failed")

@@ -22,7 +22,7 @@ from .linear import LogisticRegression
 from .resnet import CifarResNet, ResNet18
 from .rnn import RNNOriginalFedAvg, RNNStackOverFlow
 from .mobilenet import MobileNetV1
-from .mobilenet_v3 import EfficientNetLite, MobileNetV3Small, VGG
+from .mobilenet_v3 import EfficientNet, EfficientNetLite, MobileNetV3Small, VGG
 from .transformer import (
     Seq2SeqTransformer,
     TransformerClassifier,
@@ -52,7 +52,7 @@ __all__ = [
     "create", "init_params", "sample_input_for",
     "LogisticRegression", "CNNDropOut", "CNNOriginalFedAvg",
     "CifarResNet", "ResNet18", "RNNOriginalFedAvg", "RNNStackOverFlow",
-    "MobileNetV1", "MobileNetV3Small", "EfficientNetLite", "VGG",
+    "MobileNetV1", "MobileNetV3Small", "EfficientNet", "EfficientNetLite", "VGG",
     "TransformerLM", "TransformerClassifier", "ViT",
     "TransformerTagger", "TransformerSpanExtractor", "Seq2SeqTransformer",
     "Generator", "Discriminator", "GKTClientNet", "GKTServerNet",
@@ -91,6 +91,17 @@ def create(args, output_dim: int):
         return MobileNetV3Small(num_classes=output_dim, dtype=dtype)
     if model_name == "efficientnet":
         return EfficientNetLite(num_classes=output_dim, dtype=dtype)
+    if model_name.startswith("efficientnet-"):
+        # compound-scaling family (reference model/cv/efficientnet)
+        from .mobilenet_v3 import EFFICIENTNET_PARAMS
+
+        variant = model_name.split("-", 1)[1]
+        if variant not in EFFICIENTNET_PARAMS:
+            raise ValueError(
+                f"unknown efficientnet variant '{variant}' "
+                f"(have {sorted(EFFICIENTNET_PARAMS)})")
+        return EfficientNet(num_classes=output_dim, variant=variant,
+                            dtype=dtype)
     if model_name == "vgg11":
         return VGG(num_classes=output_dim, dtype=dtype)
     if model_name == "darts":

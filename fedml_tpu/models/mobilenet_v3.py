@@ -126,6 +126,78 @@ class EfficientNetLite(nn.Module):
         return nn.Dense(self.num_classes, dtype=self.dtype)(x)
 
 
+# EfficientNet compound scaling (reference ``model/cv/efficientnet/`` —
+# the full b0-b7 family, not just the lite profile): width/depth/dropout
+# per variant; resolution rides the caller's input size as in the reference.
+EFFICIENTNET_PARAMS = {
+    "b0": (1.0, 1.0, 0.2),
+    "b1": (1.0, 1.1, 0.2),
+    "b2": (1.1, 1.2, 0.3),
+    "b3": (1.2, 1.4, 0.3),
+    "b4": (1.4, 1.8, 0.4),
+    "b5": (1.6, 2.2, 0.4),
+    "b6": (1.8, 2.6, 0.5),
+    "b7": (2.0, 3.1, 0.5),
+}
+
+# B0 base config: (out_ch, expand, stride, kernel, repeats)
+_EFFNET_B0_BLOCKS = (
+    (16, 1, 1, 3, 1),
+    (24, 6, 2, 3, 2),
+    (40, 6, 2, 5, 2),
+    (80, 6, 2, 3, 3),
+    (112, 6, 1, 5, 3),
+    (192, 6, 2, 5, 4),
+    (320, 6, 1, 3, 1),
+)
+
+
+def round_filters(ch: int, width: float, divisor: int = 8) -> int:
+    """Reference ``efficientnet_utils.round_filters`` semantics."""
+    ch *= width
+    new = max(divisor, int(ch + divisor / 2) // divisor * divisor)
+    if new < 0.9 * ch:  # never shrink >10%
+        new += divisor
+    return int(new)
+
+
+def round_repeats(r: int, depth: float) -> int:
+    import math
+
+    return int(math.ceil(depth * r))
+
+
+class EfficientNet(nn.Module):
+    """Compound-scaled EfficientNet family (reference
+    ``model/cv/efficientnet/``): SE blocks on, swish activations via the
+    MBConv 'hswish' profile, GN in place of BN per the repo's FL norm
+    policy (running-stat averaging pathologies — models/resnet.py note)."""
+
+    num_classes: int = 10
+    variant: str = "b0"
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        width, depth, dropout = EFFICIENTNET_PARAMS[self.variant]
+        x = x.astype(self.dtype)
+        x = nn.Conv(round_filters(32, width), (3, 3), strides=(2, 2),
+                    use_bias=False, dtype=self.dtype)(x)
+        x = hard_swish(nn.GroupNorm(num_groups=8, dtype=self.dtype)(x))
+        for out_ch, expand, stride, kernel, repeats in _EFFNET_B0_BLOCKS:
+            out_ch = round_filters(out_ch, width)
+            for i in range(round_repeats(repeats, depth)):
+                x = MBConv(out_ch, expand, stride if i == 0 else 1, kernel,
+                           use_se=True, dtype=self.dtype)(x)
+        x = nn.Conv(round_filters(1280, width), (1, 1), use_bias=False,
+                    dtype=self.dtype)(x)
+        x = hard_swish(nn.GroupNorm(num_groups=8, dtype=self.dtype)(x))
+        x = x.mean(axis=(1, 2))
+        # per-variant head dropout (the third compound-scaling coefficient)
+        x = nn.Dropout(dropout, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=self.dtype)(x)
+
+
 class VGG(nn.Module):
     """Reference ``model/cv/vgg.py`` (VGG-11 profile, GN)."""
 

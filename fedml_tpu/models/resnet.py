@@ -28,11 +28,22 @@ import jax.numpy as jnp
 ModuleDef = Any
 
 
+SYNC_BN_AXIS = "sync_bn"
+
+
 def _norm(norm: str, dtype) -> Callable:
     if norm == "group":
         return partial(nn.GroupNorm, num_groups=None, group_size=16, dtype=dtype)
     if norm == "batch":
         return partial(nn.BatchNorm, use_running_average=None, momentum=0.9, dtype=dtype)
+    if norm == "sync_batch":
+        # SyncBN (reference model/cv/batchnorm_utils.py:488): batch stats
+        # are all-reduced over the mapped device axis named SYNC_BN_AXIS —
+        # TPU-first this is flax's axis_name hook riding an XLA psum, not a
+        # NCCL allreduce; run the model under shard_map/pmap/vmap with that
+        # axis name bound
+        return partial(nn.BatchNorm, use_running_average=None, momentum=0.9,
+                       axis_name=SYNC_BN_AXIS, dtype=dtype)
     raise ValueError(norm)
 
 
@@ -73,7 +84,7 @@ class CifarResNet(nn.Module):
     def __call__(self, x, train: bool = False):
         n = (self.depth - 2) // 6
         norm = _norm(self.norm_kind, self.dtype)
-        if self.norm_kind == "batch":
+        if self.norm_kind in ("batch", "sync_batch"):
             norm = partial(norm, use_running_average=not train)
         x = x.astype(self.dtype)
         x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)
@@ -99,7 +110,7 @@ class ResNet18(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False):
         norm = _norm(self.norm_kind, self.dtype)
-        if self.norm_kind == "batch":
+        if self.norm_kind in ("batch", "sync_batch"):
             norm = partial(norm, use_running_average=not train)
         x = x.astype(self.dtype)
         if self.small_input:

@@ -72,7 +72,10 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
                                   or 0.0),
         loss_kind=_infer_loss_kind(args, fed_data),
     )
-    needs_dropout = getattr(args, "model", "lr") in ("cnn",)
+    model_name = str(getattr(args, "model", "lr"))
+    # models with live Dropout layers need a 'dropout' rng threaded through
+    # training (cnn = CNN_DropOut; efficientnet-b* head dropout)
+    needs_dropout = model_name in ("cnn",) or model_name.startswith("efficientnet-")
     optimizer_name = str(getattr(args, "federated_optimizer", "FedAvg"))
     sim_cfg = SimConfig(
         comm_round=int(getattr(args, "comm_round", 10)),

@@ -81,6 +81,8 @@ SLOW_TEST_NAMES = (
     "test_fedgraphnn_gcn_learns",
     "test_digits_real_dataset_learns",
     "test_fedopt_adaptive_server_optimizers_learn",
+    "test_sync_batchnorm_matches_full_batch_stats",
+    "test_efficientnet_family_scales",
 )
 
 
