@@ -35,6 +35,7 @@ from .gan import Discriminator, Generator
 from .gkt import GKTClientNet, GKTServerNet
 from .darts import DARTSSearchNet, derive_genotype
 from .unet import UNetLite
+from .yolo import YoloLiteDetector
 from .gcn import (
     GCNGraphClassifier,
     GCNGraphRegressor,
@@ -56,7 +57,7 @@ __all__ = [
     "TransformerLM", "TransformerClassifier", "ViT",
     "TransformerTagger", "TransformerSpanExtractor", "Seq2SeqTransformer",
     "Generator", "Discriminator", "GKTClientNet", "GKTServerNet",
-    "DARTSSearchNet", "derive_genotype", "UNetLite", "GCNGraphClassifier",
+    "DARTSSearchNet", "derive_genotype", "UNetLite", "YoloLiteDetector", "GCNGraphClassifier",
     "GCNNodeClassifier", "GCNLinkPredictor", "GCNGraphRegressor",
     "MobileLeNet5", "MobileResNet18", "build_mobile_model_file",
     "load_mobile_model_file",
@@ -108,6 +109,9 @@ def create(args, output_dim: int):
         return DARTSSearchNet(num_classes=output_dim, dtype=dtype)
     if model_name == "unet":
         return UNetLite(num_classes=output_dim, dtype=dtype)
+    if model_name == "yolo_lite":
+        # multi-scale anchor detector (reference app/fedcv YOLOv5 class)
+        return YoloLiteDetector(num_classes=output_dim, dtype=dtype)
     if model_name in ("gcn", "graph"):
         return GCNGraphClassifier(
             num_classes=output_dim,

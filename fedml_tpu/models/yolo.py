@@ -1,0 +1,314 @@
+"""Multi-scale anchor-based detector (YOLO-family depth, TPU-native).
+
+Parity: reference ``app/fedcv/object_detection`` vendors the full YOLOv5
+torch tree — CSP backbone, PANet/FPN neck, 3-anchor heads at strides
+8/16/32, CIoU box loss, NMS (~10k LoC of torch). This module is the
+TPU-first rebuild of that *architecture class* (models/detection.py keeps
+the compact anchor-free variant for the light path):
+
+- conv backbone with three pyramid levels (strides 8/16/32),
+- top-down FPN merge (nearest upsample + 1x1 lateral, YOLOv5 neck role),
+- per-level heads predicting A anchors x (obj, dx, dy, dw, dh, classes),
+- anchor-prior target assignment (host-side numpy, like the reference's
+  build_targets) with best-IoU anchor matching,
+- CIoU regression loss + BCE objectness + CE class (jax, static shapes),
+- batched fixed-size NMS under jit (lax.fori_loop greedy suppression —
+  no dynamic shapes, so it compiles onto the accelerator; the reference
+  runs torchvision.ops.nms on host).
+
+Everything jit-side is static-shape: per-level targets are packed into one
+(sum(S_l^2 * A), 6) array per sample so the federated engine's rectangular
+batch pipeline carries them like any label tensor.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+# normalized (w, h) anchor priors per pyramid level (stride 8 / 16 / 32) —
+# small/medium/large, the YOLOv5 P3/P4/P5 split scaled to unit images
+ANCHORS = (
+    ((0.04, 0.05), (0.08, 0.06), (0.06, 0.12)),
+    ((0.12, 0.16), (0.20, 0.14), (0.16, 0.28)),
+    ((0.30, 0.35), (0.45, 0.30), (0.55, 0.60)),
+)
+A = 3  # anchors per level
+
+
+def _conv_block(x, ch, dtype, name, stride=1):
+    x = nn.Conv(ch, (3, 3), strides=(stride, stride), use_bias=False,
+                dtype=dtype, name=f"{name}_conv")(x)
+    x = nn.GroupNorm(num_groups=min(8, ch), dtype=dtype, name=f"{name}_gn")(x)
+    return nn.silu(x)
+
+
+class YoloLiteDetector(nn.Module):
+    """Backbone -> FPN -> per-level anchor heads.
+
+    Input (B, H, W, C); H must be divisible by 32. Returns a list of three
+    tensors (B, S_l, S_l, A, 5 + num_classes) for strides 8/16/32, raw
+    logits (decode applies sigmoid/exp).
+    """
+
+    num_classes: int = 2
+    width: int = 32
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.shape[1] != x.shape[2] or x.shape[1] % 32:
+            raise ValueError(
+                f"YoloLiteDetector needs square input with H % 32 == 0, got "
+                f"{x.shape[1]}x{x.shape[2]} (the grid/anchor plumbing — "
+                "rasterize_multiscale, yolo_loss — is square-indexed)")
+        w, dt = self.width, self.dtype
+        h = x.astype(dt)
+        h = _conv_block(h, w, dt, "stem", stride=2)          # /2
+        h = _conv_block(h, w, dt, "s1", stride=2)            # /4
+        h = _conv_block(h, w, dt, "s1b")
+        p3 = _conv_block(h, 2 * w, dt, "s2", stride=2)       # /8
+        p3 = _conv_block(p3, 2 * w, dt, "s2b")
+        p4 = _conv_block(p3, 4 * w, dt, "s3", stride=2)      # /16
+        p4 = _conv_block(p4, 4 * w, dt, "s3b")
+        p5 = _conv_block(p4, 8 * w, dt, "s4", stride=2)      # /32
+        p5 = _conv_block(p5, 8 * w, dt, "s4b")
+
+        # top-down FPN: lateral 1x1 + nearest upsample + merge
+        def up2(t):
+            B, H, W, C = t.shape
+            return jax.image.resize(t, (B, 2 * H, 2 * W, C), "nearest")
+
+        l5 = nn.Conv(4 * w, (1, 1), dtype=dt, name="lat5")(p5)
+        m4 = _conv_block(
+            jnp.concatenate([nn.Conv(4 * w, (1, 1), dtype=dt, name="lat4")(p4),
+                             up2(l5)], axis=-1), 4 * w, dt, "fpn4")
+        m3 = _conv_block(
+            jnp.concatenate([nn.Conv(2 * w, (1, 1), dtype=dt, name="lat3")(p3),
+                             up2(nn.Conv(2 * w, (1, 1), dtype=dt,
+                                         name="red4")(m4))], axis=-1),
+            2 * w, dt, "fpn3")
+
+        outs = []
+        for name, feat in (("head3", m3), ("head4", m4), ("head5", l5)):
+            o = nn.Conv(A * (5 + self.num_classes), (1, 1), dtype=dt,
+                        name=name)(feat)
+            B, S, _, _ = o.shape
+            outs.append(o.reshape(B, S, S, A, 5 + self.num_classes))
+        return outs
+
+
+# --- target assignment (host-side, reference build_targets role) -----------
+
+def _wh_iou(wh: Tuple[float, float], anchors: Sequence[Tuple[float, float]]):
+    """IoU of a (w, h) box against anchor priors, both centered."""
+    out = []
+    for aw, ah in anchors:
+        inter = min(wh[0], aw) * min(wh[1], ah)
+        union = wh[0] * wh[1] + aw * ah - inter
+        out.append(inter / max(union, 1e-12))
+    return np.asarray(out)
+
+
+def level_grids(image_size: int) -> Tuple[int, int, int]:
+    return image_size // 8, image_size // 16, image_size // 32
+
+
+def rasterize_multiscale(boxes: np.ndarray, classes: np.ndarray,
+                         image_size: int, num_classes: int) -> np.ndarray:
+    """Boxes (N,4 cxcywh, normalized) + classes (N,) -> packed target
+    (sum_l S_l^2 * A, 6) rows [obj, class, dx, dy, w, h]. Each box is
+    assigned to the globally best-IoU anchor prior (level, anchor), at the
+    cell containing its center — the reference's best-anchor matching."""
+    if len(classes) and int(np.max(classes)) >= num_classes:
+        raise ValueError(
+            f"class id {int(np.max(classes))} >= num_classes {num_classes}")
+    grids = level_grids(image_size)
+    levels = [np.zeros((S, S, A, 6), np.float32) for S in grids]
+    for (cx, cy, w, h), c in zip(boxes, classes):
+        ious = np.concatenate(
+            [_wh_iou((w, h), ANCHORS[li]) for li in range(3)])
+        best = int(np.argmax(ious))
+        li, ai = divmod(best, A)
+        S = grids[li]
+        gx = min(int(cx * S), S - 1)
+        gy = min(int(cy * S), S - 1)
+        levels[li][gy, gx, ai] = (1.0, float(c), cx * S - gx, cy * S - gy,
+                                  w, h)
+    return np.concatenate([t.reshape(-1, 6) for t in levels], axis=0)
+
+
+def unpack_targets(packed: jax.Array, image_size: int) -> List[jax.Array]:
+    """(..., sum_l S_l^2*A, 6) -> per-level (..., S, S, A, 6)."""
+    grids = level_grids(image_size)
+    outs, off = [], 0
+    for S in grids:
+        n = S * S * A
+        outs.append(packed[..., off:off + n, :].reshape(
+            packed.shape[:-2] + (S, S, A, 6)))
+        off += n
+    return outs
+
+
+# --- losses ----------------------------------------------------------------
+
+def ciou(pred: jax.Array, target: jax.Array) -> jax.Array:
+    """Complete-IoU (Zheng et al., the YOLOv5 box loss) on (..., 4) cxcywh
+    boxes in unit coordinates. Returns (...,) CIoU in [-1.5, 1]."""
+    px, py, pw, ph = (pred[..., i] for i in range(4))
+    tx, ty, tw, th = (target[..., i] for i in range(4))
+    pw, ph = jnp.maximum(pw, 1e-6), jnp.maximum(ph, 1e-6)
+    tw, th = jnp.maximum(tw, 1e-6), jnp.maximum(th, 1e-6)
+    # IoU
+    x1 = jnp.maximum(px - pw / 2, tx - tw / 2)
+    y1 = jnp.maximum(py - ph / 2, ty - th / 2)
+    x2 = jnp.minimum(px + pw / 2, tx + tw / 2)
+    y2 = jnp.minimum(py + ph / 2, ty + th / 2)
+    inter = jnp.maximum(x2 - x1, 0.0) * jnp.maximum(y2 - y1, 0.0)
+    union = pw * ph + tw * th - inter
+    iou = inter / jnp.maximum(union, 1e-12)
+    # center distance / enclosing diagonal
+    cw = jnp.maximum(px + pw / 2, tx + tw / 2) - jnp.minimum(
+        px - pw / 2, tx - tw / 2)
+    chh = jnp.maximum(py + ph / 2, ty + th / 2) - jnp.minimum(
+        py - ph / 2, ty - th / 2)
+    c2 = cw ** 2 + chh ** 2
+    rho2 = (px - tx) ** 2 + (py - ty) ** 2
+    # aspect-ratio consistency
+    v = (4 / jnp.pi ** 2) * (jnp.arctan(tw / th) - jnp.arctan(pw / ph)) ** 2
+    alpha = v / jnp.maximum(1.0 - iou + v, 1e-12)
+    return iou - rho2 / jnp.maximum(c2, 1e-12) - alpha * v
+
+
+def decode_level(raw: jax.Array, level: int) -> jax.Array:
+    """Raw head output (..., S, S, A, 5+C) -> boxes (..., S, S, A, 4)
+    cxcywh: sigmoid cell offsets, anchor-scaled exp sizes."""
+    S = raw.shape[-4]
+    gy, gx = jnp.meshgrid(jnp.arange(S), jnp.arange(S), indexing="ij")
+    anch = jnp.asarray(ANCHORS[level])  # (A, 2)
+    cx = (jax.nn.sigmoid(raw[..., 1]) + gx[..., None]) / S
+    cy = (jax.nn.sigmoid(raw[..., 2]) + gy[..., None]) / S
+    w = anch[:, 0] * jnp.exp(jnp.clip(raw[..., 3], -6, 4))
+    h = anch[:, 1] * jnp.exp(jnp.clip(raw[..., 4], -6, 4))
+    return jnp.stack([cx, cy, w, h], axis=-1)
+
+
+def yolo_loss(outs: List[jax.Array], packed_targets: jax.Array,
+              image_size: int, num_classes: int,
+              mask: jax.Array | None = None,
+              box_weight: float = 5.0, noobj_weight: float = 0.5):
+    """Multi-level detection loss (reference ``loss.py`` role): BCE
+    objectness everywhere, CIoU + CE on object-owning anchors. ``mask``
+    (B,) {0,1} drops padded samples (the engine's rectangle padding).
+    Returns (loss, (correct, valid)) matching the engine's metric
+    contract."""
+    B = packed_targets.shape[0]
+    m = jnp.ones((B,), jnp.float32) if mask is None else mask.astype(
+        jnp.float32).reshape(B)
+    m_live = jnp.maximum(m.sum(), 1.0)
+    targets = unpack_targets(packed_targets, image_size)
+    total = 0.0
+    correct = 0.0
+    valid = 0.0
+    for li, (raw, tgt) in enumerate(zip(outs, targets)):
+        obj_t = tgt[..., 0]
+        obj_w = obj_t * m[:, None, None, None]  # padded samples own nothing
+        obj_logit = raw[..., 0]
+        bce = optax.sigmoid_binary_cross_entropy(obj_logit, obj_t)
+        bce = jnp.where(obj_t > 0, bce, noobj_weight * bce)
+        obj_loss = (bce.mean(axis=(1, 2, 3)) * m).sum() / m_live
+
+        S = raw.shape[-4]
+        gy, gx = jnp.meshgrid(jnp.arange(S), jnp.arange(S), indexing="ij")
+        tboxes = jnp.stack([
+            (tgt[..., 2] + gx[..., None]) / S,
+            (tgt[..., 3] + gy[..., None]) / S,
+            tgt[..., 4], tgt[..., 5]], axis=-1)
+        pboxes = decode_level(raw, li)
+        box_loss = (obj_w * (1.0 - ciou(pboxes, tboxes))).sum() / jnp.maximum(
+            obj_w.sum(), 1.0)
+
+        logits = raw[..., 5:]
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        cls_t = tgt[..., 1].astype(jnp.int32)
+        ce = -jnp.take_along_axis(logp, cls_t[..., None], axis=-1)[..., 0]
+        cls_loss = (obj_w * ce).sum() / jnp.maximum(obj_w.sum(), 1.0)
+
+        total = total + obj_loss + box_weight * box_loss + cls_loss
+        pred_cls = jnp.argmax(logits, axis=-1)
+        correct = correct + (obj_w * (pred_cls == cls_t)).sum()
+        valid = valid + obj_w.sum()
+    return total, (correct, valid)
+
+
+# --- jit-side fixed-size NMS ----------------------------------------------
+
+def batched_nms(boxes: jax.Array, scores: jax.Array, iou_threshold: float,
+                max_out: int) -> Tuple[jax.Array, jax.Array]:
+    """Greedy NMS with STATIC shapes (compiles on TPU; the reference runs
+    torch NMS on host). boxes (N, 4) cxcywh, scores (N,). Returns
+    (keep_idx (max_out,), keep_valid (max_out,) {0,1})."""
+    n = boxes.shape[0]
+    x1 = boxes[:, 0] - boxes[:, 2] / 2
+    y1 = boxes[:, 1] - boxes[:, 3] / 2
+    x2 = boxes[:, 0] + boxes[:, 2] / 2
+    y2 = boxes[:, 1] + boxes[:, 3] / 2
+    area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
+
+    def pair_iou(i, mask):
+        xx1 = jnp.maximum(x1[i], x1)
+        yy1 = jnp.maximum(y1[i], y1)
+        xx2 = jnp.minimum(x2[i], x2)
+        yy2 = jnp.minimum(y2[i], y2)
+        inter = jnp.maximum(xx2 - xx1, 0) * jnp.maximum(yy2 - yy1, 0)
+        return inter / jnp.maximum(area[i] + area - inter, 1e-12)
+
+    def body(k, carry):
+        live, keep, kvalid = carry
+        masked = jnp.where(live > 0, scores, -jnp.inf)
+        i = jnp.argmax(masked)
+        ok = (masked[i] > -jnp.inf).astype(jnp.float32)
+        keep = keep.at[k].set(jnp.where(ok > 0, i, -1))
+        kvalid = kvalid.at[k].set(ok)
+        suppress = (pair_iou(i, live) > iou_threshold).astype(jnp.float32)
+        live = jnp.where(ok > 0, live * (1.0 - suppress), live)
+        live = live.at[i].set(0.0)
+        return live, keep, kvalid
+
+    live0 = jnp.ones((n,), jnp.float32)
+    keep0 = jnp.full((max_out,), -1, jnp.int32)
+    kv0 = jnp.zeros((max_out,), jnp.float32)
+    _, keep, kvalid = jax.lax.fori_loop(0, max_out, body, (live0, keep0, kv0))
+    return keep, kvalid
+
+
+def detect(outs: List[jax.Array], image_size: int, score_threshold: float,
+           iou_threshold: float = 0.5, max_out: int = 32):
+    """Decode one image's head outputs (list of (S,S,A,5+C), no batch dim)
+    into (boxes (max_out, 4), scores, classes, valid) via jit-side NMS."""
+    all_boxes, all_scores, all_cls = [], [], []
+    for li, raw in enumerate(outs):
+        boxes = decode_level(raw, li).reshape(-1, 4)
+        obj = jax.nn.sigmoid(raw[..., 0]).reshape(-1)
+        cls_p = jax.nn.softmax(raw[..., 5:], axis=-1)
+        cls = jnp.argmax(cls_p, axis=-1).reshape(-1)
+        conf = obj * jnp.max(cls_p, axis=-1).reshape(-1)
+        all_boxes.append(boxes)
+        all_scores.append(conf)
+        all_cls.append(cls)
+    boxes = jnp.concatenate(all_boxes)
+    scores = jnp.concatenate(all_scores)
+    classes = jnp.concatenate(all_cls)
+    scores = jnp.where(scores >= score_threshold, scores, 0.0)
+    # class-aware NMS, YOLOv5-style: offset each class into its own
+    # coordinate region so cross-class overlaps never suppress each other
+    offset_boxes = boxes.at[:, :2].add(classes[:, None].astype(boxes.dtype) * 4.0)
+    keep, kvalid = batched_nms(offset_boxes, scores, iou_threshold, max_out)
+    safe = jnp.maximum(keep, 0)
+    kvalid = kvalid * (scores[safe] > 0)
+    return boxes[safe], scores[safe], classes[safe], kvalid
