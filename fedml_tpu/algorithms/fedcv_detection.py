@@ -79,14 +79,9 @@ def make_detection_local_update(apply_fn: Callable, lr: float = 1e-3,
     return make_local_update(apply_fn, cfg, loss_fn=loss_fn)
 
 
-def _fedavg_detection_algorithm(name: str, apply_fn: Callable, loss_fn,
-                                lr: float, epochs: int) -> FedAlgorithm:
-    """Shared scaffold: any detection loss on the engine's compiled client
-    step + plain FedAvg server update."""
-    from .local_sgd import LocalTrainConfig, make_local_update
-
-    cfg = LocalTrainConfig(lr=lr, epochs=epochs, client_optimizer="adam")
-    local_update = make_local_update(apply_fn, cfg, loss_fn=loss_fn)
+def _fedavg_detection_algorithm(name: str, local_update: Callable) -> FedAlgorithm:
+    """Shared scaffold: any detection local update + plain FedAvg server
+    update."""
 
     def server_update(params, agg_delta, state):
         return tree_add(params, agg_delta), state
@@ -103,12 +98,9 @@ def _fedavg_detection_algorithm(name: str, apply_fn: Callable, loss_fn,
 def get_detection_algorithm(apply_fn: Callable, lr: float = 1e-3,
                             epochs: int = 1,
                             box_weight: float = 5.0) -> FedAlgorithm:
-    def loss_fn(params, x, y, mask, rng):
-        pred = apply_fn(params, x, train=True)
-        return detection_loss(pred, y, mask, box_weight)
-
     return _fedavg_detection_algorithm(
-        "FedDetection", apply_fn, loss_fn, lr, epochs)
+        "FedDetection",
+        make_detection_local_update(apply_fn, lr, epochs, box_weight))
 
 
 def get_yolo_algorithm(apply_fn: Callable, image_size: int,
@@ -119,10 +111,13 @@ def get_yolo_algorithm(apply_fn: Callable, image_size: int,
     architecture class) on the same shared engine: the CIoU/BCE/CE
     multi-level loss rides make_local_update like every other task."""
     from ..models.yolo import yolo_loss
+    from .local_sgd import LocalTrainConfig, make_local_update
 
     def loss_fn(params, x, y, mask, rng):
         outs = apply_fn(params, x, train=True)
         return yolo_loss(outs, y, image_size, num_classes, mask=mask,
                          box_weight=box_weight, noobj_weight=noobj_weight)
 
-    return _fedavg_detection_algorithm("FedYolo", apply_fn, loss_fn, lr, epochs)
+    cfg = LocalTrainConfig(lr=lr, epochs=epochs, client_optimizer="adam")
+    return _fedavg_detection_algorithm(
+        "FedYolo", make_local_update(apply_fn, cfg, loss_fn=loss_fn))

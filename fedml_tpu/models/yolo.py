@@ -136,8 +136,8 @@ def rasterize_multiscale(boxes: np.ndarray, classes: np.ndarray,
         best = int(np.argmax(ious))
         li, ai = divmod(best, A)
         S = grids[li]
-        gx = min(int(cx * S), S - 1)
-        gy = min(int(cy * S), S - 1)
+        gx = max(0, min(int(cx * S), S - 1))  # clamp BOTH sides: negative
+        gy = max(0, min(int(cy * S), S - 1))  # centers must not wrap to -1
         levels[li][gy, gx, ai] = (1.0, float(c), cx * S - gx, cy * S - gy,
                                   w, h)
     return np.concatenate([t.reshape(-1, 6) for t in levels], axis=0)
@@ -211,6 +211,10 @@ def yolo_loss(outs: List[jax.Array], packed_targets: jax.Array,
     m = jnp.ones((B,), jnp.float32) if mask is None else mask.astype(
         jnp.float32).reshape(B)
     m_live = jnp.maximum(m.sum(), 1.0)
+    if outs[0].shape[-1] != 5 + num_classes:
+        raise ValueError(
+            f"head width {outs[0].shape[-1]} != 5 + num_classes "
+            f"({5 + num_classes}) — model/num_classes mismatch")
     targets = unpack_targets(packed_targets, image_size)
     total = 0.0
     correct = 0.0
@@ -260,7 +264,7 @@ def batched_nms(boxes: jax.Array, scores: jax.Array, iou_threshold: float,
     y2 = boxes[:, 1] + boxes[:, 3] / 2
     area = jnp.maximum(x2 - x1, 0) * jnp.maximum(y2 - y1, 0)
 
-    def pair_iou(i, mask):
+    def pair_iou(i):
         xx1 = jnp.maximum(x1[i], x1)
         yy1 = jnp.maximum(y1[i], y1)
         xx2 = jnp.minimum(x2[i], x2)
@@ -275,7 +279,7 @@ def batched_nms(boxes: jax.Array, scores: jax.Array, iou_threshold: float,
         ok = (masked[i] > -jnp.inf).astype(jnp.float32)
         keep = keep.at[k].set(jnp.where(ok > 0, i, -1))
         kvalid = kvalid.at[k].set(ok)
-        suppress = (pair_iou(i, live) > iou_threshold).astype(jnp.float32)
+        suppress = (pair_iou(i) > iou_threshold).astype(jnp.float32)
         live = jnp.where(ok > 0, live * (1.0 - suppress), live)
         live = live.at[i].set(0.0)
         return live, keep, kvalid
@@ -307,7 +311,9 @@ def detect(outs: List[jax.Array], image_size: int, score_threshold: float,
     scores = jnp.where(scores >= score_threshold, scores, 0.0)
     # class-aware NMS, YOLOv5-style: offset each class into its own
     # coordinate region so cross-class overlaps never suppress each other
-    offset_boxes = boxes.at[:, :2].add(classes[:, None].astype(boxes.dtype) * 4.0)
+    # offset must exceed the max decodable extent (w <= 0.55*e^4 ~ 30 plus
+    # unit coords), or large cross-class boxes could still overlap
+    offset_boxes = boxes.at[:, :2].add(classes[:, None].astype(boxes.dtype) * 64.0)
     keep, kvalid = batched_nms(offset_boxes, scores, iou_threshold, max_out)
     safe = jnp.maximum(keep, 0)
     kvalid = kvalid * (scores[safe] > 0)
