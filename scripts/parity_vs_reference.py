@@ -65,6 +65,12 @@ def run_engine(model_name, x, y, idx_map, n_classes, per_round, rounds,
                epochs, lr, seed):
     import jax
 
+    # Parity is about ALGORITHM semantics, so pin true-f32 math: on TPU the
+    # default matmul/conv precision decomposes f32 into bf16 passes, which
+    # drifts past the tolerance over rounds (measured: cnn 0.057 loss diff
+    # at default vs ~1e-4 at highest). CPU is unaffected.
+    jax.config.update("jax_default_matmul_precision", "highest")
+
     import fedml_tpu
     from fedml_tpu.data.federated import ArrayPair, build_federated_data
     from fedml_tpu.simulation import build_simulator
@@ -249,7 +255,10 @@ def run_parity(model_name, feat_shape, n_classes, sizes, per_round, rounds,
 
 
 def main():
+    import jax
+
     results = {
+        "engine_backend": jax.default_backend(),
         "basis": (
             "reference FedAvg semantics (sampling fedavg_api.py:129-143, "
             "trainer my_model_trainer_classification.py:15, aggregation "
