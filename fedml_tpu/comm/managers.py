@@ -60,14 +60,51 @@ def create_comm_backend(backend: str, rank: int, size: int, args=None, **kw) -> 
             from ..core.mlops import MLOpsConfigs
 
             mqtt_cfg, s3_cfg = MLOpsConfigs(args).fetch_configs()
+        run_id = str(getattr(args, "run_id", 0))
         if broker is None:
-            broker = FileSystemBroker(
-                root=kw.get("broker_dir") or mqtt_cfg.get("broker_dir")
-            )
+            # precedence: an EXPLICIT broker_dir kwarg always wins (the
+            # MLOpsConfigs doc's user-proximate rule — a cached config file
+            # must never hijack a run that passed its dirs explicitly);
+            # then a configured broker endpoint (reference mqtt config keys
+            # BROKER_HOST/BROKER_PORT, mqtt_s3_..._comm_manager.py:75)
+            # selects the real-wire MQTT 3.1.1 driver; else filesystem
+            host = mqtt_cfg.get("BROKER_HOST") or mqtt_cfg.get("host")
+            if kw.get("broker_dir"):
+                broker = FileSystemBroker(root=kw["broker_dir"])
+            elif host:
+                from .mqtt_wire import MqttWireBroker
+
+                broker = MqttWireBroker(
+                    host, int(mqtt_cfg.get("BROKER_PORT")
+                              or mqtt_cfg.get("port") or 1883),
+                    # run-scoped id: two runs sharing a hosted broker must
+                    # not collide on ClientId (§3.1.4 kicks the older one)
+                    client_id=f"fedml-run{run_id}-rank{rank}",
+                    keepalive=int(mqtt_cfg.get("MQTT_KEEPALIVE") or 60),
+                )
+            else:
+                broker = FileSystemBroker(root=mqtt_cfg.get("broker_dir"))
         if store is None:
-            store = FileSystemBlobStore(
-                root=kw.get("store_dir") or s3_cfg.get("store_dir")
-            )
+            # same precedence: explicit store_dir kwarg > configured bucket
+            # (reference S3Storage keys) > filesystem default
+            bucket = s3_cfg.get("BUCKET_NAME") or s3_cfg.get("bucket")
+            if kw.get("store_dir"):
+                store = FileSystemBlobStore(root=kw["store_dir"])
+            elif bucket:
+                from .store import S3BlobStore
+
+                store = S3BlobStore(
+                    bucket,
+                    prefix=str(s3_cfg.get("prefix") or ""),
+                    region_name=s3_cfg.get("CN_REGION_NAME") or s3_cfg.get("region"),
+                    endpoint_url=s3_cfg.get("endpoint_url"),
+                    aws_access_key_id=(s3_cfg.get("CN_S3_AKI")
+                                       or s3_cfg.get("aws_access_key_id")),
+                    aws_secret_access_key=(s3_cfg.get("CN_S3_SAK")
+                                           or s3_cfg.get("aws_secret_access_key")),
+                )
+            else:
+                store = FileSystemBlobStore(root=s3_cfg.get("store_dir"))
         cls = (MqttS3MnnCommManager
                if backend == constants.COMM_BACKEND_MQTT_S3_MNN
                else MqttS3CommManager)

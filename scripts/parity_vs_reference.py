@@ -258,7 +258,12 @@ def main():
     import jax
 
     results = {
-        "engine_backend": jax.default_backend(),
+        "engine_backend": (
+            f"{jax.default_backend()} (jax_default_matmul_precision=highest "
+            "is pinned by the harness: TPU's default precision decomposes "
+            "f32 matmuls/convs into bf16 passes — a hardware numeric mode, "
+            "not an algorithm-semantics difference, and it drifts the CNN "
+            "case past tolerance over rounds)"),
         "basis": (
             "reference FedAvg semantics (sampling fedavg_api.py:129-143, "
             "trainer my_model_trainer_classification.py:15, aggregation "

@@ -328,3 +328,47 @@ def test_mqtt_s3_rides_blob_store_with_wire_broker_inline_small():
         client.stop_receive_message()
     finally:
         broker.close()
+
+
+def test_backend_factory_selects_s3_driver_from_config(tmp_path):
+    """A configured bucket routes the blob plane to the S3 driver (the
+    import shim makes the boto3-absent branch deterministic regardless of
+    the environment), and an explicit store_dir kwarg still wins over the
+    config bucket (user-proximate precedence)."""
+    import builtins
+    import json
+
+    import fedml_tpu
+    from fedml_tpu.comm.managers import create_comm_backend
+    from fedml_tpu.comm.store import FileSystemBlobStore
+
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text(json.dumps({
+        "mqtt_config": {"broker_dir": str(tmp_path / "broker")},
+        "s3_config": {"BUCKET_NAME": "models-bucket"},
+    }))
+    args = fedml_tpu.init(config=dict(
+        dataset="mnist", model="lr", mlops_config_path=str(cfg)))
+
+    real_import = builtins.__import__
+
+    def no_boto3(name, *a, **k):
+        if name == "boto3":
+            raise ImportError("No module named 'boto3'")
+        return real_import(name, *a, **k)
+
+    builtins.__import__ = no_boto3
+    try:
+        with pytest.raises(RuntimeError, match="boto3"):
+            create_comm_backend("MQTT_S3", rank=0, size=2, args=args)
+    finally:
+        builtins.__import__ = real_import
+
+    # explicit kwarg beats the config bucket — no S3 attempt at all
+    mgr = create_comm_backend("MQTT_S3", rank=0, size=2, args=args,
+                              store_dir=str(tmp_path / "explicit"))
+    try:
+        assert isinstance(mgr.store, FileSystemBlobStore)
+        assert mgr.store.root == str(tmp_path / "explicit")
+    finally:
+        mgr.stop_receive_message()
