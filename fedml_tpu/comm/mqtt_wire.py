@@ -24,6 +24,7 @@ is what the reference runs with too).
 
 from __future__ import annotations
 
+import queue
 import socket
 import struct
 import threading
@@ -34,6 +35,12 @@ from typing import Callable, Dict, List, Optional, Tuple
 from .pubsub import PubSubBroker
 
 Callback = Callable[[str, bytes], None]
+
+# Upper bound on one control-plane packet. MQTT's remaining-length field can
+# declare up to ~268 MB; accepting that would let a misbehaving peer force
+# huge allocations (bulk model weights ride the S3/blob plane, not MQTT), so
+# cap frames the same way trpc_backend.read_frame caps its header/payload.
+MAX_PACKET_BYTES = 8 * 1024 * 1024
 
 # packet types (MQTT 3.1.1 §2.2.1)
 CONNECT, CONNACK, PUBLISH, PUBACK = 1, 2, 3, 4
@@ -86,6 +93,10 @@ def _read_packet(sock: socket.socket) -> Tuple[int, int, bytes]:
         mult *= 128
     else:
         raise ValueError("malformed remaining length (>4 bytes)")
+    if length > MAX_PACKET_BYTES:
+        raise ValueError(
+            f"packet of {length} bytes exceeds MAX_PACKET_BYTES "
+            f"({MAX_PACKET_BYTES}); control-plane frames must stay small")
     body = _recv_exact(sock, length) if length else b""
     return ptype, flags, body
 
@@ -112,6 +123,9 @@ def topic_matches(filt: str, topic: str) -> bool:
 # --- broker ----------------------------------------------------------------
 
 class _Session:
+    # fan-out frames a slow subscriber may buffer before it is dropped
+    OUTQ_MAX = 256
+
     def __init__(self, sock: socket.socket, addr):
         self.sock = sock
         self.addr = addr
@@ -120,10 +134,51 @@ class _Session:
         self.send_lock = threading.Lock()
         self.alive = True
         self.inflight_qos2: Dict[int, Tuple[str, bytes, int]] = {}
+        # Fan-out deliveries ride a per-session queue drained by a writer
+        # thread, so one stalled subscriber (full TCP buffer) cannot block
+        # the publishing session's serve thread or delivery to later
+        # subscribers. Protocol replies (CONNACK/PUBACK/...) still use
+        # send() directly — they run on this session's own serve thread and
+        # only ever block that session.
+        self.outq: "queue.Queue[Optional[bytes]]" = queue.Queue(self.OUTQ_MAX)
+        self._writer: Optional[threading.Thread] = None
 
     def send(self, data: bytes) -> None:
         with self.send_lock:
             self.sock.sendall(data)
+
+    def start_writer(self, drop_cb) -> None:
+        def loop():
+            while True:
+                frame = self.outq.get()
+                if frame is None:
+                    return
+                try:
+                    self.send(frame)
+                except OSError:
+                    drop_cb(self)
+                    return
+
+        self._writer = threading.Thread(
+            target=loop, daemon=True, name=f"mqtt-broker-writer-{self.addr}")
+        self._writer.start()
+
+    def enqueue(self, frame: bytes) -> bool:
+        """Queue a fan-out frame; False = queue full (slow consumer)."""
+        try:
+            self.outq.put_nowait(frame)
+            return True
+        except queue.Full:
+            return False
+
+    def stop_writer(self) -> None:
+        # A full queue means the writer is wedged on a stalled peer; the
+        # socket shutdown in _drop is what actually frees it, the sentinel
+        # just lets an idle writer exit promptly.
+        try:
+            self.outq.put_nowait(None)
+        except queue.Full:
+            pass
 
 
 class MqttBroker:
@@ -154,6 +209,7 @@ class MqttBroker:
             except OSError:
                 return
             sess = _Session(sock, addr)
+            sess.start_writer(self._drop)
             with self._lock:
                 self._sessions.append(sess)
             threading.Thread(target=self._serve, args=(sess,), daemon=True,
@@ -161,9 +217,17 @@ class MqttBroker:
 
     def _drop(self, sess: _Session) -> None:
         sess.alive = False
+        sess.stop_writer()
         with self._lock:
             if sess in self._sessions:
                 self._sessions.remove(sess)
+        try:
+            # shutdown (not just close) so a writer thread blocked mid-sendall
+            # on a stalled peer is woken with an error instead of leaking —
+            # close() alone does not interrupt an in-flight blocking send
+            sess.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             sess.sock.close()
         except OSError:
@@ -263,10 +327,8 @@ class MqttBroker:
                 else:
                     frames[out_qos] = _packet(
                         PUBLISH, 0, _encode_string(topic) + payload)
-            try:
-                s.send(frames[out_qos])
-            except OSError:
-                self._drop(s)
+            if not s.enqueue(frames[out_qos]):
+                self._drop(s)  # slow consumer: full outbound queue
 
     def _on_subscribe(self, sess: _Session, body: bytes) -> None:
         (pid,) = struct.unpack_from(">H", body, 0)
@@ -284,7 +346,9 @@ class MqttBroker:
         sess.send(_packet(SUBACK, 0, struct.pack(">H", pid)
                           + bytes(q for _, q in filters)))
         for t, p in retained:  # §3.3.1.3 retained delivery on subscribe
-            sess.send(_packet(PUBLISH, 0b0001, _encode_string(t) + p))
+            if not sess.enqueue(_packet(PUBLISH, 0b0001, _encode_string(t) + p)):
+                self._drop(sess)
+                return
 
     def _on_unsubscribe(self, sess: _Session, body: bytes) -> None:
         (pid,) = struct.unpack_from(">H", body, 0)
@@ -359,8 +423,11 @@ class MqttClient:
             except OSError:
                 pass
             raise ConnectionError(self._conn_error or "CONNACK timeout")
-        self._pinger = threading.Thread(target=self._ping_loop, daemon=True)
-        self._pinger.start()
+        # §3.1.2.10: keepalive 0 turns the keepalive mechanism OFF entirely —
+        # no PINGREQs, and the broker applies no idle deadline
+        if self.keepalive > 0:
+            self._pinger = threading.Thread(target=self._ping_loop, daemon=True)
+            self._pinger.start()
 
     # -- plumbing
     def _send(self, data: bytes) -> None:
@@ -445,6 +512,8 @@ class MqttClient:
             self._dispatch_q.put(None)
 
     def _ping_loop(self) -> None:
+        if self.keepalive <= 0:
+            return  # keepalive disabled (§3.1.2.10)
         interval = max(self.keepalive / 2.0, 0.5)
         while self._running:
             time.sleep(interval)
@@ -464,6 +533,12 @@ class MqttClient:
                 "PUBREC/PUBREL leg is not implemented (module docstring)")
         flags = (qos << 1) | (1 if retain else 0)
         vh = _encode_string(topic)
+        # mirror the receive-side cap: an oversized frame would just get the
+        # connection dropped by the peer with no local diagnostic
+        if len(vh) + 2 + len(payload) > MAX_PACKET_BYTES:
+            raise ValueError(
+                f"publish of {len(payload)} bytes exceeds MAX_PACKET_BYTES "
+                f"({MAX_PACKET_BYTES}); ship bulk payloads via the blob store")
         if qos > 0:
             pid = self._pid()
             ev = threading.Event()

@@ -28,6 +28,7 @@ The reference embeds a latency micro-benchmark in the manager
 from __future__ import annotations
 
 import logging
+import math
 import socket
 import struct
 import threading
@@ -194,7 +195,11 @@ def read_frame(sock: socket.socket) -> Optional[Dict[str, Any]]:
                 raise ValueError(
                     f"frame tensor {nbytes} bytes exceeds cap {MAX_FRAME_BYTES}")
             dtype = np.dtype(_resolve_dtype(dtype_str))
-            expect = int(np.prod(shape, dtype=np.int64)) * dtype.itemsize
+            # math.prod: arbitrary-precision — np.prod(dtype=int64) wraps
+            # silently, so hostile dims whose product overflows to a small
+            # value could pass the expect==nbytes check and then blow up in
+            # np.empty outside this normalized-ValueError block
+            expect = math.prod(shape) * dtype.itemsize
             if expect != nbytes:
                 raise ValueError(
                     f"frame spec mismatch: dtype={dtype_str} "

@@ -363,27 +363,33 @@ class MLOpsRuntimeLogUploader:
         self._upload_lock = threading.Lock()  # stop()-flush vs loop thread
 
     def log_read(self):
-        """New complete lines since the byte cursor. Rotation/truncation
-        (file smaller than the cursor) resets to the file head rather than
-        stalling forever."""
+        """New complete lines since the byte cursor, as ``(lines, nbytes)``
+        where ``nbytes`` is the raw on-disk byte count consumed. The file is
+        read in binary so the cursor tracks real bytes — decoding with
+        ``errors='replace'`` happens per line for the payload only (a U+FFFD
+        re-encodes wider than the bad byte it stands for, so counting decoded
+        text would drift the cursor). Rotation/truncation (file smaller than
+        the cursor) resets to the file head rather than stalling forever."""
         try:
             size = os.path.getsize(self.log_file_path)
         except OSError:
-            return []
+            return [], 0
         if size < self._offset:
             self._offset = 0  # rotated or truncated: start over on the new file
-        with open(self.log_file_path, errors="replace") as f:
+        with open(self.log_file_path, "rb") as f:
             f.seek(self._offset)
-            lines = f.readlines()
+            raw_lines = f.readlines()
         # a partial trailing line (no newline yet) waits for the next tick
-        if lines and not lines[-1].endswith("\n"):
-            lines.pop()
-        return lines[: self.max_lines]
+        if raw_lines and not raw_lines[-1].endswith(b"\n"):
+            raw_lines.pop()
+        raw_lines = raw_lines[: self.max_lines]
+        consumed = sum(len(raw) for raw in raw_lines)
+        return [raw.decode("utf-8", errors="replace") for raw in raw_lines], consumed
 
     def log_upload(self) -> int:
         """Ship pending lines; returns how many were uploaded."""
         with self._upload_lock:
-            lines = self.log_read()
+            lines, consumed = self.log_read()
             if not lines:
                 return 0
             now = time.time()
@@ -401,8 +407,7 @@ class MLOpsRuntimeLogUploader:
                 {"Content-Type": "application/json", "Connection": "close"},
                 self.ca_path)
             # only after a successful post, so an outage replays
-            self._offset += sum(len(ln.encode("utf-8", "replace"))
-                                for ln in lines)
+            self._offset += consumed
             self.log_line_index += len(lines)
             return len(lines)
 
