@@ -44,11 +44,11 @@ def main() -> None:
     from fedml_tpu.simulation import build_simulator
 
     blocks, rounds_per_block = 5, 6
-    # Lane count pinned from an on-chip sweep (results/lane_sweep_r3.json):
-    # the G*L padded-work optimum picks 8 lanes, but per-step cost is
-    # SUPERLINEAR in lane count (per-lane weights lower to grouped convs
-    # whose thin per-group channels starve the 128-wide MXU), so 1-2 lanes
-    # measure ~10-15% faster end-to-end. Override with FEDML_BENCH_LANES.
+    # Lane count pinned from on-chip sweeps (results/lane_sweep_r4.json,
+    # superseding r3's grouped-conv theory): per-step cost scales ~linearly
+    # with lane count (~2.2 ms per lane per step — per-op latency across
+    # ~250+ small-shape ops dominates, not MXU or HBM), so few, long lanes
+    # win. Override with FEDML_BENCH_LANES.
     lanes_env = os.environ.get("FEDML_BENCH_LANES", "2")
     args = fedml_tpu.init(config=dict(
         dataset="cifar10", model="resnet56", partition_method="hetero",

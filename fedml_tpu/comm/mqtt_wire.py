@@ -123,8 +123,10 @@ def topic_matches(filt: str, topic: str) -> bool:
 # --- broker ----------------------------------------------------------------
 
 class _Session:
-    # fan-out frames a slow subscriber may buffer before it is dropped
+    # fan-out buffering bounds for a slow subscriber: frames AND bytes
+    # (a frame-count bound alone would let 256 near-cap frames pin ~2 GB)
     OUTQ_MAX = 256
+    OUTQ_MAX_BYTES = 32 * 1024 * 1024
 
     def __init__(self, sock: socket.socket, addr):
         self.sock = sock
@@ -141,6 +143,7 @@ class _Session:
         # send() directly — they run on this session's own serve thread and
         # only ever block that session.
         self.outq: "queue.Queue[Optional[bytes]]" = queue.Queue(self.OUTQ_MAX)
+        self._outq_bytes = 0          # under send_lock-free CAS via GIL ops
         self._writer: Optional[threading.Thread] = None
 
     def send(self, data: bytes) -> None:
@@ -153,6 +156,7 @@ class _Session:
                 frame = self.outq.get()
                 if frame is None:
                     return
+                self._outq_bytes -= len(frame)
                 try:
                     self.send(frame)
                 except OSError:
@@ -164,9 +168,14 @@ class _Session:
         self._writer.start()
 
     def enqueue(self, frame: bytes) -> bool:
-        """Queue a fan-out frame; False = queue full (slow consumer)."""
+        """Queue a fan-out frame; False = buffer full (slow consumer).
+        The byte bound is advisory-racy (+= after the check) but the race
+        window is one frame, not the 2 GB a count-only bound would allow."""
+        if self._outq_bytes + len(frame) > self.OUTQ_MAX_BYTES:
+            return False
         try:
             self.outq.put_nowait(frame)
+            self._outq_bytes += len(frame)
             return True
         except queue.Full:
             return False
@@ -380,8 +389,6 @@ class MqttClient:
 
     def __init__(self, host: str, port: int, client_id: Optional[str] = None,
                  keepalive: int = 60, timeout: float = 10.0):
-        import queue
-
         self.client_id = client_id or f"fedml-tpu-{uuid.uuid4().hex[:12]}"
         self.keepalive = keepalive
         self._sock = socket.create_connection((host, port), timeout=timeout)
@@ -512,8 +519,7 @@ class MqttClient:
             self._dispatch_q.put(None)
 
     def _ping_loop(self) -> None:
-        if self.keepalive <= 0:
-            return  # keepalive disabled (§3.1.2.10)
+        # only started when keepalive > 0 (§3.1.2.10: 0 = mechanism off)
         interval = max(self.keepalive / 2.0, 0.5)
         while self._running:
             time.sleep(interval)

@@ -322,6 +322,41 @@ def load_partition_data(
 
         train, test = gen_fets(n_tr, rng), gen_fets(n_te, rng)
         class_num = 4
+    elif dataset in ("chest_xray", "chexpert", "nih_chest_xray", "mimic_cxr"):
+        # medical chest-x-ray classification (reference app/fedcv/
+        # medical_chest_xray_image_clf: CheXpert/NIH/MIMIC loaders,
+        # DenseNet + CE). Zero-egress stand-in: grayscale images with
+        # class-typed opacity patterns — 0 clear, 1 focal round opacity,
+        # 2 diffuse haze, 3 bilateral streaks.
+        h = w = 32
+        # image-level labels need a real test count even in debug_small_data
+        # (8 test images would make test_acc quantized to 1/8)
+        n_tr, n_te = (max(int(2000 * scale), 128), max(int(400 * scale), 64))
+
+        def gen_cxr(n, s):
+            r = np.random.default_rng(s)
+            x = r.normal(0, 0.15, (n, h, w, 1)).astype(np.float32)
+            # lung-field vignette so images share a common anatomy prior
+            yy, xx = np.mgrid[0:h, 0:w]
+            field = np.exp(-(((yy - h / 2) / (h / 2)) ** 2
+                             + ((xx - w / 2) / (w / 2)) ** 2))
+            x += field[None, :, :, None].astype(np.float32) * 0.3
+            y = r.integers(0, 4, n).astype(np.int32)
+            for i in range(n):
+                if y[i] == 1:      # focal opacity: one bright disc
+                    cy, cx = r.integers(8, h - 8, 2)
+                    m = ((yy - cy) ** 2 + (xx - cx) ** 2) < r.integers(16, 36)
+                    x[i, :, :, 0] += m * 1.5
+                elif y[i] == 2:    # diffuse haze: low-frequency lift
+                    x[i, :, :, 0] += field * r.uniform(0.9, 1.3)
+                elif y[i] == 3:    # bilateral streaks: two vertical bands
+                    c1, c2 = r.integers(4, w // 2), r.integers(w // 2, w - 4)
+                    x[i, :, c1 - 1:c1 + 2, 0] += 1.2
+                    x[i, :, c2 - 1:c2 + 2, 0] += 1.2
+            return ArrayPair(x, y)
+
+        train, test = gen_cxr(n_tr, 43), gen_cxr(n_te, 44)
+        class_num = 4
     elif dataset in ("20news", "agnews", "text_classification"):
         # FedNLP text classification (reference app/fednlp/text_classification;
         # 20news via data/FedNLP loaders). Synthetic stand-in: class-topical
@@ -592,6 +627,81 @@ def load_partition_data(
             return ArrayPair(x, y)
 
         train, test = gen_reg(n_tr, 89), gen_reg(n_te, 90)
+        class_num = 1
+    elif dataset in ("subgraph_relation_pred", "relation_pred_synthetic"):
+        # FedGraphNN relation prediction (reference app/fedgraphnn/
+        # subgraph_relation_pred: WN18RR-style typed edges, RGCN+DistMult).
+        # Synthetic stand-in: nodes carry a latent group (one-hot in the
+        # features + noise); an edge of relation r links groups with
+        # (g_i + g_j) mod R == r. Input packs R adjacency slabs after the
+        # features: (N, F + R*N); labels over all ordered pairs with class
+        # 0 = no relation, r+1 = relation r.
+        n_nodes, n_feat, n_rel = 16, 8, 4
+        n_tr, n_te = (max(int(1600 * scale), 192), max(int(320 * scale), 64))
+
+        def gen_rel(n, s):
+            r = np.random.default_rng(s)
+            x = np.zeros((n, n_nodes, n_feat + n_rel * n_nodes), np.float32)
+            y = np.zeros((n, n_nodes * n_nodes), np.int32)
+            for i in range(n):
+                groups = r.integers(0, n_rel, n_nodes)
+                feats = 0.3 * r.normal(size=(n_nodes, n_feat))
+                feats[np.arange(n_nodes), groups] += 1.0  # group one-hot
+                rel_of_pair = (groups[:, None] + groups[None, :]) % n_rel
+                has_edge = np.triu(r.random((n_nodes, n_nodes)) < 0.35, 1)
+                has_edge = has_edge + has_edge.T
+                lab = np.where(has_edge, rel_of_pair + 1, 0)
+                adjs = np.zeros((n_rel, n_nodes, n_nodes), np.float32)
+                for rel in range(n_rel):
+                    adjs[rel] = (lab == rel + 1).astype(np.float32)
+                # observed graph hides 30% of edges; labels keep them all,
+                # so the task is genuinely predictive, not copy-through
+                hide = np.triu(r.random((n_nodes, n_nodes)) < 0.3, 1)
+                hide = hide + hide.T
+                adjs *= 1.0 - hide[None]
+                x[i, :, :n_feat] = feats
+                x[i, :, n_feat:] = adjs.transpose(1, 0, 2).reshape(
+                    n_nodes, n_rel * n_nodes)
+                y[i] = lab.reshape(-1)
+            return ArrayPair(x, y)
+
+        train, test = gen_rel(n_tr, 91), gen_rel(n_te, 92)
+        class_num = n_rel + 1
+    elif dataset in ("recsys_subgraph_link_pred", "recsys_synthetic",
+                     "ciao", "epinions"):
+        # FedGraphNN recsys subgraph link prediction (reference
+        # app/fedgraphnn/recsys_subgraph_link_pred: ciao/epinions user-item
+        # subgraphs, MSE on rating logits). Synthetic stand-in as rating-
+        # MATRIX COMPLETION: low-rank user/item factors generate ratings in
+        # [1, 5] for EVERY pair (the dense label block -> loss_kind='mse');
+        # the input graph carries only a ~30%-shown subset of rated edges,
+        # so the model must complete unseen cells from the factors, not
+        # copy them out of the adjacency.
+        n_users = n_items = 8
+        n_nodes, n_feat, k = n_users + n_items, 8, 3
+        n_tr, n_te = (max(int(1600 * scale), 192), max(int(320 * scale), 64))
+
+        def gen_recsys(n, s):
+            r = np.random.default_rng(s)
+            x = np.zeros((n, n_nodes, n_feat + n_nodes), np.float32)
+            y = np.zeros((n, n_users * n_items), np.float32)
+            for i in range(n):
+                fu = r.normal(size=(n_users, k))
+                fi = r.normal(size=(n_items, k))
+                rating = np.clip(3.0 + fu @ fi.T, 1.0, 5.0)  # (U, I)
+                shown = r.random((n_users, n_items)) < 0.3
+                a = np.zeros((n_nodes, n_nodes), np.float32)
+                a[:n_users, n_users:] = shown * rating
+                a[n_users:, :n_users] = (shown * rating).T
+                feats = 0.3 * r.normal(size=(n_nodes, n_feat))
+                feats[:n_users, :k] += fu
+                feats[n_users:, :k] += fi
+                x[i, :, :n_feat] = feats
+                x[i, :, n_feat:] = a
+                y[i] = rating.reshape(-1)
+            return ArrayPair(x, y)
+
+        train, test = gen_recsys(n_tr, 93), gen_recsys(n_te, 94)
         class_num = 1
     elif dataset in ("shakespeare", "fed_shakespeare", "stackoverflow_nwp"):
         from . import leaf

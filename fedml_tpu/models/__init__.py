@@ -37,10 +37,12 @@ from .darts import DARTSSearchNet, derive_genotype
 from .unet import UNetLite
 from .yolo import YoloLiteDetector
 from .gcn import (
+    BipartiteGCNRecommender,
     GCNGraphClassifier,
     GCNGraphRegressor,
     GCNLinkPredictor,
     GCNNodeClassifier,
+    RGCNRelationPredictor,
 )
 from .mobile import (
     MobileLeNet5,
@@ -84,8 +86,13 @@ def create(args, output_dim: int):
         # batch_stats thread through training via make_local_update and are
         # federated-averaged like every other key (fedavg_api.py:163-170).
         norm = getattr(args, "norm", "group")
+        # conv_impl: "xla" (default) | "im2col" | "pallas" — the multi-weight
+        # conv paths (ops/conv.py) for per-lane-weight execution experiments;
+        # measured on the v5e the XLA path wins at ResNet-56's shapes
+        # (results/lane_sweep_r4.json), so it stays the default
+        conv_impl = getattr(args, "conv_impl", None) or "xla"
         return CifarResNet(depth=depth, num_classes=output_dim,
-                           norm_kind=norm, dtype=dtype)
+                           norm_kind=norm, dtype=dtype, conv_impl=conv_impl)
     if model_name == "mobilenet":
         return MobileNetV1(num_classes=output_dim, dtype=dtype)
     if model_name == "mobilenet_v3":
@@ -105,10 +112,25 @@ def create(args, output_dim: int):
                             dtype=dtype)
     if model_name == "vgg11":
         return VGG(num_classes=output_dim, dtype=dtype)
+    if model_name in ("densenet", "densenet121"):
+        # medical chest-x-ray backbone (reference app/fedcv/
+        # medical_chest_xray_image_clf/model/densenet.py)
+        from .densenet import DenseNet
+
+        if model_name == "densenet121":
+            return DenseNet(num_classes=output_dim, growth=32,
+                            block_config=(6, 12, 24, 16), dtype=dtype)
+        return DenseNet(num_classes=output_dim, dtype=dtype)
     if model_name == "darts":
         return DARTSSearchNet(num_classes=output_dim, dtype=dtype)
     if model_name == "unet":
         return UNetLite(num_classes=output_dim, dtype=dtype)
+    if model_name in ("deeplabv3_plus", "deeplab"):
+        # DeepLabV3+ (reference app/fedcv/image_segmentation/model/
+        # deeplabV3_plus.py) — ASPP + low-level fusion decoder
+        from .deeplab import DeepLabV3Plus
+
+        return DeepLabV3Plus(num_classes=output_dim, dtype=dtype)
     if model_name == "yolo_lite":
         # multi-scale anchor detector (reference app/fedcv YOLOv5 class)
         return YoloLiteDetector(num_classes=output_dim, dtype=dtype)
@@ -122,6 +144,23 @@ def create(args, output_dim: int):
         return GCNNodeClassifier(
             num_classes=output_dim,
             num_nodes=int(getattr(args, "graph_num_nodes", 16) or 16),
+            dtype=dtype,
+        )
+    if model_name == "rgcn":
+        # relation-type prediction over typed edges (reference
+        # app/fedgraphnn/subgraph_relation_pred RGCN+DistMult); dataset
+        # class_num = num_relations + 1 (class 0 = no relation)
+        return RGCNRelationPredictor(
+            num_relations=max(output_dim - 1, 1),
+            num_nodes=int(getattr(args, "graph_num_nodes", 16) or 16),
+            dtype=dtype,
+        )
+    if model_name in ("gcn_recsys", "recsys_link_pred"):
+        # user-item rating completion (reference
+        # app/fedgraphnn/recsys_subgraph_link_pred, MSE on rating logits)
+        return BipartiteGCNRecommender(
+            num_users=int(getattr(args, "graph_num_users", 8) or 8),
+            num_items=int(getattr(args, "graph_num_items", 8) or 8),
             dtype=dtype,
         )
     if model_name == "gcn_link":

@@ -1,0 +1,134 @@
+"""DeepLabV3+ for federated semantic segmentation (FedSeg).
+
+Parity: reference ``app/fedcv/image_segmentation/model/deeplabV3_plus.py``
+(backbone with output-stride 16, ASPP with atrous rates (6, 12, 18) + image
+pooling, and the V3+ decoder that fuses 4x-upsampled ASPP features with
+1x1-reduced low-level backbone features). This is the architecture-class
+upgrade over ``models/unet.py``'s UNetLite.
+
+TPU-first design notes:
+- atrous convs are ``nn.Conv(kernel_dilation=r)`` — XLA lowers dilated
+  convs natively on the MXU; no im2col tricks needed at these channel
+  widths (ASPP runs at 256 channels where the MXU is well fed).
+- bilinear upsampling is ``jax.image.resize`` (static shapes, fuses fine);
+  the reference uses ``F.interpolate(align_corners=True)``.
+- GroupNorm everywhere (the standard FL norm fix — the reference uses
+  SyncBN inside silos; our SyncBN variant is available via
+  ``models/resnet.py`` but per-client GN is the right default for FedAvg).
+- output is (B, H*W, num_classes) token logits like UNetLite, so the
+  shared per-token masked CE path (``ops/losses.py``) and the packing
+  pipeline apply unchanged.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+def _gn(ch: int, dtype) -> nn.Module:
+    # largest group count <=8 that divides ch, so scaled-up base/aspp_ch
+    # values that aren't multiples of 8 still construct
+    g = next(g for g in range(min(8, ch), 0, -1) if ch % g == 0)
+    return nn.GroupNorm(num_groups=g, dtype=dtype)
+
+
+class _ResBlock(nn.Module):
+    ch: int
+    strides: int = 1
+    dilation: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        r = x
+        y = nn.Conv(self.ch, (3, 3), (self.strides, self.strides),
+                    kernel_dilation=(self.dilation, self.dilation),
+                    padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        y = nn.relu(_gn(self.ch, self.dtype)(y))
+        y = nn.Conv(self.ch, (3, 3), kernel_dilation=(self.dilation, self.dilation),
+                    padding="SAME", use_bias=False, dtype=self.dtype)(y)
+        y = _gn(self.ch, self.dtype)(y)
+        if r.shape != y.shape:
+            r = nn.Conv(self.ch, (1, 1), (self.strides, self.strides),
+                        use_bias=False, dtype=self.dtype)(r)
+            r = _gn(self.ch, self.dtype)(r)
+        return nn.relu(y + r)
+
+
+class ASPP(nn.Module):
+    """Atrous Spatial Pyramid Pooling (reference deeplabV3_plus.py ASPP:
+    1x1 branch, three atrous 3x3 branches, global image pooling; concat +
+    1x1 projection)."""
+
+    ch: int = 64
+    rates: Sequence[int] = (2, 4, 6)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        h, w = x.shape[1], x.shape[2]
+        branches = [nn.relu(_gn(self.ch, self.dtype)(
+            nn.Conv(self.ch, (1, 1), use_bias=False, dtype=self.dtype)(x)))]
+        for r in self.rates:
+            b = nn.Conv(self.ch, (3, 3), kernel_dilation=(r, r),
+                        padding="SAME", use_bias=False, dtype=self.dtype)(x)
+            branches.append(nn.relu(_gn(self.ch, self.dtype)(b)))
+        # image-level pooling branch
+        gp = jnp.mean(x, axis=(1, 2), keepdims=True)
+        gp = nn.relu(_gn(self.ch, self.dtype)(
+            nn.Conv(self.ch, (1, 1), use_bias=False, dtype=self.dtype)(gp)))
+        gp = jnp.broadcast_to(gp, (x.shape[0], h, w, self.ch))
+        y = jnp.concatenate(branches + [gp], axis=-1)
+        y = nn.Conv(self.ch, (1, 1), use_bias=False, dtype=self.dtype)(y)
+        return nn.relu(_gn(self.ch, self.dtype)(y))
+
+
+class DeepLabV3Plus(nn.Module):
+    """Compact DeepLabV3+: GN-ResNet backbone at output stride 4 for small
+    federated imagery, ASPP, and the V3+ low-level fusion decoder. The
+    reference runs OS 16 with atrous rates (6, 12, 18) on 512px inputs;
+    at 32-64px an 8x8 ASPP grid needs proportionally smaller rates —
+    scale ``aspp_rates``/``base``/``aspp_ch`` up for real-resolution
+    deployments."""
+
+    num_classes: int = 2
+    base: int = 16
+    aspp_ch: int = 64
+    aspp_rates: Sequence[int] = (2, 4, 6)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False) -> jnp.ndarray:
+        x = x.astype(self.dtype)
+        B, H, W, _ = x.shape
+        # stem + stage 1 (stride 1): low-level features for the decoder
+        y = nn.Conv(self.base, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(x)
+        y = nn.relu(_gn(self.base, self.dtype)(y))
+        y = _ResBlock(self.base, dtype=self.dtype)(y)
+        low = y                                          # H x W x base
+        # stage 2 (stride 2), stage 3 (stride 2) -> OS 4... OS 8 total
+        y = _ResBlock(self.base * 2, strides=2, dtype=self.dtype)(y)
+        y = _ResBlock(self.base * 2, dtype=self.dtype)(y)
+        y = _ResBlock(self.base * 4, strides=2, dtype=self.dtype)(y)
+        # dilated stage instead of further striding (atrous backbone tail)
+        y = _ResBlock(self.base * 4, dilation=2, dtype=self.dtype)(y)
+        y = ASPP(self.aspp_ch, rates=self.aspp_rates,
+                 dtype=self.dtype)(y)                     # H/4 x W/4
+        # decoder: upsample ASPP to low-level resolution, fuse, refine
+        y = jax.image.resize(y, (B, H, W, y.shape[-1]), "bilinear")
+        low = nn.relu(_gn(48, self.dtype)(
+            nn.Conv(48, (1, 1), use_bias=False, dtype=self.dtype)(low)))
+        y = jnp.concatenate([y, low], axis=-1)
+        y = nn.Conv(self.aspp_ch, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(y)
+        y = nn.relu(_gn(self.aspp_ch, self.dtype)(y))
+        y = nn.Conv(self.aspp_ch, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype)(y)
+        y = nn.relu(_gn(self.aspp_ch, self.dtype)(y))
+        logits = nn.Conv(self.num_classes, (1, 1), dtype=self.dtype)(y)
+        return logits.reshape(B, H * W, self.num_classes)

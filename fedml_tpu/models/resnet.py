@@ -25,7 +25,19 @@ from typing import Any, Callable
 import flax.linen as nn
 import jax.numpy as jnp
 
+from ..ops.conv import Conv as MWConv
+
 ModuleDef = Any
+
+
+def _conv(conv_impl: str, dtype):
+    """nn.Conv, or the multi-weight conv module (ops/conv.py) whose im2col/
+    pallas paths avoid XLA's grouped-conv lowering under per-lane weight
+    vmap (the packed-lane cohort executor). Both auto-name "Conv_i", so the
+    param tree is identical either way."""
+    if conv_impl == "xla":
+        return partial(nn.Conv, use_bias=False, dtype=dtype)
+    return partial(MWConv, use_bias=False, dtype=dtype, impl=conv_impl)
 
 
 SYNC_BN_AXIS = "sync_bn"
@@ -52,19 +64,21 @@ class BasicBlock(nn.Module):
     norm: ModuleDef
     strides: int = 1
     dtype: jnp.dtype = jnp.float32
+    conv_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x):
+        conv = _conv(self.conv_impl, self.dtype)
         residual = x
-        y = nn.Conv(self.filters, (3, 3), (self.strides, self.strides), padding="SAME",
-                    use_bias=False, dtype=self.dtype)(x)
+        y = conv(self.filters, (3, 3), (self.strides, self.strides),
+                 padding="SAME")(x)
         y = self.norm()(y)
         y = nn.relu(y)
-        y = nn.Conv(self.filters, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(y)
+        y = conv(self.filters, (3, 3), padding="SAME")(y)
         y = self.norm()(y)
         if residual.shape != y.shape:
-            residual = nn.Conv(self.filters, (1, 1), (self.strides, self.strides),
-                               use_bias=False, dtype=self.dtype, name="proj")(residual)
+            residual = conv(self.filters, (1, 1), (self.strides, self.strides),
+                            name="proj")(residual)
             residual = self.norm(name="proj_norm")(residual)
         return nn.relu(y + residual)
 
@@ -79,6 +93,7 @@ class CifarResNet(nn.Module):
     num_classes: int = 10
     norm_kind: str = "group"
     dtype: jnp.dtype = jnp.float32
+    conv_impl: str = "xla"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -87,13 +102,14 @@ class CifarResNet(nn.Module):
         if self.norm_kind in ("batch", "sync_batch"):
             norm = partial(norm, use_running_average=not train)
         x = x.astype(self.dtype)
-        x = nn.Conv(16, (3, 3), padding="SAME", use_bias=False, dtype=self.dtype)(x)
+        x = _conv(self.conv_impl, self.dtype)(16, (3, 3), padding="SAME")(x)
         x = norm()(x)
         x = nn.relu(x)
         for i, filters in enumerate((16, 32, 64)):
             for j in range(n):
                 strides = 2 if i > 0 and j == 0 else 1
-                x = BasicBlock(filters, norm, strides, self.dtype)(x)
+                x = BasicBlock(filters, norm, strides, self.dtype,
+                               self.conv_impl)(x)
         x = jnp.mean(x, axis=(1, 2))
         return nn.Dense(self.num_classes, dtype=self.dtype)(x)
 

@@ -720,6 +720,10 @@ class FedSimulator:
             "pos": jnp.asarray(pos_arr),
             "sic": jnp.asarray(sic),
         }
+        # introspection for tests/driver dryrun: lane grid of the last round
+        # (G is always a multiple of the mesh client axis, so per-device
+        # shards are G/axis_size lanes)
+        self._last_packed_shape = (G, L_pad)
         self.params, self.server_state, metrics_vec = self._packed_step(
             self.params, self.server_state, cohort, step_rng,
             jnp.float32(cohort_n), self._x_dev, self._y_dev,

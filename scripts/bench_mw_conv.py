@@ -1,0 +1,136 @@
+"""Microbench: multi-weight conv impls at the ResNet-56 packed-lane shapes.
+
+Measures marginal ms/step via the two-chained-scan-lengths protocol (fixed
+dispatch overhead cancels; forced np.asarray readback — block_until_ready is
+unreliable on the tunneled chip). Writes results/mw_conv_bench.json.
+
+Run alone on the real chip: `python scripts/bench_mw_conv.py` (default env
+dials the axon TPU; do not run concurrently with any other JAX process).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, ".")
+from fedml_tpu.ops.conv import conv2d_im2col, conv2d_pallas  # noqa: E402
+
+L, B = 6, 64          # lanes x per-lane batch (lane_sweep_r3 configuration)
+STAGES = [(32, 32, 16), (16, 16, 64), (8, 8, 128)]
+N1, N2 = 10, 510   # 500-step delta: tunnel jitter (±30-60 ms/invocation)
+                   # needs ≥50 ms of marginal compute to resolve sub-0.1ms ops
+DTYPE = jnp.bfloat16
+
+
+def run_case(make_step, init_carry, flops_per_step):
+    """Returns marginal seconds/step and TFLOP/s.
+
+    The loop returns a device-computed SCALAR — the readback that forces
+    retirement must be 4 bytes, not the full carry (a multi-MB tunnel
+    transfer whose jitter would swamp the marginal)."""
+    results = {}
+    for n in (N1, N2):
+        def loop(carry):
+            def body(c, _):
+                return make_step(c), None
+            c, _ = jax.lax.scan(body, carry, None, length=n)
+            leaves = jax.tree_util.tree_leaves(c)
+            return sum(l.astype(jnp.float32).sum() for l in leaves)
+        loop_j = jax.jit(loop)
+        float(loop_j(init_carry))            # compile + warm
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            float(loop_j(init_carry))        # scalar readback retires all
+            ts.append(time.perf_counter() - t0)
+        results[n] = min(ts)
+    marginal = (results[N2] - results[N1]) / (N2 - N1)
+    return marginal, flops_per_step / marginal / 1e12
+
+
+def conv_xla(x, w):
+    return jax.lax.conv_general_dilated(
+        x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def main():
+    print("devices:", jax.devices())
+    out = {"config": {"L": L, "B": B, "dtype": "bf16", "protocol":
+           f"marginal from scan lengths {N1}/{N2}, min of 3, forced readback"},
+           "cases": {}}
+
+    for (h, w, c) in STAGES:
+        key = f"{h}x{w}x{c}"
+        out["cases"][key] = {}
+        rng = np.random.RandomState(0)
+        xs = jnp.asarray(rng.randn(L, B, h, w, c), DTYPE) * 0.1
+        ws = jnp.asarray(rng.randn(L, 3, 3, c, c), DTYPE) * 0.05
+        x1 = xs.reshape(L * B, h, w, c)
+        w1 = ws[0]
+        # FLOPs: fwd = 2*M*K*N per lane; fwd+bwd = 3x
+        flops_fwd = 2 * (L * B * h * w) * (9 * c) * c
+
+        # ---- forward-only ----
+        fwd_impls = {
+            "shared_xla": (lambda xc: (conv_xla(xc, w1) * 0.1).astype(DTYPE), x1),
+            "vmap_xla_grouped": (lambda xc: (jax.vmap(conv_xla)(xc, ws) * 0.1).astype(DTYPE), xs),
+            "vmap_im2col": (lambda xc: (jax.vmap(
+                functools.partial(conv2d_im2col, stride=1, padding="SAME"))(xc, ws) * 0.1).astype(DTYPE), xs),
+            "vmap_pallas": (lambda xc: (jax.vmap(
+                functools.partial(conv2d_pallas, stride=1, padding="SAME"))(xc, ws) * 0.1).astype(DTYPE), xs),
+        }
+        for name, (step, init) in fwd_impls.items():
+            try:
+                m, tf = run_case(step, init, flops_fwd)
+                out["cases"][key][f"fwd_{name}"] = {
+                    "ms_per_step": round(m * 1e3, 4), "tflops": round(tf, 2)}
+                print(f"{key} fwd {name}: {m*1e3:.3f} ms  {tf:.1f} TF/s", flush=True)
+            except Exception as e:
+                out["cases"][key][f"fwd_{name}"] = {"error": repr(e)[:300]}
+                print(f"{key} fwd {name}: FAILED {repr(e)[:200]}", flush=True)
+
+        # ---- fwd+bwd (x and w grads; carry both to chain iterations) ----
+        def make_train(conv_fn, vmapped):
+            def loss(xc, wc):
+                y = (jax.vmap(conv_fn)(xc, wc) if vmapped else conv_fn(xc, wc))
+                return (y.astype(jnp.float32) ** 2).mean()
+
+            def step(carry):
+                xc, wc = carry
+                dx, dw = jax.grad(loss, argnums=(0, 1))(xc, wc)
+                return ((xc + dx.astype(DTYPE) * 0.01).astype(DTYPE),
+                        (wc - dw.astype(DTYPE) * 0.01).astype(DTYPE))
+            return step
+
+        bwd_impls = {
+            "shared_xla": (make_train(conv_xla, False), (x1, w1)),
+            "vmap_xla_grouped": (make_train(conv_xla, True), (xs, ws)),
+            "vmap_im2col": (make_train(
+                functools.partial(conv2d_im2col, stride=1, padding="SAME"), True), (xs, ws)),
+            "vmap_pallas": (make_train(
+                functools.partial(conv2d_pallas, stride=1, padding="SAME"), True), (xs, ws)),
+        }
+        for name, (step, init) in bwd_impls.items():
+            try:
+                m, tf = run_case(step, init, 3 * flops_fwd)
+                out["cases"][key][f"train_{name}"] = {
+                    "ms_per_step": round(m * 1e3, 4), "tflops": round(tf, 2)}
+                print(f"{key} train {name}: {m*1e3:.3f} ms  {tf:.1f} TF/s", flush=True)
+            except Exception as e:
+                out["cases"][key][f"train_{name}"] = {"error": repr(e)[:300]}
+                print(f"{key} train {name}: FAILED {repr(e)[:200]}", flush=True)
+
+    with open("results/mw_conv_bench.json", "w") as f:
+        json.dump(out, f, indent=1)
+    print("wrote results/mw_conv_bench.json")
+
+
+if __name__ == "__main__":
+    main()

@@ -138,6 +138,36 @@ def test_packed_on_mesh_matches_sp():
     assert np.isfinite(hist_m[-1]["test_acc"])
 
 
+@pytest.mark.slow
+def test_packed_mesh_size_sweep_matches_sp():
+    """VERDICT r3 #6: the packed path must compose at EVERY mesh size, with
+    per-device lane shards scaling as devices grow — 2/4/8-device meshes
+    all reproduce the SP result, and the lane grid G divides by the axis
+    size (so each device owns G/axis lanes)."""
+    from fedml_tpu.parallel import AXIS_CLIENT, MeshConfig, create_mesh
+
+    args_s = _args(cohort_schedule="packed")
+    sim_s, apply_s = build_simulator(args_s)
+    sim_s.run(apply_s, log_fn=None)
+    ref = _flat(sim_s.params)
+
+    shard_lanes = {}
+    for n in (2, 4, 8):
+        mesh = create_mesh(MeshConfig(axes=((AXIS_CLIENT, n),)),
+                           devices=jax.devices()[:n])
+        args_m = _args(cohort_schedule="packed")
+        sim_m, apply_m = build_simulator(args_m, mesh=mesh)
+        assert sim_m._packed
+        sim_m.run(apply_m, log_fn=None)
+        np.testing.assert_allclose(ref, _flat(sim_m.params),
+                                   rtol=2e-4, atol=2e-6)
+        g, _ = sim_m._last_packed_shape
+        assert g % n == 0, f"lane grid G={g} must divide mesh size {n}"
+        shard_lanes[n] = g // n
+    # per-device share shrinks (or stays) as the mesh grows
+    assert shard_lanes[2] >= shard_lanes[4] >= shard_lanes[8] >= 1
+
+
 def test_packed_with_momentum_and_prox():
     """Optimizer state reset at client boundaries: momentum must not leak
     across clients — parity vs the even path proves the reset is right."""
