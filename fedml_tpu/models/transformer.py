@@ -50,6 +50,7 @@ class SelfAttention(nn.Module):
     seq_axis: Optional[str] = None
     mesh: Optional[object] = None
     sp_impl: str = "ring"
+    attn_impl: Optional[str] = None   # None = memory-aware auto (ops/attention)
 
     @nn.compact
     def __call__(self, x):
@@ -86,7 +87,8 @@ class SelfAttention(nn.Module):
                 check_vma=False,
             )(q, k, v)
         else:
-            out = multihead_attention(q, k, v, causal=self.causal)
+            out = multihead_attention(q, k, v, causal=self.causal,
+                                      impl=self.attn_impl)
         out = out.reshape(B, T, D)
         return nn.Dense(self.dim, use_bias=False, dtype=self.dtype, name="proj")(out)
 
@@ -99,12 +101,14 @@ class Block(nn.Module):
     seq_axis: Optional[str] = None
     mesh: Optional[object] = None
     sp_impl: str = "ring"
+    attn_impl: Optional[str] = None
 
     @nn.compact
     def __call__(self, x):
         x = x + SelfAttention(
             self.dim, self.num_heads, self.causal, self.dtype,
             seq_axis=self.seq_axis, mesh=self.mesh, sp_impl=self.sp_impl,
+            attn_impl=self.attn_impl,
         )(nn.LayerNorm(dtype=self.dtype)(x))
         x = x + MLPBlock(self.dim, dtype=self.dtype)(nn.LayerNorm(dtype=self.dtype)(x))
         return x
@@ -122,20 +126,31 @@ class TransformerLM(nn.Module):
     seq_axis: Optional[str] = None
     mesh: Optional[object] = None
     sp_impl: str = "ring"
+    attn_impl: Optional[str] = None
+    remat: bool = False   # rematerialize blocks in bwd: activation HBM ->
+                          # O(1) per layer at ~1.3x fwd FLOPs (jax.checkpoint)
 
     @nn.compact
-    def __call__(self, tokens, train: bool = False):
+    def __call__(self, tokens, train: bool = False,
+                 return_hidden: bool = False):
         B, T = tokens.shape
         h = nn.Embed(self.vocab_size, self.dim, dtype=self.dtype, name="wte")(tokens)
         pos = nn.Embed(self.max_len, self.dim, dtype=self.dtype, name="wpe")(
             jnp.arange(T)[None, :]
         )
         h = h + pos
+        block_cls = nn.remat(Block) if self.remat else Block
         for i in range(self.num_layers):
-            h = Block(self.dim, self.num_heads, causal=True, dtype=self.dtype,
-                      seq_axis=self.seq_axis, mesh=self.mesh,
-                      sp_impl=self.sp_impl, name=f"block_{i}")(h)
+            h = block_cls(self.dim, self.num_heads, causal=True, dtype=self.dtype,
+                          seq_axis=self.seq_axis, mesh=self.mesh,
+                          sp_impl=self.sp_impl, attn_impl=self.attn_impl,
+                          name=f"block_{i}")(h)
         h = nn.LayerNorm(dtype=self.dtype, name="ln_f")(h)
+        if return_hidden:
+            # for chunked-CE training (ops/losses.chunked_lm_cross_entropy):
+            # the caller applies the head per sequence chunk so the full
+            # (B, T, V) logits never materialize
+            return h
         return nn.Dense(self.vocab_size, use_bias=False, dtype=self.dtype, name="head")(h)
 
 

@@ -19,6 +19,30 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def auto_attention_impl(B: int, H: int, T: int, Dh: int,
+                        itemsize: int = 2) -> str:
+    """Pick 'flash' vs 'dense' for (B, T, H, Dh) attention.
+
+    Speed: measured crossover (results/flash_attention_bench.json) — XLA's
+    fused dense attention holds a slight edge below T=4096 on the v5e
+    (0.88-0.99x); from 4096 the K-blocked kernel wins 2x+ and is the only
+    option once (T, T) logits stop fitting in HBM.
+
+    Memory: BELOW the speed crossover, dense training saves the
+    (B, H, T, T) probabilities for the backward pass PER LAYER — a
+    12-layer stack at B=16 H=16 T=2048 pins 26 GB. Prefer flash whenever
+    one layer's saved tensor crosses 512 MB (a meaningful slice of 16 GB
+    HBM once multiplied by typical depths).
+    """
+    from .pallas import flash_shapes_ok
+
+    dense_saved_bytes = B * H * T * T * itemsize
+    want_flash = T >= 4096 or dense_saved_bytes > 512 * 1024**2
+    if want_flash and flash_shapes_ok(T, Dh, itemsize=itemsize):
+        return "flash"
+    return "dense"
+
+
 def multihead_attention(
     q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False,
     impl: Optional[str] = None,
@@ -30,29 +54,24 @@ def multihead_attention(
     """
     T, Dh = q.shape[1], q.shape[-1]
     if impl is None:
-        from .pallas import flash_shapes_ok
-
         itemsize = jnp.dtype(q.dtype).itemsize
-        # measured crossover (results/flash_attention_bench.json): XLA's
-        # fused dense attention holds a slight edge below T=4096 on the
-        # v5e (0.88-0.99x); from 4096 the K-blocked kernel wins 2x+ and is
-        # the only option once (T,T) logits stop fitting in HBM
-        impl = ("flash" if T >= 4096 and flash_shapes_ok(T, Dh, itemsize=itemsize)
-                else "dense")
-        if impl == "dense" and T >= 8192:
-            # loud, not silent: dense materializes O(T^2) f32 logits — at
-            # these lengths that's an HBM blowup surfacing as a generic
-            # allocation error. Flash was refused (untileable T or
-            # lane-unfriendly Dh); point at the fix.
+        impl = auto_attention_impl(q.shape[0], q.shape[2], T, Dh, itemsize)
+        saved_gb = q.shape[0] * q.shape[2] * T * T * itemsize / 2**30
+        if impl == "dense" and (T >= 8192 or saved_gb > 0.5):
+            # loud, not silent: dense wanted flash (long T, or the
+            # per-layer saved probabilities alone cross the memory
+            # threshold) but flash was refused (untileable T or
+            # lane-unfriendly Dh) — the failure will surface later as a
+            # generic HBM allocation error; point at the fix NOW.
             import logging
 
             logging.warning(
                 "attention auto-dispatch: falling back to DENSE O(T^2) "
                 "attention at T=%d (flash needs T tileable by 128-blocks "
                 "and Dh in {64, k*128}; got Dh=%d) — expect ~%.1f GB of "
-                "logits; pad T to a tileable length or shard the sequence "
-                "with ring/ulysses attention", T, Dh,
-                q.shape[0] * q.shape[2] * T * T * 4 / 2**30)
+                "saved probabilities PER LAYER; pad T/Dh to tileable "
+                "sizes or shard the sequence with ring/ulysses attention",
+                T, Dh, saved_gb)
     if impl == "flash":
         from .pallas import flash_attention
 

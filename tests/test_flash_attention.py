@@ -69,6 +69,19 @@ def test_flash_gradients_long_context_T1024():
     assert "1024x1024" in dense_hlo
 
 
+def test_auto_impl_memory_aware():
+    """Dispatch goes flash below the T=4096 speed crossover whenever one
+    layer's saved dense probabilities would cross 512 MB (the MFU-bench
+    lesson: 12 layers x 2.15 GB of probs at B=16 H=16 T=2048 = 26 GB)."""
+    from fedml_tpu.ops.attention import auto_attention_impl
+
+    assert auto_attention_impl(4, 8, 2048, 64) == "dense"    # 268 MB: speed
+    assert auto_attention_impl(16, 16, 2048, 64) == "flash"  # 2.1 GB/layer
+    assert auto_attention_impl(1, 1, 8192, 64) == "flash"    # past crossover
+    # memory wants flash but shapes refuse (lane-hostile Dh) -> dense
+    assert auto_attention_impl(16, 16, 2048, 48) == "dense"
+
+
 def test_auto_dispatch_guard():
     assert flash_shapes_ok(256, 64)
     assert flash_shapes_ok(1024, 128)
