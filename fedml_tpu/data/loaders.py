@@ -380,8 +380,11 @@ def load_partition_data(
 
         train, test = gen_text(n_tr, 52), gen_text(n_te, 53)
         class_num = n_cls
-    elif dataset in ("moleculenet", "graph_synthetic"):
-        # FedGraphNN molecule-property stand-in: fixed-size graphs packed as
+    elif dataset in ("moleculenet", "graph_synthetic",
+                     "social_networks_graph_clf"):
+        # FedGraphNN graph-classification families (reference
+        # app/fedgraphnn/{moleculenet_graph_clf,social_networks_graph_clf}
+        # — same task type, different corpora): fixed-size graphs packed as
         # [features | adjacency] (models/gcn.py); label depends on a motif
         # (triangle density) so there is graph structure to learn
         n_nodes, n_feat = 16, 8
@@ -565,7 +568,8 @@ def load_partition_data(
 
         train, test = gen_node(n_tr, 85), gen_node(n_te, 86)
         class_num = 2
-    elif dataset in ("ego_networks_link_pred", "link_pred_synthetic"):
+    elif dataset in ("ego_networks_link_pred", "link_pred_synthetic",
+                     "subgraph_link_pred"):
         # FedGraphNN link-level tasks (reference app/fedgraphnn/
         # ego_networks_link_pred, subgraph_link_pred): 2-community graphs,
         # 30% of edges hidden from the input; labels = the FULL adjacency

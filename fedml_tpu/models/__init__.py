@@ -80,7 +80,8 @@ def create(args, output_dim: int):
         return CNNOriginalFedAvg(num_classes=output_dim, dtype=dtype)
     if model_name == "resnet18_gn":
         return ResNet18(num_classes=output_dim, norm_kind="group", dtype=dtype)
-    if model_name in ("resnet56", "resnet20"):
+    if model_name in ("resnet56", "resnet20", "resnet8"):
+        # 6n+2 CIFAR family; resnet8 (n=1) exists for fast BN-path tests
         depth = int(model_name.replace("resnet", ""))
         # 'batch' matches the reference flagship resnet56 (model/cv/resnet.py:303);
         # batch_stats thread through training via make_local_update and are

@@ -26,7 +26,17 @@ sys.path.insert(0, ".")
 from fedml_tpu.ops.attention import multihead_attention  # noqa: E402
 from fedml_tpu.ops.pallas.flash_attention import flash_attention  # noqa: E402
 
-N1, N2 = 2, 12
+N1, N2 = 2, 22
+PEAK_TF = 400e12   # measured dense-matmul ceiling: plausibility floor for
+                   # marginals (tunnel noise can produce negative/absurd
+                   # values; a marginal below 25% of the at-peak time for
+                   # the op's FLOPs is physically impossible -> rejected)
+
+
+def attn_train_flops(T, B, H, Dh=64, causal=True):
+    # QK^T + AV fwd (x2 matmuls), ~2x more in bwd; causal halves T^2
+    per = 2 * 2 * B * H * (T * T / (2 if causal else 1)) * Dh
+    return 3 * per
 
 
 def timed_train(fn, q, k, v):
@@ -72,6 +82,16 @@ def main():
         q, k, v = qkv(T, B, H)
         pt = {"T": T, "B": B, "H": H}
         m = timed_train(lambda q, k, v: flash_attention(q, k, v, causal=True), q, k, v)
+        # no dense comparator exists here to derive a floor from, so use
+        # the FLOPs-based one: a marginal under 25% of the at-peak time is
+        # tunnel noise, not a measurement
+        floor_lc = 0.25 * attn_train_flops(T, B, H) / PEAK_TF
+        if m < floor_lc:
+            pt["flash_train"] = (f"rejected: marginal {m*1e3:.2f} ms below "
+                                 f"plausibility floor {floor_lc*1e3:.2f} ms")
+            print(pt, flush=True)
+            long_pts.append(pt)
+            continue
         pt["flash_train_ms"] = round(m * 1e3, 2)
         try:
             md = timed_train(lambda q, k, v: multihead_attention(
@@ -94,8 +114,13 @@ def main():
     q, k, v = qkv(T, B, H)
     md = timed_train(lambda q, k, v: multihead_attention(
         q, k, v, causal=True, impl="dense"), q, k, v)
-    sweep = {"dense_train_ms": round(md * 1e3, 2), "grid": []}
-    best = None
+    # flash does the same matmul FLOPs as dense and saves only O(T^2) HBM
+    # traffic, so >4x-than-dense readings are physically impossible here —
+    # tunnel-noise flukes, rejected
+    floor = md / 4
+    sweep = {"dense_train_ms": round(md * 1e3, 2), "grid": [],
+             "plausibility_floor_ms": round(floor * 1e3, 3)}
+    cands = []
     for bq in (128, 256, 512, 1024, 2048):
         for bk in (128, 256, 512, 1024, 2048):
             try:
@@ -104,18 +129,36 @@ def main():
                 rec = {"block_q": bq, "block_k": bk,
                        "train_ms": round(m * 1e3, 2),
                        "vs_dense": round(md / m, 2)}
+                if m < floor:
+                    rec["rejected"] = "below plausibility floor (noise)"
+                else:
+                    cands.append((m, bq, bk))
                 sweep["grid"].append(rec)
-                if best is None or m < best[0]:
-                    best = (m, bq, bk)
                 print(rec, flush=True)
             except Exception as e:
                 sweep["grid"].append({"block_q": bq, "block_k": bk,
                                       "error": repr(e)[:120]})
                 print(f"bq={bq} bk={bk} FAIL", flush=True)
-    if best is not None:
-        sweep["best"] = {"block_q": best[1], "block_k": best[2],
-                         "train_ms": round(best[0] * 1e3, 2),
-                         "vs_dense": round(md / best[0], 2)}
+    # single sweep passes are still noisy: re-measure the 4 fastest
+    # plausible candidates twice more and rank by median of 3
+    finals = []
+    for m0, bq, bk in sorted(cands)[:4]:
+        ms = [m0]
+        for _ in range(2):
+            ms.append(timed_train(lambda q, k, v: flash_attention(
+                q, k, v, causal=True, block_q=bq, block_k=bk), q, k, v))
+        med = sorted(ms)[1]
+        if med < floor:   # the floor applies to re-measures too
+            print(f"re-measure bq={bq} bk={bk}: median {med*1e3:.2f} ms "
+                  "below plausibility floor, rejected", flush=True)
+            continue
+        finals.append({"block_q": bq, "block_k": bk,
+                       "train_ms_median3": round(med * 1e3, 2),
+                       "vs_dense": round(md / med, 2)})
+        print("re-measure:", finals[-1], flush=True)
+    if finals:
+        sweep["best"] = min(finals, key=lambda r: r["train_ms_median3"])
+        sweep["finalists"] = finals
     out["t2048_block_sweep"] = sweep
     print("best @2048:", sweep.get("best"), flush=True)
 

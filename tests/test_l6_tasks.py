@@ -146,6 +146,23 @@ def test_fedgraphnn_recsys_rating_completion_learns():
     assert hist[-1]["test_loss"] < 0.8, hist[-1]
 
 
+def test_all_reference_fedgraphnn_dirs_have_dataset_aliases():
+    """Every task directory under the reference app/fedgraphnn tree must
+    resolve through data.load (capability-parity check, VERDICT r3 #5)."""
+    from fedml_tpu import data as data_mod
+
+    for name in ("moleculenet", "moleculenet_reg", "ego_networks_node_clf",
+                 "ego_networks_link_pred", "subgraph_link_pred",
+                 "social_networks_graph_clf", "subgraph_relation_pred",
+                 "recsys_subgraph_link_pred"):
+        args = fedml_tpu.init(config=dict(
+            dataset=name, model="gcn", debug_small_data=True,
+            client_num_in_total=2, client_num_per_round=2, comm_round=1,
+            partition_method="homo", batch_size=8, random_seed=0))
+        fed, class_num = data_mod.load(args)
+        assert class_num >= 1 and len(fed.train_data_local_dict) == 2, name
+
+
 def test_regression_float_labels_survive_packing():
     """Float regression targets must not be truncated to ints anywhere in
     the packing path (ADVICE r1: native pack int32 cast)."""

@@ -55,7 +55,7 @@ def test_batchnorm_resnet_trains_and_averages_stats():
     import jax
 
     args = fedml_tpu.init(config=dict(
-        dataset="cifar10", model="resnet20", norm="batch",
+        dataset="cifar10", model="resnet8", norm="batch",
         debug_small_data=True, client_num_in_total=4, client_num_per_round=2,
         comm_round=2, learning_rate=0.05, epochs=1, batch_size=8,
         frequency_of_the_test=1, random_seed=0,
@@ -85,7 +85,7 @@ def test_batchnorm_fedopt_splits_server_update():
     import jax
 
     args = fedml_tpu.init(config=dict(
-        dataset="cifar10", model="resnet20", norm="batch",
+        dataset="cifar10", model="resnet8", norm="batch",
         federated_optimizer="FedOpt", server_optimizer="adam", server_lr=0.1,
         debug_small_data=True, client_num_in_total=4, client_num_per_round=2,
         comm_round=3, learning_rate=0.05, epochs=1, batch_size=8,
