@@ -132,6 +132,12 @@ def create(args, output_dim: int):
         from .deeplab import DeepLabV3Plus
 
         return DeepLabV3Plus(num_classes=output_dim, dtype=dtype)
+    if model_name == "transunet":
+        # TransUNet (reference app/fedcv/image_segmentation/model/
+        # transunet/transunet.py) — CNN encoder + ViT bottleneck + decoder
+        from .transunet import TransUNet
+
+        return TransUNet(num_classes=output_dim, dtype=dtype)
     if model_name == "yolo_lite":
         # multi-scale anchor detector (reference app/fedcv YOLOv5 class)
         return YoloLiteDetector(num_classes=output_dim, dtype=dtype)

@@ -46,6 +46,10 @@ class DistTrainConfig:
     lr: float = 3e-4
     weight_decay: float = 0.01
     use_remat: bool = True   # jax.checkpoint the blocks: FLOPs for HBM
+    # chunked LM cross-entropy (ops/losses.chunked_lm_cross_entropy):
+    # never materializes the (B, T, V) f32 logits — the large-vocab HBM
+    # hog. 0 disables; otherwise the sequence-chunk size.
+    ce_chunk: int = 0
     # sequence-parallel collective pattern: "ring" (ppermute blockwise,
     # O(T/sp) memory) or "ulysses" (all-to-all seq<->heads re-shard,
     # full-sequence flash-eligible attention; heads % sp == 0)
@@ -110,6 +114,10 @@ class DistributedLMTrainer:
             seq_axis=AXIS_SEQ if cfg.sp > 1 else None,
             mesh=self.mesh if cfg.sp > 1 else None,
             sp_impl=cfg.sp_impl,
+            # per-block remat: O(1) layers of activations alive in bwd —
+            # strictly better than checkpointing the whole apply (which
+            # still holds every layer alive during the recompute)
+            remat=cfg.use_remat,
         )
         # init on host with a tiny batch, then place with TP shardings; the
         # init token length must divide by sp (ring attention shards T)
@@ -131,13 +139,18 @@ class DistributedLMTrainer:
     def _build_train_step(self) -> Callable:
         model = self.model
         opt = self.opt
-        use_remat = self.cfg.use_remat
+        ce_chunk = self.cfg.ce_chunk
 
         def loss_fn(params, tokens, targets):
-            apply = model.apply
-            if use_remat:
-                apply = jax.checkpoint(model.apply)
-            logits = apply(params, tokens)
+            # block-level remat is baked into the model (cfg.use_remat)
+            if ce_chunk:
+                from ..ops.losses import chunked_lm_cross_entropy
+
+                hid = model.apply(params, tokens, return_hidden=True)
+                head = params["params"]["head"]["kernel"].astype(hid.dtype)
+                return chunked_lm_cross_entropy(hid, head, targets,
+                                                chunk=ce_chunk)
+            logits = model.apply(params, tokens)
             logz = jax.nn.log_softmax(logits.astype(jnp.float32))
             ll = jnp.take_along_axis(logz, targets[..., None], -1)[..., 0]
             return -ll.mean()

@@ -153,6 +153,21 @@ def test_fednas_darts_search_runs():
 
 
 @pytest.mark.slow
+def test_fedseg_transunet_learns():
+    """TransUNet (reference app/fedcv/image_segmentation/model/transunet):
+    CNN encoder + ViT bottleneck must train federated and segment."""
+    args = fedml_tpu.init(config=dict(
+        dataset="seg_synthetic", model="transunet", debug_small_data=True,
+        client_num_in_total=2, client_num_per_round=2, comm_round=3,
+        partition_method="homo", learning_rate=0.05, batch_size=8,
+        frequency_of_the_test=3, random_seed=0))
+    sim, apply_fn = build_simulator(args)
+    hist = sim.run(apply_fn, log_fn=None)
+    assert hist[0]["train_loss"] > hist[-1]["train_loss"]
+    assert hist[-1]["test_acc"] > 0.9, hist[-1]
+
+
+@pytest.mark.slow
 def test_fedseg_deeplab_learns_and_beats_unet_control():
     """DeepLabV3+ (reference app/fedcv/image_segmentation/model/
     deeplabV3_plus.py) trains federated, learns, and — VERDICT r3 #4 —

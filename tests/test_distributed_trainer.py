@@ -48,6 +48,20 @@ def test_distributed_lm_trains(dp, tp, sp):
     assert losses[-1] < losses[0] * 0.7, (losses[0], losses[-1])
 
 
+def test_distributed_lm_chunked_ce_matches_full():
+    """ce_chunk must be a pure memory lever: same loss trajectory as the
+    full-logit CE under a tp-sharded head (vocab-sharded chunk logits +
+    log-softmax collective compose under GSPMD)."""
+    vocab, B, T = 32, 8, 16
+    losses = {}
+    for chunk in (0, 8):
+        cfg = DistTrainConfig(dp=4, tp=2, sp=1, lr=1e-2, ce_chunk=chunk)
+        tr = DistributedLMTrainer(cfg, vocab_size=vocab, dim=64, num_heads=4,
+                                  num_layers=2, max_len=T, dtype=jnp.float32)
+        losses[chunk] = tr.train(_toy_data(vocab, B, T), steps=10, log_fn=None)
+    np.testing.assert_allclose(losses[0], losses[8], rtol=1e-4, atol=1e-5)
+
+
 def test_ring_attention_matches_dense():
     """SP ring attention must equal dense attention numerically."""
     from jax.sharding import PartitionSpec as P
