@@ -31,10 +31,23 @@ if __name__ == "__main__":
     p.add_argument("--layers", type=int, default=8)
     p.add_argument("--seq_len", type=int, default=2048)
     p.add_argument("--batch", type=int, default=8)
+    # memory levers (see results/lm_mfu_bench.json for their measured
+    # effect): per-block remat and chunked cross-entropy
+    p.add_argument("--no_remat", action="store_true")
+    p.add_argument("--ce_chunk", type=int, default=256,
+                   help="0 = full-logit CE; else sequence-chunk size "
+                        "(seq_len must be divisible by it)")
     a = p.parse_args()
+    if a.ce_chunk and a.seq_len % a.ce_chunk:
+        # fall back rather than crash on the first step: chunked CE needs
+        # seq_len % chunk == 0
+        print(f"seq_len {a.seq_len} not divisible by ce_chunk {a.ce_chunk}; "
+              "using full-logit CE")
+        a.ce_chunk = 0
 
     trainer = DistributedLMTrainer(
-        DistTrainConfig(dp=a.dp, tp=a.tp, sp=a.sp, lr=3e-4),
+        DistTrainConfig(dp=a.dp, tp=a.tp, sp=a.sp, lr=3e-4,
+                        use_remat=not a.no_remat, ce_chunk=a.ce_chunk),
         vocab_size=32000, dim=a.dim, num_heads=8, num_layers=a.layers,
         max_len=a.seq_len,
     )

@@ -77,6 +77,13 @@ class TransUNet(nn.Module):
         tokens = y.reshape(B, h * w, self.trans_dim)
         pos = self.param("pos_embed", nn.initializers.normal(0.02),
                          (1, h * w, self.trans_dim), jnp.float32)
+        if pos.shape[1] != h * w:
+            raise ValueError(
+                f"TransUNet pos_embed was initialized for {pos.shape[1]} "
+                f"tokens but this input yields {h * w} (input {H}x{W}): "
+                "unlike the fully-convolutional DeepLabV3+, TransUNet "
+                "params are resolution-bound — re-init or interpolate "
+                "pos_embed for the new resolution")
         tokens = tokens + pos.astype(self.dtype)
         for i in range(self.trans_layers):
             tokens = Block(self.trans_dim, self.trans_heads, causal=False,

@@ -38,7 +38,6 @@ from typing import Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from flax import linen as nn
 
 # --- pure-JAX im2col ------------------------------------------------------

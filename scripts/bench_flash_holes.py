@@ -167,32 +167,40 @@ def main():
     # always produces a self-consistent artifact
     interp = []
     for pt in long_pts:
+        flash_desc = (f"flash {pt['flash_train_ms']} ms"
+                      if "flash_train_ms" in pt
+                      else f"flash reading {pt.get('flash_train', 'absent')}")
         if "speedup" in pt:
             interp.append(
                 f"T={pt['T']} fwd+bwd: flash {pt['speedup']}x dense "
                 f"({pt['flash_train_ms']} vs {pt['dense_train_ms']} ms at "
-                f"B{pt['B']}H{pt['H']}); magnitude drifts with tunnel load "
-                "across runs (2.9-7.2x observed), direction robust.")
-        elif "dense_train" in pt and "flash_train_ms" in pt:
+                f"B{pt['B']}H{pt['H']}); single-run magnitude — tunnel "
+                "load drifts cross-run readings, direction is the claim.")
+        elif "dense_train_ms" in pt:
             interp.append(
-                f"T={pt['T']} fwd+bwd: flash {pt['flash_train_ms']} ms; "
-                "dense memory-infeasible (compile OOM recorded; bf16 "
-                f"logits alone are {pt['B']*pt['H']*pt['T']**2*2/2**30:.1f} "
-                "GB plus backward copies vs 15.75 GB HBM).")
+                f"T={pt['T']} fwd+bwd: dense {pt['dense_train_ms']} ms; "
+                f"{flash_desc}.")
+        else:
+            # dense raised: report the recorded error verbatim — it may be
+            # a memory-infeasibility (expected at 32k: bf16 logits alone
+            # are B*H*T^2*2 bytes vs 15.75 GB HBM) or a transient tunnel
+            # failure; the raw record distinguishes them
+            interp.append(
+                f"T={pt['T']} fwd+bwd: {flash_desc}; dense comparator "
+                f"unavailable this run ({pt.get('dense_train', '?')[:80]}).")
     if sweep.get("best"):
         interp.append(
             f"T=2048: best plausible blocks {sweep['best']['block_q']}/"
             f"{sweep['best']['block_k']} measure {sweep['best']['vs_dense']}x "
-            "dense (median of 3). The r3 '0.88x flash' reading does not "
-            "reproduce under the corrected protocol: dense itself drifts "
-            "~2x across runs, and flash with mid-size blocks is at-or-"
-            "better than dense. The auto-dispatch crossover at 4096 stays "
-            "(never worse); sub-5ms op readings on this tunnel should not "
-            "drive retunes.")
+            "dense (median of 3) this run — flash is not slower than dense "
+            "at 2048 under this protocol. The auto-dispatch crossover at "
+            "4096 stays (never worse); sub-5ms op readings on this tunnel "
+            "should not drive retunes.")
     interp.append(
         "Protocol: marginal from chained-scan lengths "
         f"{N1}/{N2}, all grads fed to the carry (no DCE), scalar readback, "
-        "plausibility floors (dense/4 at 2048; FLOPs-based at long T).")
+        "plausibility floors (dense/4 at 2048; FLOPs-based at long T). "
+        "Cross-run history lives in git, not in this file.")
     out["interpretation"] = interp
 
     with open("results/flash_attention_holes_r4.json", "w") as f:
