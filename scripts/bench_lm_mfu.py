@@ -138,7 +138,8 @@ def main():
     for T, B, remat, chunked in ((2048, 4, False, False),
                                  (2048, 16, False, True),
                                  (8192, 2, False, True),
-                                 (16384, 1, False, True)):
+                                 (16384, 1, False, True),
+                                 (32768, 1, True, True)):
         try:
             r = measure(T, B, remat, chunked)
         except Exception as e:
