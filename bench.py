@@ -57,6 +57,11 @@ def main() -> None:
         batch_size=64, frequency_of_the_test=10_000, random_seed=0,
         use_bf16=True,
         packed_lanes=int(lanes_env) if lanes_env else None,
+        # flat-carry packed executor (results/lane_sweep_r4.json): 1.6x
+        # faster per step in the on-chip microbench, parity-exact on CPU;
+        # opt-in here until validated end-to-end on the chip
+        # (FEDML_BENCH_FLAT=1)
+        packed_flat_carry=os.environ.get("FEDML_BENCH_FLAT", "") == "1",
     ))
     sim, apply_fn = build_simulator(args)
     assert sim._use_device_data, "device-resident data path must engage"

@@ -93,6 +93,7 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
             None if getattr(args, "packed_lanes", None) is None
             else int(args.packed_lanes)
         ),
+        packed_flat_carry=bool(getattr(args, "packed_flat_carry", False)),
         max_width_buckets=int(getattr(args, "max_width_buckets", 4)),
         loss_kind=cfg.loss_kind,
     )

@@ -168,6 +168,25 @@ def test_packed_mesh_size_sweep_matches_sp():
     assert shard_lanes[2] >= shard_lanes[4] >= shard_lanes[8] >= 1
 
 
+def test_packed_flat_carry_matches_tree_carry():
+    """cfg.packed_flat_carry (ravelled-vector lane carry — the v5e perf
+    path) must be numerically interchangeable with the pytree carry,
+    including momentum (opt-state reset at client boundaries rides the
+    flat vector too) and the FedProx proximal term."""
+    for extra in (dict(momentum=0.9),
+                  dict(federated_optimizer="FedProx", fedprox_mu=0.1)):
+        results = {}
+        for flat in (False, True):
+            args = _args(cohort_schedule="packed", comm_round=2,
+                         packed_flat_carry=flat, **extra)
+            sim, ap = build_simulator(args)
+            assert sim._packed
+            sim.run(ap, log_fn=None)
+            results[flat] = _flat(sim.params)
+        np.testing.assert_allclose(results[False], results[True],
+                                   rtol=2e-5, atol=2e-7)
+
+
 def test_packed_with_momentum_and_prox():
     """Optimizer state reset at client boundaries: momentum must not leak
     across clients — parity vs the even path proves the reset is right."""
