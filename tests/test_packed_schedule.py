@@ -187,6 +187,32 @@ def test_packed_flat_carry_matches_tree_carry():
                                    rtol=2e-5, atol=2e-7)
 
 
+@pytest.mark.slow
+def test_packed_flat_carry_conv_model_matches_tree():
+    """Flat carry on a CONV model (the bench regime: ~many param leaves,
+    the case the flat mode exists for) — parity vs tree carry, and the
+    program must compile in reasonable time (regression guard for the
+    unravel-in-scan path).
+
+    Tolerance note: unlike the LR model (bit-close), conv backward
+    accumulation orders differ under the re-fused flat program, and f32
+    rounding differences amplify chaotically through GN/ReLU over the
+    ~24 training steps — measured drift is ~6e-4 absolute after 2
+    rounds, same class as the packed-vs-even tolerance."""
+    results = {}
+    for flat in (False, True):
+        args = _args(dataset="cifar10", model="resnet8",
+                     cohort_schedule="packed", comm_round=2, momentum=0.9,
+                     client_num_in_total=4, client_num_per_round=3,
+                     batch_size=8, packed_flat_carry=flat)
+        sim, ap = build_simulator(args)
+        assert sim._packed
+        sim.run(ap, log_fn=None)
+        results[flat] = _flat(sim.params)
+    np.testing.assert_allclose(results[False], results[True],
+                               rtol=1e-2, atol=2e-3)
+
+
 def test_packed_with_momentum_and_prox():
     """Optimizer state reset at client boundaries: momentum must not leak
     across clients — parity vs the even path proves the reset is right."""
