@@ -143,7 +143,8 @@ class _Session:
         # send() directly — they run on this session's own serve thread and
         # only ever block that session.
         self.outq: "queue.Queue[Optional[bytes]]" = queue.Queue(self.OUTQ_MAX)
-        self._outq_bytes = 0          # under send_lock-free CAS via GIL ops
+        self._outq_bytes = 0          # guarded by _outq_lock (enqueue + writer)
+        self._outq_lock = threading.Lock()
         self._writer: Optional[threading.Thread] = None
 
     def send(self, data: bytes) -> None:
@@ -156,7 +157,8 @@ class _Session:
                 frame = self.outq.get()
                 if frame is None:
                     return
-                self._outq_bytes -= len(frame)
+                with self._outq_lock:
+                    self._outq_bytes -= len(frame)
                 try:
                     self.send(frame)
                 except OSError:
@@ -169,16 +171,18 @@ class _Session:
 
     def enqueue(self, frame: bytes) -> bool:
         """Queue a fan-out frame; False = buffer full (slow consumer).
-        The byte bound is advisory-racy (+= after the check) but the race
-        window is one frame, not the 2 GB a count-only bound would allow."""
-        if self._outq_bytes + len(frame) > self.OUTQ_MAX_BYTES:
-            return False
-        try:
-            self.outq.put_nowait(frame)
-            self._outq_bytes += len(frame)
-            return True
-        except queue.Full:
-            return False
+        The byte counter is lock-guarded so concurrent publisher threads
+        cannot drift it (lost updates would either spuriously drop healthy
+        subscribers or defeat the byte bound entirely)."""
+        with self._outq_lock:
+            if self._outq_bytes + len(frame) > self.OUTQ_MAX_BYTES:
+                return False
+            try:
+                self.outq.put_nowait(frame)
+                self._outq_bytes += len(frame)
+                return True
+            except queue.Full:
+                return False
 
     def stop_writer(self) -> None:
         # A full queue means the writer is wedged on a stalled peer; the

@@ -75,15 +75,18 @@ class TransUNet(nn.Module):
         # ViT bottleneck over the token grid
         h, w = y.shape[1], y.shape[2]
         tokens = y.reshape(B, h * w, self.trans_dim)
-        pos = self.param("pos_embed", nn.initializers.normal(0.02),
-                         (1, h * w, self.trans_dim), jnp.float32)
-        if pos.shape[1] != h * w:
+        # surface the resolution-bound contract BEFORE self.param, whose
+        # ScopeParamShapeError on an apply-time mismatch is opaque
+        existing = self.get_variable("params", "pos_embed")
+        if existing is not None and existing.shape[1] != h * w:
             raise ValueError(
-                f"TransUNet pos_embed was initialized for {pos.shape[1]} "
+                f"TransUNet pos_embed was initialized for {existing.shape[1]} "
                 f"tokens but this input yields {h * w} (input {H}x{W}): "
                 "unlike the fully-convolutional DeepLabV3+, TransUNet "
                 "params are resolution-bound — re-init or interpolate "
                 "pos_embed for the new resolution")
+        pos = self.param("pos_embed", nn.initializers.normal(0.02),
+                         (1, h * w, self.trans_dim), jnp.float32)
         tokens = tokens + pos.astype(self.dtype)
         for i in range(self.trans_layers):
             tokens = Block(self.trans_dim, self.trans_heads, causal=False,

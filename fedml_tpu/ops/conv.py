@@ -99,16 +99,19 @@ except Exception:  # pragma: no cover
     _HAS_PALLAS = False
 
 
-def _pick_block_b(b: int, h: int, w: int, ci: int, kk: int, co: int) -> int:
+def _pick_block_b(b: int, h: int, w: int, ci: int, kk: int, co: int,
+                  itemsize: int = 2) -> int:
     """Largest power-of-two batch block whose working set fits ~8 MB VMEM
-    (padded lane estimates: trailing dims round up to 128 lanes)."""
+    (padded lane estimates: trailing dims round up to 128 lanes).
+    ``itemsize`` is the element byte width of the actual dtype — f32 inputs
+    have twice the bf16 working set and must pick smaller blocks."""
     def lanes(n):
         return -(-n // 128) * 128
 
     for bt in (64, 32, 16, 8, 4, 2, 1):
         if bt > b or b % bt:
             continue
-        est = 2 * (
+        est = itemsize * (
             bt * (h + 2) * (w + 2) * lanes(ci)        # input block
             + bt * h * w * lanes(kk * ci)             # patch matrix
             + bt * h * w * lanes(co)                  # output block
@@ -167,9 +170,9 @@ def _pad_same(x, kh, kw, stride):
 
 
 def _supported(x_shape, w_shape, stride, padding) -> bool:
-    if not _HAS_PALLAS:
+    if not _HAS_PALLAS or len(w_shape) != 4:
         return False
-    kh, kw, ci, co = w_shape
+    kh, kw, _, _ = w_shape
     return (padding == "SAME" and stride == 1 and kh == kw == 3
             and len(x_shape) == 4)
 
@@ -186,11 +189,20 @@ def conv2d_pallas(x: jnp.ndarray, w: jnp.ndarray, stride: int = 1,
 
 
 def _conv2d_pallas_impl(x, w, stride, padding):
+    if not _HAS_PALLAS:
+        raise RuntimeError("conv2d_pallas requires jax.experimental.pallas")
+    if not _supported(x.shape, w.shape, stride, padding):
+        raise ValueError(
+            "conv2d_pallas supports only 3x3 kernels, stride 1, SAME padding "
+            f"on 4-D NHWC inputs; got w.shape={tuple(w.shape)}, "
+            f"stride={stride}, padding={padding!r}, x.ndim={len(x.shape)}. "
+            "Use Conv(impl=...) for automatic fallback on unsupported shapes.")
     b, h, ww, ci = x.shape
     kh, kw, _, co = w.shape
     ho, wo = h, ww  # stride-1 SAME
     xp = _pad_same(x, kh, kw, stride)
-    bt = _pick_block_b(b, h, ww, ci, kh * kw, co)
+    bt = _pick_block_b(b, h, ww, ci, kh * kw, co,
+                       itemsize=jnp.dtype(x.dtype).itemsize)
     kern = functools.partial(_fwd_kernel, kh=kh, kw=kw, ho=ho, wo=wo,
                              stride=stride, out_dtype=x.dtype)
     return pl.pallas_call(
@@ -221,7 +233,8 @@ def _conv2d_pallas_bwd(stride, padding, res, g):
     dx = _conv2d_pallas_impl(g, w_flip, stride, padding).astype(x.dtype)
     # dw: patches(x)^T @ g, accumulated across batch blocks on the grid
     xp = _pad_same(x, kh, kw, stride)
-    bt = _pick_block_b(b, h, ww, ci, kh * kw, co)
+    bt = _pick_block_b(b, h, ww, ci, kh * kw, co,
+                       itemsize=jnp.dtype(x.dtype).itemsize)
     kern = functools.partial(_dw_kernel, kh=kh, kw=kw, ho=h, wo=ww,
                              stride=stride)
     dw_flat = pl.pallas_call(

@@ -1,0 +1,68 @@
+"""Accelerator-availability probe for the tunneled TPU backend.
+
+The backend this image exposes ("axon") can be transiently UNAVAILABLE or
+hang at init. Two properties make a naive in-process check wrong:
+
+- jax caches a failed backend init for the process lifetime, so the probe
+  must run in a FRESH SUBPROCESS or one early failure dooms every retry;
+- with JAX_PLATFORMS unset, a failed accelerator init silently falls back
+  to CPU — a matmul succeeding proves nothing. The probe therefore reports
+  the device platform and callers require it to be an accelerator.
+
+Shared by ``bench.py`` (bounded retries before the flagship measurement)
+and ``scripts/probe_chip.py`` (operator-facing availability loop).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+from typing import Optional, Tuple
+
+_PROBE_SRC = (
+    "import jax, jax.numpy as jnp; "
+    "d = jax.devices()[0]; "
+    "x = jnp.ones((256, 256), jnp.bfloat16); "
+    "v = float((x @ x).sum()); "
+    "print('CHIP_PROBE', d.platform, v, flush=True)"
+)
+
+
+def probe_once(timeout: float = 240.0) -> Tuple[bool, str]:
+    """One fresh-subprocess probe. Returns (accelerator_ok, detail).
+
+    accelerator_ok is True only when the subprocess completed a matmul on
+    a NON-CPU device — a CPU-fallback success is reported as a failure
+    (detail names the platform) so callers never silently measure CPU.
+    """
+    try:
+        r = subprocess.run([sys.executable, "-c", _PROBE_SRC],
+                           capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return False, f"probe hung >{timeout:.0f}s (backend init stuck)"
+    for line in r.stdout.splitlines():
+        if line.startswith("CHIP_PROBE "):
+            platform = line.split()[1]
+            if platform == "cpu":
+                return False, "accelerator init fell back to cpu"
+            return True, f"platform={platform}"
+    tail = (r.stderr or r.stdout).strip().splitlines()
+    return False, f"rc={r.returncode} {tail[-1] if tail else 'no output'}"
+
+
+def wait_for_chip(attempts: int = 5, sleep_s: float = 90.0,
+                  probe_timeout: float = 240.0,
+                  log=None) -> Tuple[bool, Optional[str]]:
+    """Retry ``probe_once`` with backoff. Returns (ok, last_detail)."""
+    detail: Optional[str] = None
+    for i in range(attempts):
+        ok, detail = probe_once(probe_timeout)
+        if log is not None:
+            log(f"chip probe {i + 1}/{attempts}: "
+                f"{'OK ' + detail if ok else detail}")
+        if ok:
+            return True, detail
+        if i + 1 < attempts:
+            time.sleep(sleep_s)
+    return False, detail

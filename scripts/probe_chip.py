@@ -1,0 +1,32 @@
+"""Patient TPU availability probe: retries backend init with backoff.
+
+Thin operator-facing CLI over ``fedml_tpu.utils.chip_probe`` (fresh
+subprocess per attempt; CPU fallback counts as UNAVAILABLE). Exits 0 on
+first accelerator success, 1 after exhausting attempts.
+
+Usage: python scripts/probe_chip.py [attempts] [sleep_seconds]
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fedml_tpu.utils.chip_probe import wait_for_chip  # noqa: E402
+
+
+def main() -> int:
+    attempts = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+    sleep_s = float(sys.argv[2]) if len(sys.argv) > 2 else 120.0
+    ok, detail = wait_for_chip(
+        attempts=attempts, sleep_s=sleep_s, probe_timeout=180.0,
+        log=lambda m: print(f"[{time.strftime('%H:%M:%S')}] {m}", flush=True))
+    print("CHIP AVAILABLE" if ok else f"CHIP UNAVAILABLE ({detail})",
+          flush=True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
