@@ -80,6 +80,36 @@ def chunked_lm_cross_entropy(hidden: jax.Array, head_kernel: jax.Array,
     return -jnp.mean(ll)
 
 
+def per_sample_metrics(out: jax.Array, y: jax.Array, mask: jax.Array,
+                       loss_kind: str = "ce", tol: float = 0.5):
+    """Per-SAMPLE (loss_sum, correct, valid) f32 vectors, shape (B,).
+
+    The segmented per-client evaluator (``FedSimulator.local_test_on_all_
+    clients``) needs per-sample values so one compiled pass over mixed-client
+    batches can scatter-add each sample's stats into its owner client's
+    accumulator. Reductions run over every trailing (e.g. per-token) axis,
+    so ``sum(loss_sum)/sum(valid)`` over any grouping equals the masked_*
+    aggregate over the same samples — per-client and global numbers agree
+    with the reference's sum-of-per-sample-loss / num-samples semantics
+    (``/root/reference/python/fedml/simulation/sp/fedavg/fedavg_api.py:233``).
+    """
+    axes = tuple(range(1, max(y.ndim, mask.ndim)))
+    if loss_kind == "mse":
+        p = out.astype(jnp.float32)
+        if p.ndim == y.ndim + 1 and p.shape[-1] == 1:
+            p = p[..., 0]
+        err = jnp.square(p - y.astype(jnp.float32))
+        m = jnp.broadcast_to(_broadcast_mask(mask, err.ndim), err.shape)
+        hit = (jnp.abs(p - y.astype(jnp.float32)) <= tol)
+        return ((err * m).sum(axes), (hit * m).sum(axes), m.sum(axes))
+    logz = jax.nn.log_softmax(out.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logz, y[..., None], axis=-1)[..., 0]
+    m = jnp.broadcast_to(_broadcast_mask(mask, ll.ndim), ll.shape)
+    pred = jnp.argmax(out, axis=-1)
+    correct = ((pred == y) * m).sum(axes)
+    return (-(ll * m).sum(axes), correct, m.sum(axes))
+
+
 def masked_mse(preds: jax.Array, targets: jax.Array, mask: jax.Array) -> jax.Array:
     """Sum(sq err * mask) / max(sum(mask), 1) — regression tasks (FedGraphNN
     moleculenet property regression). preds (...,) or (..., 1)."""

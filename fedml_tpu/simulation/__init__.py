@@ -96,6 +96,8 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
         packed_flat_carry=bool(getattr(args, "packed_flat_carry", False)),
         max_width_buckets=int(getattr(args, "max_width_buckets", 4)),
         loss_kind=cfg.loss_kind,
+        local_test_on_all_clients=bool(
+            getattr(args, "local_test_on_all_clients", False)),
     )
 
     attack_type = getattr(args, "attack_type", None)
@@ -162,6 +164,9 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
         fed_data, alg, variables, sim_cfg, mesh=mesh,
         # raw pieces for the packed cohort schedule's in-scan batch step
         packed_ctx=(apply_fn, cfg, needs_dropout, has_batch_stats),
+        # reference test_on_the_server hook: an object with that method
+        # (ServerAggregator subclass) replaces the default eval when truthy
+        server_tester=getattr(args, "server_tester", None),
     )
     return sim, apply_fn
 

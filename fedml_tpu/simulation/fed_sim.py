@@ -116,6 +116,14 @@ class SimConfig:
     max_width_buckets: int = 4
     # eval loss family — must match LocalTrainConfig.loss_kind ("ce" | "mse")
     loss_kind: str = "ce"
+    # per-client local-test evaluation at eval rounds (reference
+    # ``_local_test_on_all_clients``, fedavg_api.py:188-246): every client's
+    # local train AND local test split is evaluated under the current global
+    # params; history records the reference's weighted aggregates plus the
+    # per-client vectors. One compiled segmented pass per split (per-sample
+    # stats scatter-added into per-client accumulators) — not a per-client
+    # Python loop. Off by default: it roughly doubles eval cost.
+    local_test_on_all_clients: bool = False
 
 
 def _gather_from_device(data: Dict[str, Any], x_all, y_all) -> Dict[str, Any]:
@@ -158,6 +166,7 @@ class FedSimulator:
         cfg: SimConfig,
         mesh=None,
         packed_ctx: Optional[tuple] = None,
+        server_tester=None,
     ):
         self.fed = fed_data
         self.alg = algorithm
@@ -174,6 +183,14 @@ class FedSimulator:
             self._client_state_proto = ()
         self.history: List[Dict[str, float]] = []
         self._eval_fn = None
+        # reference test_on_the_server hook (ServerAggregator/ModelTrainer
+        # subclass or any object with that method): a truthy return at eval
+        # rounds REPLACES the default evaluation, exactly like the MPI
+        # aggregator (FedAVGAggregator.py:130 `if self.trainer.test_on_the_
+        # server(...): return`); a dict return is merged into the record
+        self._server_tester = server_tester
+        self._local_eval_fn = None
+        self._local_eval_cache: Dict[str, Any] = {}
 
         sizes = [len(v) for v in fed_data.train_data_local_dict.values()]
         if cfg.num_local_batches is None:
@@ -683,7 +700,21 @@ class FedSimulator:
 
     def _post_round(self, rec, round_idx, apply_fn, ckpt, log_fn) -> None:
         if apply_fn is not None and self._should_eval(round_idx):
-            rec.update(self.evaluate(apply_fn))
+            handled = False
+            if self._server_tester is not None:
+                res = self._server_tester.test_on_the_server(
+                    self.fed.train_data_local_dict,
+                    self.fed.test_data_local_dict,
+                    None, None,
+                )
+                if res:  # truthy return replaces the default evaluation
+                    handled = True
+                    if isinstance(res, dict):
+                        rec.update(res)
+            if not handled:
+                rec.update(self.evaluate(apply_fn))
+                if self.cfg.local_test_on_all_clients:
+                    rec.update(self.local_test_on_all_clients(apply_fn))
         self.history.append(rec)
         if ckpt is not None and self._should_checkpoint(round_idx):
             from ..utils.checkpoint import save_simulator_state
@@ -692,7 +723,7 @@ class FedSimulator:
         if log_fn:
             log_fn(f"[round {round_idx}] " + " ".join(
                 f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}"
-                for k, v in rec.items() if k != "round"
+                for k, v in rec.items() if k not in ("round", "per_client")
             ))
 
     def _client_perms(self, client_ids, round_idx: int):
@@ -877,21 +908,145 @@ class FedSimulator:
         if n == 0:  # train-only dataset (e.g. LEAF users without test splits)
             return {}
         bs = min(self.cfg.eval_batch_size, n)
-        # pad the tail batch to full size and mask it out — eval covers every
-        # sample exactly (a truncated tail would bias parity numbers)
-        n_pad = (-n) % bs
-        x = test.x if n_pad == 0 else np.concatenate(
-            [test.x, np.zeros((n_pad,) + test.x.shape[1:], test.x.dtype)])
-        y = test.y if n_pad == 0 else np.concatenate(
-            [test.y, np.zeros((n_pad,) + test.y.shape[1:], test.y.dtype)])
-        m = np.ones(n + n_pad, np.float32)
-        m[n:] = 0.0
-        xs = jnp.asarray(x).reshape((-1, bs) + test.x.shape[1:])
-        # keep trailing label dims (per-token/per-pixel targets)
-        ys = jnp.asarray(y).reshape((-1, bs) + test.y.shape[1:])
-        ms = jnp.asarray(m).reshape((-1, bs))
+        xs, ys, ms = self._pad_and_batch(test.x, test.y, bs)
         l, c, cnt = self._eval_fn(self.params, xs, ys, ms)
         return {
             "test_loss": float(l) / max(float(cnt), 1.0),
             "test_acc": float(c) / max(float(cnt), 1.0),
         }
+
+    @staticmethod
+    def _pad_and_batch(x, y, bs, sid=None):
+        """Pad the tail batch to full size with masked-out rows and reshape
+        into (num_batches, bs, ...) device arrays — eval covers every sample
+        exactly (a truncated tail would bias parity numbers). Keeps trailing
+        label dims (per-token/per-pixel targets). ``sid`` optionally carries
+        a per-sample segment id through the same batching."""
+        n = len(x)
+        n_pad = (-n) % bs
+        m = np.ones(n + n_pad, np.float32)
+        if n_pad:
+            x = np.concatenate([x, np.zeros((n_pad,) + x.shape[1:], x.dtype)])
+            y = np.concatenate([y, np.zeros((n_pad,) + y.shape[1:], y.dtype)])
+            if sid is not None:
+                sid = np.concatenate([sid, np.zeros(n_pad, sid.dtype)])
+            m[n:] = 0.0
+        out = (jnp.asarray(x).reshape((-1, bs) + x.shape[1:]),
+               jnp.asarray(y).reshape((-1, bs) + y.shape[1:]),
+               jnp.asarray(m).reshape((-1, bs)))
+        if sid is not None:
+            out += (jnp.asarray(sid).reshape((-1, bs)),)
+        return out
+
+    # --- per-client local-test evaluation ----------------------------------
+
+    def _build_local_eval(self, apply_fn) -> Callable:
+        """One compiled segmented pass: scan over mixed-client batches,
+        scatter-add each sample's (loss, correct, valid) into its owner
+        client's accumulator. Replaces the reference's per-client Python
+        eval loop (fedavg_api.py:188-246 runs client_num_in_total separate
+        model passes) with ONE program whose cost is the sample count —
+        client raggedness costs nothing because client identity is data
+        (a per-sample id vector), not shape."""
+        from ..ops.losses import per_sample_metrics
+
+        loss_kind = self.cfg.loss_kind
+        C = self.fed.client_num
+
+        def seg_eval(params, xs, ys, ms, cids):
+            def body(carry, batch):
+                x, y, m, cid = batch
+                out = apply_fn(params, x, train=False)
+                lv, cv, vv = per_sample_metrics(out, y, m, loss_kind)
+                L, K, N = carry
+                return (L.at[cid].add(lv), K.at[cid].add(cv),
+                        N.at[cid].add(vv)), None
+
+            z = jnp.zeros((C,), jnp.float32)
+            (L, K, N), _ = jax.lax.scan(body, (z, z, z), (xs, ys, ms, cids))
+            return L, K, N
+
+        return jax.jit(seg_eval)
+
+    def _local_eval_batches(self, split: str):
+        """Batched (xs, ys, ms, sids) tensors for one split ("train" |
+        "test") plus a per-client representative map. Clients sharing one
+        ArrayPair OBJECT (the default loaders give every client the SAME
+        global test set) are deduplicated: the shared array is evaluated
+        ONCE under its first client's position and the stats fan out to the
+        group afterwards — without this, C clients x the full test set
+        would be materialized (O(C * test_set) memory, review finding).
+        Cached — built once per simulator. Returns (batched, rep) where
+        rep[i] = the client position whose accumulator holds client i's
+        stats (-1 = no data); None when the split has no samples."""
+        if split in self._local_eval_cache:
+            return self._local_eval_cache[split]
+        d = (self.fed.train_data_local_dict if split == "train"
+             else self.fed.test_data_local_dict)
+        keys = sorted(self.fed.train_data_local_dict.keys())
+        rep = np.full(len(keys), -1, np.int64)
+        first_pos: Dict[int, int] = {}  # id(pair) -> representative position
+        xs_l, ys_l, sid_l = [], [], []
+        for i, k in enumerate(keys):
+            pair = d.get(k)
+            if pair is None or len(pair) == 0:
+                continue
+            if id(pair) in first_pos:
+                rep[i] = first_pos[id(pair)]
+                continue
+            first_pos[id(pair)] = rep[i] = i
+            xs_l.append(pair.x)
+            ys_l.append(pair.y)
+            sid_l.append(np.full(len(pair), i, np.int32))
+        if not xs_l:
+            self._local_eval_cache[split] = None
+            return None
+        x, y, sid = (np.concatenate(v) for v in (xs_l, ys_l, sid_l))
+        bs = min(self.cfg.eval_batch_size, len(x))
+        batched = self._pad_and_batch(x, y, bs, sid=sid)
+        self._local_eval_cache[split] = (batched, rep)
+        return self._local_eval_cache[split]
+
+    def local_test_on_all_clients(self, apply_fn) -> Dict[str, Any]:
+        """Reference ``_local_test_on_all_clients`` (fedavg_api.py:188-246):
+        evaluate the current global params on EVERY client's local train and
+        local test split; report the sample-weighted aggregates
+        (sum correct / sum samples, sum loss / sum samples) plus per-client
+        vectors under "per_client". Clients without local test data are
+        excluded from both aggregates, matching the reference's ``continue``.
+        """
+        if self._local_eval_fn is None:
+            self._local_eval_fn = self._build_local_eval(apply_fn)
+        keys = sorted(self.fed.train_data_local_dict.keys())
+        include = np.array([
+            self.fed.test_data_local_dict.get(k) is not None
+            and len(self.fed.test_data_local_dict[k]) > 0
+            for k in keys
+        ])
+        out: Dict[str, Any] = {}
+        per_client: Dict[str, List[float]] = {}
+        for split, agg_prefix in (("train", "local_train"),
+                                  ("test", "local_test")):
+            cached = self._local_eval_batches(split)
+            if cached is None:
+                continue
+            batched, rep = cached
+            L, K, N = (np.asarray(v) for v in
+                       self._local_eval_fn(self.params, *batched))
+            # fan the representative accumulators out to their group (shared
+            # ArrayPairs were evaluated once); rep -1 = client has no data
+            has = rep >= 0
+            r = np.where(has, rep, 0)
+            L, K, N = (np.where(has, v[r], 0.0) for v in (L, K, N))
+            n_safe = np.maximum(N, 1.0)
+            per_client[f"{split}_loss"] = (L / n_safe).tolist()
+            per_client[f"{split}_acc"] = (K / n_safe).tolist()
+            per_client[f"{split}_samples"] = N.tolist()
+            # reference aggregate: every client contributes its own copy of
+            # the stats, so shared test sets count once per client
+            inc = include & (N > 0)
+            denom = max(float(N[inc].sum()), 1.0)
+            out[f"{agg_prefix}_loss"] = float(L[inc].sum()) / denom
+            out[f"{agg_prefix}_acc"] = float(K[inc].sum()) / denom
+        out["per_client"] = per_client
+        return out

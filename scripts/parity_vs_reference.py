@@ -44,7 +44,8 @@ BS = 16
 
 # --- synthetic data (identical arrays feed both frameworks) ---------------
 
-def make_synth(n_clients, sizes, feat_shape, n_classes, seed):
+def make_synth(n_clients, sizes, feat_shape, n_classes, seed,
+               test_per_client=24):
     rng = np.random.default_rng(seed)
     total = sum(sizes)
     # class-dependent means so the loss visibly falls
@@ -56,13 +57,24 @@ def make_synth(n_clients, sizes, feat_shape, n_classes, seed):
     for c, n in enumerate(sizes):
         idx_map[c] = list(range(start, start + n))
         start += n
-    return x, y, idx_map
+    # per-client local TEST splits (same generative process) so the
+    # _local_test_on_all_clients comparison exercises distinct local sets
+    n_test = test_per_client * n_clients
+    ty = rng.integers(0, n_classes, size=n_test).astype(np.int64)
+    tx = (centers[ty]
+          + rng.normal(0.0, 1.0, size=(n_test,) + tuple(feat_shape))
+          ).astype(np.float32)
+    test_idx_map = {
+        c: list(range(c * test_per_client, (c + 1) * test_per_client))
+        for c in range(n_clients)
+    }
+    return x, y, idx_map, tx, ty, test_idx_map
 
 
 # --- engine side ----------------------------------------------------------
 
 def run_engine(model_name, x, y, idx_map, n_classes, per_round, rounds,
-               epochs, lr, seed):
+               epochs, lr, seed, tx, ty, test_idx_map):
     import jax
 
     # Parity is about ALGORITHM semantics, so pin true-f32 math: on TPU the
@@ -76,23 +88,28 @@ def run_engine(model_name, x, y, idx_map, n_classes, per_round, rounds,
     from fedml_tpu.simulation import build_simulator
 
     fed = build_federated_data(
-        ArrayPair(x, y.astype(np.int32)), ArrayPair(x[:BS], y[:BS].astype(np.int32)),
-        idx_map, n_classes,
+        ArrayPair(x, y.astype(np.int32)), ArrayPair(tx, ty.astype(np.int32)),
+        idx_map, n_classes, test_idx_map=test_idx_map,
     )
     args = fedml_tpu.init(config=dict(
         dataset="synthetic_parity", model=model_name,
         client_num_in_total=len(idx_map), client_num_per_round=per_round,
         comm_round=rounds, learning_rate=lr, epochs=epochs, batch_size=BS,
-        frequency_of_the_test=10_000, random_seed=seed,
-        cohort_schedule="even",
+        frequency_of_the_test=1, random_seed=seed,
+        cohort_schedule="even", local_test_on_all_clients=True,
     ))
     sim, apply_fn = build_simulator(args, fed_data=fed)
     # real copies, not views: the round step donates the params buffers
     init_params = jax.tree.map(lambda a: np.array(a, copy=True), sim.params)
-    hist = sim.run(apply_fn=None, log_fn=None)
+    hist = sim.run(apply_fn=apply_fn, log_fn=None)
     final_params = jax.tree.map(np.asarray, sim.params)
     losses = [h["train_loss"] for h in hist]
-    return init_params, final_params, losses
+    local_metrics = [
+        {k: h[k] for k in ("local_train_acc", "local_train_loss",
+                           "local_test_acc", "local_test_loss")}
+        for h in hist
+    ]
+    return init_params, final_params, losses, local_metrics
 
 
 # --- reference-semantics torch side --------------------------------------
@@ -155,7 +172,8 @@ def _torch_models(model_name, flax_params, n_classes, feat_shape):
 
 
 def run_torch_reference(model_name, flax_init, x, y, idx_map, n_classes,
-                        per_round, rounds, epochs, lr, seed, feat_shape):
+                        per_round, rounds, epochs, lr, seed, feat_shape,
+                        tx, ty, test_idx_map):
     import torch
     import torch.nn as nn
 
@@ -165,6 +183,33 @@ def run_torch_reference(model_name, flax_init, x, y, idx_map, n_classes,
     n_total = len(idx_map)
     w_global = copy.deepcopy(model.state_dict())
     losses_per_round = []
+    local_metrics_per_round = []
+
+    def local_test_on_all_clients():
+        """fedavg_api.py:188-246 + my_model_trainer_classification.local_test
+        (sum-of-per-sample-loss accumulation): weighted aggregates over
+        every client's local train and test split under w_global."""
+        model.load_state_dict(w_global)
+        model.eval()
+        sum_crit = nn.CrossEntropyLoss(reduction="sum")
+        out = {}
+        for split, data, split_map in (
+            ("train", (x, y), idx_map), ("test", (tx, ty), test_idx_map)
+        ):
+            n_corr = n_samp = loss_sum = 0.0
+            with torch.no_grad():
+                for cid in range(n_total):
+                    rows = np.asarray(split_map[int(cid)])
+                    bx = torch.from_numpy(data[0][rows])
+                    by = torch.from_numpy(data[1][rows])
+                    logits = model(bx)
+                    loss_sum += float(sum_crit(logits, by).item())
+                    n_corr += float((logits.argmax(-1) == by).sum().item())
+                    n_samp += len(rows)
+            key = "local_train" if split == "train" else "local_test"
+            out[f"{key}_acc"] = n_corr / n_samp
+            out[f"{key}_loss"] = loss_sum / n_samp
+        return out
 
     for round_idx in range(rounds):
         # fedavg_api.py:129-143 sampling, bit-for-bit
@@ -204,9 +249,10 @@ def run_torch_reference(model_name, flax_init, x, y, idx_map, n_classes,
             agg[k] = sum((n / training_num) * w[k] for n, w in w_locals)
         w_global = agg
         losses_per_round.append(float(np.mean(client_losses)))
+        local_metrics_per_round.append(local_test_on_all_clients())
 
     model.load_state_dict(w_global)
-    return model, losses_per_round
+    return model, losses_per_round, local_metrics_per_round
 
 
 def _flax_to_flat(model_name, flax_params):
@@ -228,14 +274,24 @@ def _flax_to_flat(model_name, flax_params):
 
 def run_parity(model_name, feat_shape, n_classes, sizes, per_round, rounds,
                epochs, lr, seed=3):
-    x, y, idx_map = make_synth(len(sizes), sizes, feat_shape, n_classes, seed)
-    flax_init, flax_final, engine_losses = run_engine(
-        model_name, x, y, idx_map, n_classes, per_round, rounds, epochs, lr, seed)
-    torch_model, torch_losses = run_torch_reference(
+    x, y, idx_map, tx, ty, test_idx_map = make_synth(
+        len(sizes), sizes, feat_shape, n_classes, seed)
+    flax_init, flax_final, engine_losses, engine_local = run_engine(
+        model_name, x, y, idx_map, n_classes, per_round, rounds, epochs, lr,
+        seed, tx, ty, test_idx_map)
+    torch_model, torch_losses, torch_local = run_torch_reference(
         model_name, flax_init, x, y, idx_map, n_classes, per_round, rounds,
-        epochs, lr, seed, feat_shape)
+        epochs, lr, seed, feat_shape, tx, ty, test_idx_map)
 
     loss_diffs = [abs(a - b) for a, b in zip(engine_losses, torch_losses)]
+    # per-round _local_test_on_all_clients METRIC VALUES must match too —
+    # the reference's reported numbers, not just the final params
+    local_keys = ("local_train_acc", "local_train_loss",
+                  "local_test_acc", "local_test_loss")
+    local_diffs = [
+        abs(e[k] - t[k])
+        for e, t in zip(engine_local, torch_local) for k in local_keys
+    ]
     flat = _flax_to_flat(model_name, flax_final)
     sd = torch_model.state_dict()
     param_diff = max(
@@ -246,11 +302,15 @@ def run_parity(model_name, feat_shape, n_classes, sizes, per_round, rounds,
         "rounds": rounds,
         "engine_losses": engine_losses,
         "reference_losses": torch_losses,
+        "engine_local_metrics": engine_local,
+        "reference_local_metrics": torch_local,
         "max_abs_loss_diff": max(loss_diffs),
+        "max_abs_local_metric_diff": max(local_diffs),
         "max_abs_param_diff": param_diff,
         "loss_tol": 2e-3,
         "param_tol": 2e-3,
-        "pass": max(loss_diffs) < 2e-3 and param_diff < 2e-3,
+        "pass": (max(loss_diffs) < 2e-3 and param_diff < 2e-3
+                 and max(local_diffs) < 2e-3),
     }
 
 

@@ -1,0 +1,136 @@
+"""Per-client local-test evaluation (reference ``_local_test_on_all_clients``,
+``/root/reference/python/fedml/simulation/sp/fedavg/fedavg_api.py:188-246``)
+and the ``test_on_the_server`` hook (``FedAVGAggregator.py:130``)."""
+
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.simulation import build_simulator
+
+
+def _args(**over):
+    base = dict(
+        dataset="mnist", model="lr", debug_small_data=True,
+        client_num_in_total=8, client_num_per_round=4, comm_round=3,
+        learning_rate=0.1, epochs=1, batch_size=10, backend="sp",
+        frequency_of_the_test=2, random_seed=0,
+    )
+    base.update(over)
+    return fedml_tpu.init(config=base)
+
+
+def test_local_test_on_all_clients_matches_per_client_loop():
+    """The one-program segmented eval must agree with an explicit
+    client-by-client evaluation of the same params (the reference's loop
+    semantics), per client and in the weighted aggregate."""
+    args = _args(local_test_on_all_clients=True)
+    sim, apply_fn = build_simulator(args)
+    res = sim.local_test_on_all_clients(apply_fn)
+    pc = res["per_client"]
+
+    import jax.numpy as jnp
+
+    keys = sorted(sim.fed.train_data_local_dict.keys())
+    for split, d in (("train", sim.fed.train_data_local_dict),
+                     ("test", sim.fed.test_data_local_dict)):
+        for i, k in enumerate(keys):
+            pair = d.get(k)
+            if pair is None or len(pair) == 0:
+                continue
+            logits = apply_fn(sim.params, jnp.asarray(pair.x), train=False)
+            logz = np.asarray(
+                jnp.take_along_axis(
+                    jnp.log(jnp.clip(jnp.asarray(
+                        np.exp(np.asarray(logits, np.float64))
+                        / np.exp(np.asarray(logits, np.float64)).sum(
+                            -1, keepdims=True)), 1e-30)),
+                    jnp.asarray(pair.y)[..., None], axis=-1)[..., 0])
+            loss = -float(logz.sum()) / len(pair)
+            acc = float(
+                (np.asarray(np.argmax(logits, -1)) == pair.y).mean())
+            assert pc[f"{split}_loss"][i] == pytest.approx(loss, rel=2e-3), (
+                split, k)
+            assert pc[f"{split}_acc"][i] == pytest.approx(acc, abs=1e-6), (
+                split, k)
+            assert pc[f"{split}_samples"][i] == len(pair)
+
+    # weighted aggregates = sum over included clients / total samples
+    n = np.asarray(pc["test_samples"])
+    inc = n > 0
+    agg_acc = (np.asarray(pc["test_acc"]) * n)[inc].sum() / n[inc].sum()
+    assert res["local_test_acc"] == pytest.approx(float(agg_acc), abs=1e-6)
+
+
+def test_history_carries_local_metrics_at_eval_rounds():
+    args = _args(local_test_on_all_clients=True)
+    history = fedml_tpu.run_simulation(args=args)
+    eval_recs = [h for h in history if "test_acc" in h]
+    assert eval_recs, "no eval rounds recorded"
+    for rec in eval_recs:
+        for key in ("local_train_acc", "local_train_loss",
+                    "local_test_acc", "local_test_loss"):
+            assert key in rec, key
+        pc = rec["per_client"]
+        assert len(pc["train_acc"]) == 8
+        assert len(pc["test_acc"]) == 8
+    # training on MNIST LR: local-train accuracy should beat random fast
+    assert eval_recs[-1]["local_train_acc"] > 0.5
+    # non-eval rounds must not pay the cost
+    non_eval = [h for h in history if "test_acc" not in h]
+    assert all("local_train_acc" not in h for h in non_eval)
+
+
+def test_shared_test_pair_deduplicated():
+    """Default loaders hand every client the SAME global-test ArrayPair —
+    the segmented eval must evaluate it once, not materialize C copies."""
+    args = _args(local_test_on_all_clients=True)
+    sim, apply_fn = build_simulator(args)
+    tdict = sim.fed.test_data_local_dict
+    keys = sorted(tdict.keys())
+    if len({id(tdict[k]) for k in keys}) != 1:
+        pytest.skip("loader no longer shares one test pair")
+    batched, rep = sim._local_eval_batches("test")
+    n_one = len(tdict[keys[0]])
+    total_rows = batched[0].shape[0] * batched[0].shape[1]
+    assert total_rows < 2 * n_one, "shared pair was duplicated per client"
+    assert (rep == rep[0]).all() and rep[0] == 0
+    res = sim.local_test_on_all_clients(apply_fn)
+    pc = res["per_client"]
+    # every client reports the same (shared-set) stats, and the weighted
+    # aggregate equals the single-set value
+    assert len(set(pc["test_acc"])) == 1
+    assert res["local_test_acc"] == pytest.approx(pc["test_acc"][0])
+    g = sim.evaluate(apply_fn)
+    assert res["local_test_acc"] == pytest.approx(g["test_acc"], abs=1e-6)
+
+
+def test_server_tester_hook_replaces_default_eval():
+    """Reference FedAVGAggregator.py:130: a truthy test_on_the_server
+    return skips the default evaluation entirely."""
+    calls = []
+
+    class Tester:
+        def test_on_the_server(self, train_dict, test_dict, device, args):
+            calls.append((len(train_dict), len(test_dict)))
+            return {"custom_metric": 0.75}
+
+    args = _args()
+    args.server_tester = Tester()
+    history = fedml_tpu.run_simulation(args=args)
+    assert calls and calls[0] == (8, 8)
+    eval_recs = [h for h in history if "custom_metric" in h]
+    assert eval_recs, "hook result missing from history"
+    assert all("test_acc" not in h for h in history), (
+        "default eval must be skipped when the hook handles testing")
+
+
+def test_server_tester_falsy_falls_through():
+    class Tester:
+        def test_on_the_server(self, train_dict, test_dict, device, args):
+            return None
+
+    args = _args()
+    args.server_tester = Tester()
+    history = fedml_tpu.run_simulation(args=args)
+    assert any("test_acc" in h for h in history)
