@@ -28,9 +28,25 @@ from ..core.algframe import ClientOutput
 from ..ops.losses import (
     masked_accuracy,
     masked_mse,
+    masked_multilabel_accuracy,
+    masked_sigmoid_bce,
     masked_softmax_cross_entropy,
     masked_within_tolerance,
 )
+
+
+def _masked_loss_and_metrics(out, y, mask, loss_kind):
+    """Shared (loss, correct, valid) dispatch over the loss families:
+    ce (int labels), mse (scalar float regression), bce (multi-hot 0/1
+    labels, e.g. the CheXpert 14-finding contract)."""
+    if loss_kind == "mse":
+        return (masked_mse(out, y, mask),
+                *masked_within_tolerance(out, y, mask))
+    if loss_kind == "bce":
+        return (masked_sigmoid_bce(out, y, mask),
+                *masked_multilabel_accuracy(out, y, mask))
+    return (masked_softmax_cross_entropy(out, y, mask),
+            *masked_accuracy(out, y, mask))
 
 PyTree = Any
 
@@ -101,6 +117,10 @@ def infer_loss_kind(args, fed_data) -> str:
 
     y = np.asarray(fed_data.train_data_global.y)
     if np.issubdtype(y.dtype, np.floating):
+        # multi-hot 0/1 float matrices are multi-label classification (the
+        # CheXpert 14-finding contract) -> sigmoid BCE
+        if y.ndim == 2 and y.shape[1] > 1 and np.isin(y, (0.0, 1.0)).all():
+            return "bce"
         # Only scalar-per-example float targets auto-select mse. Structured
         # float labels (e.g. the object-detection rasterized (S,S,6) grids)
         # need a task-specific loss — routing them through the generic
@@ -118,18 +138,13 @@ def infer_loss_kind(args, fed_data) -> str:
 def make_loss_fn(apply_fn: Callable, needs_dropout: bool = False,
                  loss_kind: str = "ce") -> Callable:
     """(params, x, y, mask, rng) -> (loss, (correct, valid)) with masking."""
-    if loss_kind not in ("ce", "mse"):
+    if loss_kind not in ("ce", "mse", "bce"):
         raise ValueError(f"unknown loss_kind '{loss_kind}'")
 
     def loss_fn(params, x, y, mask, rng):
         kwargs = {"rngs": {"dropout": rng}} if needs_dropout else {}
         out = apply_fn(params, x, train=True, **kwargs)
-        if loss_kind == "mse":
-            loss = masked_mse(out, y, mask)
-            correct, valid = masked_within_tolerance(out, y, mask)
-        else:
-            loss = masked_softmax_cross_entropy(out, y, mask)
-            correct, valid = masked_accuracy(out, y, mask)
+        loss, correct, valid = _masked_loss_and_metrics(out, y, mask, loss_kind)
         return loss, (correct, valid)
 
     return loss_fn
@@ -402,12 +417,7 @@ def make_eval_fn(apply_fn: Callable, loss_kind: str = "ce") -> Callable:
 
     def eval_fn(params, x, y, mask):
         out = apply_fn(params, x, train=False)
-        if loss_kind == "mse":
-            loss = masked_mse(out, y, mask)
-            correct, valid = masked_within_tolerance(out, y, mask)
-        else:
-            loss = masked_softmax_cross_entropy(out, y, mask)
-            correct, valid = masked_accuracy(out, y, mask)
+        loss, correct, valid = _masked_loss_and_metrics(out, y, mask, loss_kind)
         return loss * valid, correct, valid
 
     return eval_fn

@@ -132,6 +132,40 @@ _IMG_SPECS = {  # dataset -> (shape, classes, seed) for large-image fallbacks
 }
 
 
+def _synthetic_cxr(scale: float):
+    """Zero-egress chest-x-ray stand-in (real CheXpert trees take priority,
+    see the chest_xray branch): grayscale images with class-typed opacity
+    patterns — 0 clear, 1 focal round opacity, 2 diffuse haze, 3 bilateral
+    streaks — over a shared lung-field vignette."""
+    h = w = 32
+    # image-level labels need a real test count even in debug_small_data
+    # (8 test images would make test_acc quantized to 1/8)
+    n_tr, n_te = (max(int(2000 * scale), 128), max(int(400 * scale), 64))
+
+    def gen_cxr(n, s):
+        r = np.random.default_rng(s)
+        x = r.normal(0, 0.15, (n, h, w, 1)).astype(np.float32)
+        yy, xx = np.mgrid[0:h, 0:w]
+        field = np.exp(-(((yy - h / 2) / (h / 2)) ** 2
+                         + ((xx - w / 2) / (w / 2)) ** 2))
+        x += field[None, :, :, None].astype(np.float32) * 0.3
+        y = r.integers(0, 4, n).astype(np.int32)
+        for i in range(n):
+            if y[i] == 1:      # focal opacity: one bright disc
+                cy, cx = r.integers(8, h - 8, 2)
+                m = ((yy - cy) ** 2 + (xx - cx) ** 2) < r.integers(16, 36)
+                x[i, :, :, 0] += m * 1.5
+            elif y[i] == 2:    # diffuse haze: low-frequency lift
+                x[i, :, :, 0] += field * r.uniform(0.9, 1.3)
+            elif y[i] == 3:    # bilateral streaks: two vertical bands
+                c1, c2 = r.integers(4, w // 2), r.integers(w // 2, w - 4)
+                x[i, :, c1 - 1:c1 + 2, 0] += 1.2
+                x[i, :, c2 - 1:c2 + 2, 0] += 1.2
+        return ArrayPair(x, y)
+
+    return gen_cxr(n_tr, 43), gen_cxr(n_te, 44), 4
+
+
 def load_partition_data(
     dataset: str,
     data_cache_dir: Optional[str],
@@ -303,6 +337,13 @@ def load_partition_data(
             train, test = gen_nus(n_tr, 30), gen_nus(n_te, 31)
             class_num = 5
     elif dataset in ("fets2021", "FeTS2021"):
+        from . import real_formats
+
+        # real FeTS2021 tree first (partitioning CSV + BraTS volumes as
+        # .nii[.gz] or .npz): the CSV's institution split IS the natural
+        # federated partition (reference python/fedml/data/FeTS2021)
+        if data_cache_dir and real_formats.fets_files(data_cache_dir):
+            return real_formats.load_fets2021(data_cache_dir)
         # medical segmentation (reference data/FeTS2021); 2D stand-in with 4
         # tissue classes, per-pixel labels flattened like seg_synthetic
         h = w = 32
@@ -323,40 +364,26 @@ def load_partition_data(
         train, test = gen_fets(n_tr, rng), gen_fets(n_te, rng)
         class_num = 4
     elif dataset in ("chest_xray", "chexpert", "nih_chest_xray", "mimic_cxr"):
-        # medical chest-x-ray classification (reference app/fedcv/
-        # medical_chest_xray_image_clf: CheXpert/NIH/MIMIC loaders,
-        # DenseNet + CE). Zero-egress stand-in: grayscale images with
-        # class-typed opacity patterns — 0 clear, 1 focal round opacity,
-        # 2 diffuse haze, 3 bilateral streaks.
-        h = w = 32
-        # image-level labels need a real test count even in debug_small_data
-        # (8 test images would make test_acc quantized to 1/8)
-        n_tr, n_te = (max(int(2000 * scale), 128), max(int(400 * scale), 64))
+        from . import real_formats
 
-        def gen_cxr(n, s):
-            r = np.random.default_rng(s)
-            x = r.normal(0, 0.15, (n, h, w, 1)).astype(np.float32)
-            # lung-field vignette so images share a common anatomy prior
-            yy, xx = np.mgrid[0:h, 0:w]
-            field = np.exp(-(((yy - h / 2) / (h / 2)) ** 2
-                             + ((xx - w / 2) / (w / 2)) ** 2))
-            x += field[None, :, :, None].astype(np.float32) * 0.3
-            y = r.integers(0, 4, n).astype(np.int32)
-            for i in range(n):
-                if y[i] == 1:      # focal opacity: one bright disc
-                    cy, cx = r.integers(8, h - 8, 2)
-                    m = ((yy - cy) ** 2 + (xx - cx) ** 2) < r.integers(16, 36)
-                    x[i, :, :, 0] += m * 1.5
-                elif y[i] == 2:    # diffuse haze: low-frequency lift
-                    x[i, :, :, 0] += field * r.uniform(0.9, 1.3)
-                elif y[i] == 3:    # bilateral streaks: two vertical bands
-                    c1, c2 = r.integers(4, w // 2), r.integers(w // 2, w - 4)
-                    x[i, :, c1 - 1:c1 + 2, 0] += 1.2
-                    x[i, :, c2 - 1:c2 + 2, 0] += 1.2
-            return ArrayPair(x, y)
-
-        train, test = gen_cxr(n_tr, 43), gen_cxr(n_te, 44)
-        class_num = 4
+        # real CheXpert-layout tree first (train.csv/valid.csv + image
+        # dirs, reference chexpert/dataset.py:52-57): multi-hot 14-finding
+        # float labels -> loss_kind="bce" via infer_loss_kind
+        if real_formats.chexpert_files(data_cache_dir):
+            train, test, class_num = real_formats.load_chexpert(
+                data_cache_dir)
+            # partition label for hetero: count of positive findings
+            part_labels = np.minimum(
+                train.y.sum(axis=1).astype(np.int64), 4)
+        else:
+            train = None
+        if train is None:
+            # medical chest-x-ray classification (reference app/fedcv/
+            # medical_chest_xray_image_clf: CheXpert/NIH/MIMIC loaders,
+            # DenseNet). Zero-egress stand-in: grayscale images with
+            # class-typed opacity patterns — 0 clear, 1 focal round
+            # opacity, 2 diffuse haze, 3 bilateral streaks.
+            train, test, class_num = _synthetic_cxr(scale)
     elif dataset in ("20news", "agnews", "text_classification"):
         # FedNLP text classification (reference app/fednlp/text_classification;
         # 20news via data/FedNLP loaders). Synthetic stand-in: class-topical

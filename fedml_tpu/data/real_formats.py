@@ -292,3 +292,235 @@ def load_nus_wide(root: str, split: str = "Train") -> Tuple[np.ndarray, np.ndarr
                 f"{p}: {arr.size} values do not divide into {n} label rows")
         blocks.append(arr.reshape(n, -1))
     return np.concatenate(blocks, axis=1), labels, concepts
+
+
+# --- medical: chest x-ray (CheXpert layout) -------------------------------
+
+CHEXPERT_LABELS = [
+    "No Finding", "Enlarged Cardiomediastinum", "Cardiomegaly",
+    "Lung Opacity", "Lung Lesion", "Edema", "Consolidation", "Pneumonia",
+    "Atelectasis", "Pneumothorax", "Pleural Effusion", "Pleural Other",
+    "Fracture", "Support Devices",
+]
+
+
+def chexpert_files(root: Optional[str]) -> bool:
+    """CheXpert-v1.0(-small) layout: train.csv + valid.csv + train/ tree
+    (reference app/fedcv/medical_chest_xray_image_clf/data/chexpert/
+    dataset.py:52-57)."""
+    return bool(
+        root
+        and os.path.isfile(os.path.join(root, "train.csv"))
+        and os.path.isfile(os.path.join(root, "valid.csv"))
+        and os.path.isdir(os.path.join(root, "train"))
+    )
+
+
+def _chexpert_split(root: str, split: str, img_size: int, policy: str,
+                    max_images: int) -> ArrayPair:
+    """One CheXpert split -> (images, multi-hot labels). CSV semantics
+    mirror the reference dataset.py:81-100: column 0 is the image path with
+    its first two components stripped, columns 5: are the 14 findings;
+    blank or -1 (uncertain) maps to 0 under the "zeros" policy, 1 under
+    "ones". Labels stay MULTI-HOT float32 (N, 14) — the reference trains
+    BCEWithLogits over them, here loss_kind="bce"."""
+    csv_path = os.path.join(root, f"{split}.csv")
+    img_root = os.path.join(root, "train" if split == "train" else "valid")
+    xs, ys = [], []
+    with open(csv_path) as f:
+        reader = csv.reader(f)
+        next(reader)  # header
+        split_dir = os.path.basename(img_root)
+        for row in reader:
+            if len(xs) >= max_images:
+                break
+            # the canonical CSV prefixes "CheXpert-v1.0-small/<split>/";
+            # repacks often drop the dataset dir — anchor on the split
+            # component instead of assuming exactly two leading parts
+            parts = row[0].split("/")
+            if split_dir in parts:
+                rel = os.path.join(*parts[parts.index(split_dir) + 1:])
+            elif len(parts) > 2:
+                rel = os.path.join(*parts[2:])
+            else:
+                rel = parts[-1]
+            lbl = np.zeros(len(CHEXPERT_LABELS), np.float32)
+            for i, v in enumerate(row[5:5 + len(CHEXPERT_LABELS)]):
+                if v == "" or float(v) == -1:
+                    lbl[i] = 0.0 if policy == "zeros" else 1.0
+                else:
+                    lbl[i] = float(int(float(v)))
+            path = os.path.join(img_root, rel)
+            if not os.path.isfile(path):
+                continue
+            xs.append(_load_image(path, img_size))
+            ys.append(lbl)
+    assert xs, f"no readable images for CheXpert split '{split}' under {root}"
+    return ArrayPair(np.stack(xs), np.stack(ys))
+
+
+def load_chexpert(root: str, img_size: int = 64, policy: str = "zeros",
+                  max_images: int = 50_000) -> Tuple[ArrayPair, ArrayPair, int]:
+    """CheXpert tree -> (train, valid-as-test, class_num=14)."""
+    train = _chexpert_split(root, "train", img_size, policy, max_images)
+    test = _chexpert_split(root, "valid", img_size, policy, max_images)
+    return train, test, len(CHEXPERT_LABELS)
+
+
+# --- medical: FeTS 2021 (BraTS volumes + partitioning CSV) -----------------
+
+_NIFTI_DTYPES = {2: np.uint8, 4: np.int16, 8: np.int32, 16: np.float32,
+                 64: np.float64, 256: np.int8, 512: np.uint16}
+
+
+def read_nifti(path: str) -> np.ndarray:
+    """Minimal NIfTI-1 volume reader (.nii / .nii.gz): header fields
+    dim (offset 40, 8x int16), datatype (70, int16), vox_offset (108,
+    float32); both endiannesses (sizeof_hdr==348 detects byte order).
+    Covers the BraTS/FeTS2021 files; no affine/scaling handling — raw
+    voxels only."""
+    import gzip
+
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rb") as f:
+        hdr = f.read(352)
+        if len(hdr) < 348:
+            raise ValueError(f"{path}: truncated NIfTI header")
+        bo = "<"
+        if int.from_bytes(hdr[0:4], "little") != 348:
+            if int.from_bytes(hdr[0:4], "big") != 348:
+                raise ValueError(f"{path}: not a NIfTI-1 file")
+            bo = ">"
+        dim = np.frombuffer(hdr[40:56], dtype=bo + "i2")
+        ndim = int(dim[0])
+        if not 1 <= ndim <= 7:
+            raise ValueError(f"{path}: bad NIfTI ndim {ndim}")
+        shape = tuple(int(d) for d in dim[1:1 + ndim])
+        code = int(np.frombuffer(hdr[70:72], dtype=bo + "i2")[0])
+        if code not in _NIFTI_DTYPES:
+            raise ValueError(f"{path}: unsupported NIfTI datatype {code}")
+        dt = np.dtype(_NIFTI_DTYPES[code]).newbyteorder(bo)
+        vox_offset = int(np.frombuffer(hdr[108:112], dtype=bo + "f4")[0])
+        f.seek(max(vox_offset, 352))
+        data = np.frombuffer(f.read(), dtype=dt)
+    n = int(np.prod(shape))
+    if data.size < n:
+        raise ValueError(f"{path}: expected {n} voxels, found {data.size}")
+    # NIfTI data is Fortran-ordered (x fastest)
+    return data[:n].reshape(shape[::-1]).transpose(range(len(shape))[::-1])
+
+
+FETS_MODALITIES = ("flair", "t1", "t1ce", "t2")
+
+
+def fets_files(root: Optional[str]) -> Optional[str]:
+    """FeTS2021 layout: a partitioning CSV (partitioning_1.csv /
+    partitioning_2.csv / partitioning.csv with Partition_ID,Subject_ID
+    columns) next to per-subject dirs of .nii[.gz] volumes or <subject>.npz
+    bundles. Returns the CSV path when present."""
+    if not root:
+        return None
+    for name in ("partitioning_1.csv", "partitioning_2.csv",
+                 "partitioning.csv"):
+        p = os.path.join(root, name)
+        if os.path.isfile(p):
+            return p
+    return None
+
+
+def _load_fets_subject(root: str, subject: str):
+    """(modalities (H, W, D, 4) f32, seg (H, W, D) int32) from either a
+    <subject>.npz bundle (keys flair/t1/t1ce/t2/seg) or the BraTS dir
+    layout <subject>/<subject>_<mod>.nii[.gz]."""
+    npz_path = os.path.join(root, f"{subject}.npz")
+    if os.path.isfile(npz_path):
+        with np.load(npz_path) as z:
+            mods = np.stack([np.asarray(z[m], np.float32)
+                             for m in FETS_MODALITIES], axis=-1)
+            seg = np.asarray(z["seg"], np.int32)
+        return mods, seg
+    sub_dir = os.path.join(root, subject)
+    vols = []
+    for m in FETS_MODALITIES + ("seg",):
+        for ext in (".nii.gz", ".nii"):
+            p = os.path.join(sub_dir, f"{subject}_{m}{ext}")
+            if os.path.isfile(p):
+                vols.append(read_nifti(p))
+                break
+        else:
+            raise FileNotFoundError(
+                f"FeTS subject {subject}: missing {m} volume under {sub_dir}")
+    mods = np.stack([v.astype(np.float32) for v in vols[:4]], axis=-1)
+    return mods, vols[4].astype(np.int32)
+
+
+def load_fets2021(root: str, slices_per_subject: int = 8,
+                  test_fraction: float = 0.2) -> FederatedData:
+    """FeTS2021 -> FederatedData with the CSV's NATURAL institution
+    partition (Partition_ID -> client), the reference's whole point
+    (python/fedml/data/FeTS2021: real multi-institution splits of BraTS).
+
+    Per subject: ``slices_per_subject`` axial slices centered on the
+    volume's segmentation mass, each a (H, W, 4) modality stack
+    (z-normalized per slice over brain voxels) with per-pixel labels
+    flattened to (H*W,) — BraTS label 4 (enhancing tumor) remapped to 3
+    for dense classes {0,1,2,3}. Subjects are split train/test per
+    partition (last ``test_fraction`` of each institution's subject list).
+    """
+    csv_path = fets_files(root)
+    assert csv_path is not None, f"no FeTS partitioning CSV under {root}"
+    part_subjects: Dict[str, List[str]] = {}
+    with open(csv_path) as f:
+        reader = csv.DictReader(f)
+        cols = {c.lower().strip(): c for c in reader.fieldnames or []}
+        pid_col = cols.get("partition_id")
+        sid_col = cols.get("subject_id")
+        assert pid_col and sid_col, (
+            f"{csv_path}: need Partition_ID,Subject_ID columns, "
+            f"got {reader.fieldnames}")
+        for row in reader:
+            part_subjects.setdefault(
+                str(row[pid_col]).strip(), []).append(row[sid_col].strip())
+
+    def subject_slices(subject: str):
+        mods, seg = _load_fets_subject(root, subject)
+        h, w, d = seg.shape
+        # crop H/W to a multiple of 8 (TransUNet/segmentation-stage
+        # contract); slices picked around the max-label plane
+        h8, w8 = h - h % 8, w - w % 8
+        per_z = seg.reshape(h, w, d).sum(axis=(0, 1))
+        zc = int(np.argmax(per_z))
+        half = slices_per_subject // 2
+        z0 = max(0, min(zc - half, d - slices_per_subject))
+        xs, ys = [], []
+        for z in range(z0, min(z0 + slices_per_subject, d)):
+            sl = mods[:h8, :w8, z, :]
+            mu, sd = sl.mean(), sl.std()
+            xs.append((sl - mu) / (sd + 1e-6))
+            lab = seg[:h8, :w8, z].copy()
+            lab[lab == 4] = 3
+            ys.append(lab.reshape(-1))
+        return xs, ys
+
+    xs_all, ys_all = [], []
+    idx_map: Dict[int, List[int]] = {}
+    test_xs, test_ys = [], []
+    for ci, pid in enumerate(sorted(part_subjects, key=str)):
+        subs = part_subjects[pid]
+        n_test = max(1, int(len(subs) * test_fraction)) if len(subs) > 1 else 0
+        idx_map[ci] = []
+        for si, subject in enumerate(subs):
+            xs, ys = subject_slices(subject)
+            if si >= len(subs) - n_test:
+                test_xs.extend(xs)
+                test_ys.extend(ys)
+            else:
+                idx_map[ci].extend(range(len(xs_all), len(xs_all) + len(xs)))
+                xs_all.extend(xs)
+                ys_all.extend(ys)
+    assert xs_all and test_xs, f"FeTS tree under {root} yielded no slices"
+    train = ArrayPair(np.stack(xs_all).astype(np.float32),
+                      np.stack(ys_all).astype(np.int32))
+    test = ArrayPair(np.stack(test_xs).astype(np.float32),
+                     np.stack(test_ys).astype(np.int32))
+    return build_federated_data(train, test, idx_map, class_num=4)

@@ -80,6 +80,31 @@ def chunked_lm_cross_entropy(hidden: jax.Array, head_kernel: jax.Array,
     return -jnp.mean(ll)
 
 
+def masked_sigmoid_bce(logits: jax.Array, targets: jax.Array,
+                       mask: jax.Array) -> jax.Array:
+    """Multi-label binary cross-entropy: sum(BCE * mask) / max(sum(mask), 1)
+    over every (example, label) cell. logits/targets (..., L) with 0/1
+    float targets (the CheXpert 14-finding contract — reference
+    ``app/fedcv/medical_chest_xray_image_clf/data/chexpert/dataset.py:11``
+    label_header; their trainer drives BCEWithLogitsLoss over it)."""
+    z = logits.astype(jnp.float32)
+    t = targets.astype(jnp.float32)
+    # numerically-stable log-sigmoid form of BCE-with-logits
+    per = jnp.maximum(z, 0.0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    m = jnp.broadcast_to(_broadcast_mask(mask, per.ndim), per.shape)
+    return (per * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+
+def masked_multilabel_accuracy(logits: jax.Array, targets: jax.Array,
+                               mask: jax.Array):
+    """Per-label binary accuracy at threshold 0.5 (logit > 0), riding the
+    (num_correct, num_valid) plumbing; valid counts (example, label) cells."""
+    pred = (logits > 0.0).astype(jnp.float32)
+    t = targets.astype(jnp.float32)
+    m = jnp.broadcast_to(_broadcast_mask(mask, t.ndim), t.shape)
+    return ((pred == t) * m).sum(), m.sum()
+
+
 def per_sample_metrics(out: jax.Array, y: jax.Array, mask: jax.Array,
                        loss_kind: str = "ce", tol: float = 0.5):
     """Per-SAMPLE (loss_sum, correct, valid) f32 vectors, shape (B,).
@@ -94,6 +119,15 @@ def per_sample_metrics(out: jax.Array, y: jax.Array, mask: jax.Array,
     (``/root/reference/python/fedml/simulation/sp/fedavg/fedavg_api.py:233``).
     """
     axes = tuple(range(1, max(y.ndim, mask.ndim)))
+    if loss_kind == "bce":
+        z = out.astype(jnp.float32)
+        t = y.astype(jnp.float32)
+        per = jnp.maximum(z, 0.0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        m = jnp.broadcast_to(_broadcast_mask(mask, per.ndim), per.shape)
+        lbl_axes = tuple(range(1, per.ndim))
+        hit = ((z > 0.0).astype(jnp.float32) == t)
+        return ((per * m).sum(lbl_axes), (hit * m).sum(lbl_axes),
+                m.sum(lbl_axes))
     if loss_kind == "mse":
         p = out.astype(jnp.float32)
         if p.ndim == y.ndim + 1 and p.shape[-1] == 1:

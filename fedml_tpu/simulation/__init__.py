@@ -167,6 +167,7 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
         # reference test_on_the_server hook: an object with that method
         # (ServerAggregator subclass) replaces the default eval when truthy
         server_tester=getattr(args, "server_tester", None),
+        hook_args=args,
     )
     return sim, apply_fn
 

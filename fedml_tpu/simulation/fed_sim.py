@@ -167,6 +167,7 @@ class FedSimulator:
         mesh=None,
         packed_ctx: Optional[tuple] = None,
         server_tester=None,
+        hook_args=None,
     ):
         self.fed = fed_data
         self.alg = algorithm
@@ -189,6 +190,7 @@ class FedSimulator:
         # aggregator (FedAVGAggregator.py:130 `if self.trainer.test_on_the_
         # server(...): return`); a dict return is merged into the record
         self._server_tester = server_tester
+        self._hook_args = hook_args  # original args object, for the hook
         self._local_eval_fn = None
         self._local_eval_cache: Dict[str, Any] = {}
 
@@ -702,10 +704,13 @@ class FedSimulator:
         if apply_fn is not None and self._should_eval(round_idx):
             handled = False
             if self._server_tester is not None:
+                # reference signature (FedAVGAggregator.py:130): the real
+                # device + the original args, not None placeholders —
+                # ported aggregators read args.* and the device
                 res = self._server_tester.test_on_the_server(
                     self.fed.train_data_local_dict,
                     self.fed.test_data_local_dict,
-                    None, None,
+                    jax.devices()[0], self._hook_args,
                 )
                 if res:  # truthy return replaces the default evaluation
                     handled = True
@@ -942,31 +947,52 @@ class FedSimulator:
 
     def _build_local_eval(self, apply_fn) -> Callable:
         """One compiled segmented pass: scan over mixed-client batches,
-        scatter-add each sample's (loss, correct, valid) into its owner
-        client's accumulator. Replaces the reference's per-client Python
-        eval loop (fedavg_api.py:188-246 runs client_num_in_total separate
-        model passes) with ONE program whose cost is the sample count —
-        client raggedness costs nothing because client identity is data
-        (a per-sample id vector), not shape."""
+        scatter-add each sample's (loss, correct, valid-cells, samples)
+        into its owner client's accumulator. Replaces the reference's
+        per-client Python eval loop (fedavg_api.py:188-246 runs
+        client_num_in_total separate model passes) with ONE program whose
+        cost is the sample count — client raggedness costs nothing because
+        client identity is data (a per-sample id vector), not shape.
+        Valid CELLS (label positions: 1/sample for classification, L or
+        H*W for multi-label/per-pixel) normalize loss/acc; SAMPLES is the
+        reference's true example count. ``gather`` routes x/y lookups
+        through HBM-resident global arrays (index batches) instead of a
+        second device copy of the train set."""
         from ..ops.losses import per_sample_metrics
 
         loss_kind = self.cfg.loss_kind
         C = self.fed.client_num
 
+        def accumulate(params, x, y, m, cid, carry):
+            out = apply_fn(params, x, train=False)
+            lv, cv, vv = per_sample_metrics(out, y, m, loss_kind)
+            L, K, N, S = carry
+            return (L.at[cid].add(lv), K.at[cid].add(cv),
+                    N.at[cid].add(vv), S.at[cid].add(m))
+
+        z4 = lambda: tuple(jnp.zeros((C,), jnp.float32) for _ in range(4))  # noqa: E731
+
         def seg_eval(params, xs, ys, ms, cids):
             def body(carry, batch):
                 x, y, m, cid = batch
-                out = apply_fn(params, x, train=False)
-                lv, cv, vv = per_sample_metrics(out, y, m, loss_kind)
-                L, K, N = carry
-                return (L.at[cid].add(lv), K.at[cid].add(cv),
-                        N.at[cid].add(vv)), None
+                return accumulate(params, x, y, m, cid, carry), None
 
-            z = jnp.zeros((C,), jnp.float32)
-            (L, K, N), _ = jax.lax.scan(body, (z, z, z), (xs, ys, ms, cids))
-            return L, K, N
+            res, _ = jax.lax.scan(body, z4(), (xs, ys, ms, cids))
+            return res
 
-        return jax.jit(seg_eval)
+        def seg_eval_gather(params, idxs, ms, cids, x_all, y_all):
+            def body(carry, batch):
+                idx, m, cid = batch
+                x = x_all[idx] * m.reshape(
+                    m.shape + (1,) * (x_all.ndim - 1)).astype(x_all.dtype)
+                y = y_all[idx] * m.reshape(
+                    m.shape + (1,) * (y_all.ndim - 1)).astype(y_all.dtype)
+                return accumulate(params, x, y, m, cid, carry), None
+
+            res, _ = jax.lax.scan(body, z4(), (idxs, ms, cids))
+            return res
+
+        return jax.jit(seg_eval), jax.jit(seg_eval_gather)
 
     def _local_eval_batches(self, split: str):
         """Batched (xs, ys, ms, sids) tensors for one split ("train" |
@@ -981,10 +1007,40 @@ class FedSimulator:
         stats (-1 = no data); None when the split has no samples."""
         if split in self._local_eval_cache:
             return self._local_eval_cache[split]
-        d = (self.fed.train_data_local_dict if split == "train"
-             else self.fed.test_data_local_dict)
         keys = sorted(self.fed.train_data_local_dict.keys())
         rep = np.full(len(keys), -1, np.int64)
+        if split == "train" and self._use_device_data:
+            # index batches into the ALREADY-device-resident global train
+            # arrays — a direct concat would pin a second full HBM copy of
+            # the train set for the simulator's lifetime (review finding)
+            idx_l, sid_l = [], []
+            for i, k in enumerate(keys):
+                ix = self.fed._global_index.get(k)
+                if ix is None or len(ix) == 0:
+                    continue
+                rep[i] = i
+                idx_l.append(np.asarray(ix, np.int32))
+                sid_l.append(np.full(len(ix), i, np.int32))
+            if not idx_l:
+                self._local_eval_cache[split] = None
+                return None
+            idx = np.concatenate(idx_l)
+            sid = np.concatenate(sid_l)
+            n = len(idx)
+            bs = min(self.cfg.eval_batch_size, n)
+            n_pad = (-n) % bs
+            m = np.ones(n + n_pad, np.float32)
+            if n_pad:
+                idx = np.concatenate([idx, np.zeros(n_pad, np.int32)])
+                sid = np.concatenate([sid, np.zeros(n_pad, np.int32)])
+                m[n:] = 0.0
+            batched = (jnp.asarray(idx).reshape(-1, bs),
+                       jnp.asarray(m).reshape(-1, bs),
+                       jnp.asarray(sid).reshape(-1, bs))
+            self._local_eval_cache[split] = ("gather", batched, rep)
+            return self._local_eval_cache[split]
+        d = (self.fed.train_data_local_dict if split == "train"
+             else self.fed.test_data_local_dict)
         first_pos: Dict[int, int] = {}  # id(pair) -> representative position
         xs_l, ys_l, sid_l = [], [], []
         for i, k in enumerate(keys):
@@ -1004,7 +1060,7 @@ class FedSimulator:
         x, y, sid = (np.concatenate(v) for v in (xs_l, ys_l, sid_l))
         bs = min(self.cfg.eval_batch_size, len(x))
         batched = self._pad_and_batch(x, y, bs, sid=sid)
-        self._local_eval_cache[split] = (batched, rep)
+        self._local_eval_cache[split] = ("direct", batched, rep)
         return self._local_eval_cache[split]
 
     def local_test_on_all_clients(self, apply_fn) -> Dict[str, Any]:
@@ -1017,6 +1073,7 @@ class FedSimulator:
         """
         if self._local_eval_fn is None:
             self._local_eval_fn = self._build_local_eval(apply_fn)
+        seg_eval, seg_eval_gather = self._local_eval_fn
         keys = sorted(self.fed.train_data_local_dict.keys())
         include = np.array([
             self.fed.test_data_local_dict.get(k) is not None
@@ -1030,18 +1087,25 @@ class FedSimulator:
             cached = self._local_eval_batches(split)
             if cached is None:
                 continue
-            batched, rep = cached
-            L, K, N = (np.asarray(v) for v in
-                       self._local_eval_fn(self.params, *batched))
+            kind, batched, rep = cached
+            if kind == "gather":
+                res = seg_eval_gather(self.params, *batched,
+                                      self._x_dev, self._y_dev)
+            else:
+                res = seg_eval(self.params, *batched)
+            L, K, N, S = (np.asarray(v) for v in res)
             # fan the representative accumulators out to their group (shared
             # ArrayPairs were evaluated once); rep -1 = client has no data
             has = rep >= 0
             r = np.where(has, rep, 0)
-            L, K, N = (np.where(has, v[r], 0.0) for v in (L, K, N))
+            L, K, N, S = (np.where(has, v[r], 0.0) for v in (L, K, N, S))
+            # loss/acc normalize over valid label CELLS (== samples for
+            # classification; L cells for multi-label, H*W for per-pixel);
+            # "samples" is the reference's true example count either way
             n_safe = np.maximum(N, 1.0)
             per_client[f"{split}_loss"] = (L / n_safe).tolist()
             per_client[f"{split}_acc"] = (K / n_safe).tolist()
-            per_client[f"{split}_samples"] = N.tolist()
+            per_client[f"{split}_samples"] = S.tolist()
             # reference aggregate: every client contributes its own copy of
             # the stats, so shared test sets count once per client
             inc = include & (N > 0)

@@ -1,0 +1,129 @@
+"""Generate tiny committed fixtures for the medical real-format parsers.
+
+- chexpert/: CheXpert-v1.0-small layout (train.csv/valid.csv + image trees,
+  path column formatted exactly like the real CSV incl. the two stripped
+  leading components; labels with blanks and -1 uncertain entries).
+- fets2021/: partitioning CSV + three subjects — two as .npz bundles, one
+  as a BraTS-style dir of .nii.gz volumes (written by a minimal NIfTI-1
+  writer so read_nifti's header/endianness/Fortran-order path is exercised
+  against independently-constructed files).
+
+Run once: python scripts/make_medical_fixtures.py
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+FIX = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                   "tests", "fixtures", "real_formats")
+
+
+def write_nifti(path: str, vol: np.ndarray) -> None:
+    """Minimal NIfTI-1 writer (little-endian, no scaling/affine)."""
+    codes = {np.dtype(np.uint8): (2, 8), np.dtype(np.int16): (4, 16),
+             np.dtype(np.int32): (8, 32), np.dtype(np.float32): (16, 32)}
+    code, bitpix = codes[vol.dtype]
+    hdr = bytearray(352)
+    struct.pack_into("<i", hdr, 0, 348)                    # sizeof_hdr
+    dims = [vol.ndim] + list(vol.shape) + [1] * (7 - vol.ndim)
+    struct.pack_into("<8h", hdr, 40, *dims)                # dim
+    struct.pack_into("<h", hdr, 70, code)                  # datatype
+    struct.pack_into("<h", hdr, 72, bitpix)                # bitpix
+    struct.pack_into("<f", hdr, 108, 352.0)                # vox_offset
+    hdr[344:348] = b"n+1\x00"                              # magic
+    payload = bytes(hdr) + np.asfortranarray(vol).tobytes(order="F")
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "wb") as f:
+        f.write(payload)
+
+
+def make_chexpert() -> None:
+    from PIL import Image
+
+    root = os.path.join(FIX, "chexpert")
+    rng = np.random.default_rng(7)
+    header = (
+        "Path,Sex,Age,Frontal/Lateral,AP/PA,No Finding,"
+        "Enlarged Cardiomediastinum,Cardiomegaly,Lung Opacity,Lung Lesion,"
+        "Edema,Consolidation,Pneumonia,Atelectasis,Pneumothorax,"
+        "Pleural Effusion,Pleural Other,Fracture,Support Devices")
+    for split, n in (("train", 12), ("valid", 4)):
+        rows = [header]
+        for i in range(n):
+            rel = f"patient{i:05d}/study1/view1_frontal.jpg"
+            img_path = os.path.join(root, split, rel)
+            os.makedirs(os.path.dirname(img_path), exist_ok=True)
+            # label-correlated brightness so learning/parsing is checkable
+            lbl = (rng.random(14) < 0.25).astype(int)
+            base = 60 + 120 * lbl[2]  # Cardiomegaly brightens the image
+            arr = rng.integers(0, 40, (32, 32), np.uint8) + base
+            Image.fromarray(arr.astype(np.uint8), "L").save(img_path)
+            cells = []
+            for j, v in enumerate(lbl):
+                if j == 5 and i % 4 == 1:
+                    cells.append("")          # blank -> policy fill
+                elif j == 7 and i % 4 == 2:
+                    cells.append("-1.0")      # uncertain -> policy fill
+                else:
+                    cells.append(f"{float(v):.1f}")
+            rows.append(
+                f"CheXpert-v1.0-small/{split}/{rel},Female,60,Frontal,AP,"
+                + ",".join(cells))
+        with open(os.path.join(root, f"{split}.csv"), "w") as f:
+            f.write("\n".join(rows) + "\n")
+
+
+def make_fets() -> None:
+    root = os.path.join(FIX, "fets2021")
+    os.makedirs(root, exist_ok=True)
+    rng = np.random.default_rng(11)
+    h = w = 24
+    d = 12
+    subjects = {
+        "1": ["FeTS21_Training_001", "FeTS21_Training_002"],
+        "2": ["FeTS21_Training_003"],
+    }
+    with open(os.path.join(root, "partitioning_1.csv"), "w") as f:
+        f.write("Partition_ID,Subject_ID\n")
+        for pid, subs in subjects.items():
+            for s in subs:
+                f.write(f"{pid},{s}\n")
+
+    def make_subject(seed):
+        r = np.random.default_rng(seed)
+        mods = r.normal(0, 1, (h, w, d, 4)).astype(np.float32)
+        seg = np.zeros((h, w, d), np.int32)
+        r0, c0, z0 = r.integers(2, h - 8), r.integers(2, w - 8), d // 2 - 2
+        for cls, off in ((1, 0), (2, 2), (4, 4)):  # BraTS labels {1,2,4}
+            seg[r0 + off:r0 + off + 3, c0:c0 + 3, z0:z0 + 4] = cls
+        mods[..., 0] += (seg > 0) * 2.0  # tumor visible in flair
+        return mods, seg
+
+    # subjects 1-2 as npz bundles
+    for i, subject in enumerate(subjects["1"]):
+        mods, seg = make_subject(20 + i)
+        np.savez_compressed(
+            os.path.join(root, f"{subject}.npz"),
+            flair=mods[..., 0], t1=mods[..., 1], t1ce=mods[..., 2],
+            t2=mods[..., 3], seg=seg)
+    # subject 3 as a BraTS dir of .nii.gz volumes (int16 seg exercises the
+    # dtype table; float32 modalities the common path)
+    subject = subjects["2"][0]
+    mods, seg = make_subject(30)
+    sdir = os.path.join(root, subject)
+    os.makedirs(sdir, exist_ok=True)
+    for mi, m in enumerate(("flair", "t1", "t1ce", "t2")):
+        write_nifti(os.path.join(sdir, f"{subject}_{m}.nii.gz"),
+                    mods[..., mi].astype(np.float32))
+    write_nifti(os.path.join(sdir, f"{subject}_seg.nii.gz"),
+                seg.astype(np.int16))
+
+
+if __name__ == "__main__":
+    make_chexpert()
+    make_fets()
+    print(f"fixtures written under {FIX}")

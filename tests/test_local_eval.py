@@ -90,7 +90,8 @@ def test_shared_test_pair_deduplicated():
     keys = sorted(tdict.keys())
     if len({id(tdict[k]) for k in keys}) != 1:
         pytest.skip("loader no longer shares one test pair")
-    batched, rep = sim._local_eval_batches("test")
+    kind, batched, rep = sim._local_eval_batches("test")
+    assert kind == "direct"
     n_one = len(tdict[keys[0]])
     total_rows = batched[0].shape[0] * batched[0].shape[1]
     assert total_rows < 2 * n_one, "shared pair was duplicated per client"
@@ -112,13 +113,15 @@ def test_server_tester_hook_replaces_default_eval():
 
     class Tester:
         def test_on_the_server(self, train_dict, test_dict, device, args):
-            calls.append((len(train_dict), len(test_dict)))
+            calls.append((len(train_dict), len(test_dict), device, args))
             return {"custom_metric": 0.75}
 
     args = _args()
     args.server_tester = Tester()
     history = fedml_tpu.run_simulation(args=args)
-    assert calls and calls[0] == (8, 8)
+    assert calls and calls[0][:2] == (8, 8)
+    # reference signature: real device + the original args, not None
+    assert calls[0][2] is not None and calls[0][3] is args
     eval_recs = [h for h in history if "custom_metric" in h]
     assert eval_recs, "hook result missing from history"
     assert all("test_acc" not in h for h in history), (
