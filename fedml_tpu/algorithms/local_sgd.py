@@ -198,8 +198,9 @@ def make_local_update(
                 "GroupNorm model variant")
         if cfg.loss_kind != "ce":
             raise ValueError(
-                "loss_kind='mse' with BatchNorm models is unwired; use a "
-                "GroupNorm variant for regression")
+                f"loss_kind='{cfg.loss_kind}' with BatchNorm models is "
+                "unwired (only 'ce' threads batch stats); use a GroupNorm "
+                "model variant for regression/multi-label tasks")
         if cfg.use_scaffold:
             raise ValueError(
                 "SCAFFOLD control variates are defined on params only; "

@@ -80,6 +80,15 @@ def chunked_lm_cross_entropy(hidden: jax.Array, head_kernel: jax.Array,
     return -jnp.mean(ll)
 
 
+def _bce_with_logits(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Per-cell numerically-stable BCE-with-logits (log-sigmoid form).
+    The ONE implementation shared by the training loss and the per-sample
+    eval path — any stability/semantics change lands in both."""
+    z = logits.astype(jnp.float32)
+    t = targets.astype(jnp.float32)
+    return jnp.maximum(z, 0.0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+
+
 def masked_sigmoid_bce(logits: jax.Array, targets: jax.Array,
                        mask: jax.Array) -> jax.Array:
     """Multi-label binary cross-entropy: sum(BCE * mask) / max(sum(mask), 1)
@@ -87,10 +96,7 @@ def masked_sigmoid_bce(logits: jax.Array, targets: jax.Array,
     float targets (the CheXpert 14-finding contract — reference
     ``app/fedcv/medical_chest_xray_image_clf/data/chexpert/dataset.py:11``
     label_header; their trainer drives BCEWithLogitsLoss over it)."""
-    z = logits.astype(jnp.float32)
-    t = targets.astype(jnp.float32)
-    # numerically-stable log-sigmoid form of BCE-with-logits
-    per = jnp.maximum(z, 0.0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+    per = _bce_with_logits(logits, targets)
     m = jnp.broadcast_to(_broadcast_mask(mask, per.ndim), per.shape)
     return (per * m).sum() / jnp.maximum(m.sum(), 1.0)
 
@@ -120,12 +126,10 @@ def per_sample_metrics(out: jax.Array, y: jax.Array, mask: jax.Array,
     """
     axes = tuple(range(1, max(y.ndim, mask.ndim)))
     if loss_kind == "bce":
-        z = out.astype(jnp.float32)
-        t = y.astype(jnp.float32)
-        per = jnp.maximum(z, 0.0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        per = _bce_with_logits(out, y)
         m = jnp.broadcast_to(_broadcast_mask(mask, per.ndim), per.shape)
         lbl_axes = tuple(range(1, per.ndim))
-        hit = ((z > 0.0).astype(jnp.float32) == t)
+        hit = ((out > 0.0).astype(jnp.float32) == y.astype(jnp.float32))
         return ((per * m).sum(lbl_axes), (hit * m).sum(lbl_axes),
                 m.sum(lbl_axes))
     if loss_kind == "mse":

@@ -114,7 +114,8 @@ class SimConfig:
     #              the algorithm mean-aggregates; else even.
     cohort_schedule: str = "auto"
     max_width_buckets: int = 4
-    # eval loss family — must match LocalTrainConfig.loss_kind ("ce" | "mse")
+    # eval loss family — must match LocalTrainConfig.loss_kind
+    # ("ce" | "mse" | "bce")
     loss_kind: str = "ce"
     # per-client local-test evaluation at eval rounds (reference
     # ``_local_test_on_all_clients``, fedavg_api.py:188-246): every client's
@@ -1026,18 +1027,10 @@ class FedSimulator:
                 return None
             idx = np.concatenate(idx_l)
             sid = np.concatenate(sid_l)
-            n = len(idx)
-            bs = min(self.cfg.eval_batch_size, n)
-            n_pad = (-n) % bs
-            m = np.ones(n + n_pad, np.float32)
-            if n_pad:
-                idx = np.concatenate([idx, np.zeros(n_pad, np.int32)])
-                sid = np.concatenate([sid, np.zeros(n_pad, np.int32)])
-                m[n:] = 0.0
-            batched = (jnp.asarray(idx).reshape(-1, bs),
-                       jnp.asarray(m).reshape(-1, bs),
-                       jnp.asarray(sid).reshape(-1, bs))
-            self._local_eval_cache[split] = ("gather", batched, rep)
+            bs = min(self.cfg.eval_batch_size, len(idx))
+            idx_b, sid_b, m_b = self._pad_and_batch(idx, sid, bs)
+            self._local_eval_cache[split] = ("gather", (idx_b, m_b, sid_b),
+                                             rep)
             return self._local_eval_cache[split]
         d = (self.fed.train_data_local_dict if split == "train"
              else self.fed.test_data_local_dict)
@@ -1066,10 +1059,18 @@ class FedSimulator:
     def local_test_on_all_clients(self, apply_fn) -> Dict[str, Any]:
         """Reference ``_local_test_on_all_clients`` (fedavg_api.py:188-246):
         evaluate the current global params on EVERY client's local train and
-        local test split; report the sample-weighted aggregates
-        (sum correct / sum samples, sum loss / sum samples) plus per-client
+        local test split; report the weighted aggregates plus per-client
         vectors under "per_client". Clients without local test data are
         excluded from both aggregates, matching the reference's ``continue``.
+
+        Normalization: loss/acc divide by valid label CELLS. For
+        classification (one label per example — everything the reference's
+        loop covers) cells == samples, so the numbers equal the reference's
+        sum-loss/sum-samples exactly (parity-checked to ~1e-7 in
+        scripts/parity_vs_reference.py). For the additional multi-label
+        (bce: L cells/sample) and per-pixel (H*W cells/sample) families the
+        values are per-cell means — the reference has no equivalent there.
+        "per_client[*_samples]" always reports TRUE example counts.
         """
         if self._local_eval_fn is None:
             self._local_eval_fn = self._build_local_eval(apply_fn)

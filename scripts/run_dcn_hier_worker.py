@@ -1,0 +1,116 @@
+"""One silo-process of a hierarchical FL round whose GLOBAL aggregation
+crosses the process boundary (the DCN axis).
+
+Launch 2 of these under jax.distributed (coordinator on localhost; see
+tests/test_multihost_dcn.py). Each process is one GROUP/silo: it runs
+``--group-rounds`` of local FedAvg over its own clients entirely
+in-process (the ICI tier), then the two groups' models are combined by a
+sample-weighted mean computed AS A CROSS-PROCESS MESH COLLECTIVE — a jit
+over a global mesh whose devices span both processes, so the reduction
+traffic rides the distributed runtime exactly where a TPU pod would use
+DCN. Both processes must end with bit-identical global params.
+
+Parity: reference ``cross_silo/hierarchical`` topology (torch DDP process
+groups + MPI server tier, dist_trainer_launcher.py:23) collapsed to
+jax.distributed + one sharded program.
+
+Usage: run_dcn_hier_worker.py --out OUT.json [--group-rounds N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--group-rounds", type=int, default=2)
+    opts = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.flatten_util import ravel_pytree
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    import fedml_tpu
+    from fedml_tpu.simulation import build_simulator
+
+    # fedml_tpu.init runs maybe_initialize_distributed (the coordinator
+    # env vars) — the world only exists after it
+    # --- group tier: local FedAvg rounds, one group per process ----------
+    # group data differs per process (disjoint client populations); seeds
+    # are deterministic so the test harness can recompute the expectation
+    import os
+
+    pid = int(os.environ.get("JAX_PROCESS_ID", 0))
+    args = fedml_tpu.init(config=dict(
+        dataset="mnist", model="lr", debug_small_data=True,
+        client_num_in_total=6, client_num_per_round=3,
+        comm_round=opts.group_rounds, learning_rate=0.1, epochs=1,
+        batch_size=10, frequency_of_the_test=10_000,
+        random_seed=100 + pid,  # group-specific data AND init
+    ))
+    assert jax.process_count() == 2, "expects a 2-process jax.distributed world"
+    assert pid == jax.process_index()
+    sim, apply_fn = build_simulator(args)
+    sim.run(apply_fn=None, log_fn=None)
+    flat, unravel = ravel_pytree(sim.params)
+    weight = float(sim.fed.train_data_num)
+
+    # --- global tier: weighted mean over the DCN axis --------------------
+    # one "silo" mesh axis spanning every global device (2 per process);
+    # each process contributes its group's (weighted) vector on its OWN
+    # local devices, and the jitted mean reduces ACROSS processes
+    devs = np.array(jax.devices()).reshape(-1, 1)
+    mesh = Mesh(devs, ("silo", "model"))
+    row_sh = NamedSharding(mesh, P("silo", "model"))
+    n_rows = len(jax.devices())
+    flat_np = np.asarray(flat, np.float32)
+    local_rows = [
+        jax.device_put(flat_np[None, :], d) for d in jax.local_devices()
+    ]
+    stacked = jax.make_array_from_single_device_arrays(
+        (n_rows, flat_np.shape[0]), row_sh, local_rows)
+    w_np = np.full(len(jax.local_devices()), weight / len(jax.local_devices()),
+                   np.float32)
+    w_rows = [jax.device_put(w_np[None, i], d)
+              for i, d in enumerate(jax.local_devices())]
+    w_global = jax.make_array_from_single_device_arrays(
+        (n_rows,), NamedSharding(mesh, P("silo")), w_rows)
+
+    @functools.partial(
+        jax.jit, out_shardings=NamedSharding(mesh, P()))
+    def global_mean(rows, w):
+        # executes over the global mesh: the sum crosses the process
+        # boundary (DCN); output REPLICATED so every process holds a full
+        # addressable copy to read back locally
+        return (w[:, None] * rows).sum(0) / w.sum()
+
+    merged = global_mean(stacked, w_global)
+    merged_vec = np.asarray(merged.addressable_data(0))
+    global_params = unravel(jnp.asarray(merged_vec))
+
+    # evaluate the MERGED model on this group's test split (proves the
+    # cross-process result is a usable model, not just bytes)
+    sim.params = global_params
+    metrics = sim.evaluate(apply_fn)
+
+    with open(opts.out, "w") as f:
+        json.dump({
+            "process": pid,
+            "global_devices": len(jax.devices()),
+            "local_devices": len(jax.local_devices()),
+            "group_weight": weight,
+            "group_vec_l2": float(np.linalg.norm(flat_np)),
+            "merged_digest": float(np.abs(merged_vec).sum()),
+            "merged_first8": [float(v) for v in merged_vec[:8]],
+            "test_acc": metrics.get("test_acc"),
+        }, f)
+
+
+if __name__ == "__main__":
+    main()
