@@ -78,7 +78,14 @@ class FedMLCrossDeviceAggregator(FedMLAggregator):
 class ServerMNN:
     """Reference ``fedml.run_mnn_server()`` target (launch_cross_device.py:6):
     build the aggregator + server manager; devices connect over the chosen
-    backend and upload blobs."""
+    backend and upload blobs.
+
+    Wire contract (conformance-tested by a protocol-only stand-in client in
+    tests/test_cross_device_wire_protocol.py): downlink INIT/SYNC carry the
+    FULL global params; uplink model_params is the DELTA (local - global),
+    aggregated as params + weighted-mean(delta) (aggregator.py:108). Devices
+    porting from the reference (which uploads full params) must subtract the
+    received global before uploading."""
 
     def __init__(self, args, fed_data, variables, apply_fn=None,
                  backend: str = "LOOPBACK", **kw):

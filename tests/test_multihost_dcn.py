@@ -39,7 +39,8 @@ def test_hierarchical_round_over_dcn(tmp_path):
             PYTHONPATH=REPO_ROOT,
             JAX_PLATFORMS="cpu",
             PALLAS_AXON_POOL_IPS="",
-            XLA_FLAGS="--xla_force_host_platform_device_count=2",
+            XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                       + " --xla_force_host_platform_device_count=2").strip(),
             JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
             JAX_NUM_PROCESSES="2",
             JAX_PROCESS_ID=str(pid),
