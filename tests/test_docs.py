@@ -1,0 +1,61 @@
+"""Docs-tree integrity: links resolve, every example is reachable from a
+doc page, and the generated config reference is not stale."""
+
+import os
+import re
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOCS = os.path.join(REPO, "docs")
+
+LINK_RE = re.compile(r"\[[^\]]*\]\(([^)]+)\)")
+
+
+def _doc_files():
+    return [os.path.join(DOCS, f) for f in sorted(os.listdir(DOCS))
+            if f.endswith(".md")]
+
+
+def test_docs_exist():
+    names = {os.path.basename(p) for p in _doc_files()}
+    for required in ("README.md", "quickstart_simulation.md",
+                     "quickstart_cross_silo.md", "quickstart_cross_device.md",
+                     "quickstart_distributed_training.md",
+                     "config_reference.md", "performance.md", "apps.md"):
+        assert required in names, f"docs/{required} missing"
+
+
+def test_all_relative_links_resolve():
+    broken = []
+    for path in _doc_files():
+        base = os.path.dirname(path)
+        for m in LINK_RE.finditer(open(path).read()):
+            target = m.group(1).split("#")[0]  # drop anchors, keep the path
+            if not target or target.startswith(
+                    ("http://", "https://", "mailto:")):
+                continue
+            if not os.path.exists(os.path.normpath(os.path.join(base, target))):
+                broken.append(f"{os.path.basename(path)} -> {target}")
+    assert not broken, broken
+
+
+def test_every_example_reachable_from_docs():
+    examples = {
+        d for d in os.listdir(os.path.join(REPO, "examples"))
+        if os.path.isdir(os.path.join(REPO, "examples", d))
+    }
+    corpus = "".join(open(p).read() for p in _doc_files())
+    # examples/README.md is itself linked from docs; any example named
+    # there counts as reachable too
+    corpus += open(os.path.join(REPO, "examples", "README.md")).read()
+    missing = [e for e in sorted(examples) if e not in corpus]
+    assert not missing, f"examples unreachable from docs: {missing}"
+
+
+def test_config_reference_not_stale():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "gen_config_reference.py"), "--check"],
+        capture_output=True, text=True, cwd=REPO)
+    assert r.returncode == 0, r.stderr or r.stdout
