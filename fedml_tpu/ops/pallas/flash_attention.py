@@ -314,7 +314,28 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
     return from_bh(dq), from_bh(dk), from_bh(dv)
 
 
-def _resolve_blocks(T, block_q, block_k):
+# Measured-fastest (block_q, block_k) per sequence length, from on-chip
+# same-process sweeps (scripts/bench_flash_blocks_r5.py ->
+# results/flash_blocks_r5.json). Shapes absent here fall back to
+# auto_block squares. Rectangular blocks (small q x large k) keep the
+# softmax state resident while streaming more K per grid step — the r4
+# T=2048 sweep saw (128, 1024) at 1.62x dense (flash_attention_holes_r4
+# t2048_block_sweep) pending confirmation under the r5 protocol.
+BLOCK_TABLE: dict = {}
+
+
+def _resolve_blocks(T, block_q, block_k, Dh: int = 64, itemsize: int = 2):
+    table = BLOCK_TABLE.get(T)
+    if block_q is None and block_k is None and table is not None:
+        bq, bk = table
+        # table entries face the SAME guards the auto path does: lane
+        # alignment (Mosaic needs multiples of 128) and scoped VMEM for
+        # the larger tile — a mis-adopted (128, 2048) entry must fall
+        # back to auto squares, not blow VMEM at chip time
+        if (T % bq == 0 and T % bk == 0
+                and bq % MIN_BLOCK == 0 and bk % MIN_BLOCK == 0
+                and flash_vmem_ok(T, Dh, itemsize, block=max(bq, bk))):
+            return bq, bk
     auto = auto_block(T)
     bq = block_q or auto
     bk = block_k or auto
@@ -337,14 +358,18 @@ def flash_attention(
     tiling for T (auto_block); requires T % block == 0 (callers fall back
     to dense otherwise)."""
     interpret = jax.default_backend() != "tpu"
-    block_q, block_k = _resolve_blocks(q.shape[1], block_q, block_k)
+    block_q, block_k = _resolve_blocks(
+        q.shape[1], block_q, block_k, Dh=q.shape[-1],
+        itemsize=jnp.dtype(q.dtype).itemsize)
     out, _ = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
     return out
 
 
 def _fwd(q, k, v, causal, block_q, block_k):
     interpret = jax.default_backend() != "tpu"
-    block_q, block_k = _resolve_blocks(q.shape[1], block_q, block_k)
+    block_q, block_k = _resolve_blocks(
+        q.shape[1], block_q, block_k, Dh=q.shape[-1],
+        itemsize=jnp.dtype(q.dtype).itemsize)
     out, lse = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
     return out, (q, k, v, out, lse)
 
@@ -352,7 +377,9 @@ def _fwd(q, k, v, causal, block_q, block_k):
 def _bwd(causal, block_q, block_k, res, g):
     q, k, v, out, lse = res
     interpret = jax.default_backend() != "tpu"
-    block_q, block_k = _resolve_blocks(q.shape[1], block_q, block_k)
+    block_q, block_k = _resolve_blocks(
+        q.shape[1], block_q, block_k, Dh=q.shape[-1],
+        itemsize=jnp.dtype(q.dtype).itemsize)
     return _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret)
 
 

@@ -54,6 +54,12 @@ class DistTrainConfig:
     # O(T/sp) memory) or "ulysses" (all-to-all seq<->heads re-shard,
     # full-sequence flash-eligible attention; heads % sp == 0)
     sp_impl: str = "ring"
+    # AdamW first-moment dtype: "bfloat16" halves mu's HBM footprint and
+    # the optimizer stage's read/write traffic (mu tolerates bf16; nu
+    # stays f32 — its tiny values underflow bf16's 8-bit mantissa).
+    # Optimizer-stage bandwidth is a measured lever on the tunneled v5e
+    # (scripts/bench_lm_attribution_r5.py).
+    mu_dtype: Optional[str] = None
 
 
 def make_lm_mesh(cfg: DistTrainConfig, devices=None) -> Mesh:
@@ -130,7 +136,9 @@ class DistributedLMTrainer:
             is_leaf=lambda x: isinstance(x, P),
         )
         self.params = jax.device_put(variables, self.param_shardings)
-        self.opt = optax.adamw(cfg.lr, weight_decay=cfg.weight_decay)
+        self.opt = optax.adamw(
+            cfg.lr, weight_decay=cfg.weight_decay,
+            mu_dtype=jnp.dtype(cfg.mu_dtype) if cfg.mu_dtype else None)
         # moments inherit the params' shardings (init maps over sharded params)
         self.opt_state = self.opt.init(self.params)
         self.batch_sharding = NamedSharding(self.mesh, P(AXIS_DATA, AXIS_SEQ))

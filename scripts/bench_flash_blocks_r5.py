@@ -1,0 +1,164 @@
+"""Confirm-and-adopt pass for flash block shapes (VERDICT r4 #4).
+
+The r4 sweep (results/flash_attention_holes_r4.json t2048_block_sweep)
+saw (block_q=128, block_k=1024) at 1.62x dense at T=2048 — UNCONFIRMED
+single reading. This script re-measures the short-T regime with repeated
+independent trials in ONE process (cross-process numbers vary up to 3x
+on the tunneled chip) and emits:
+
+- per-T winners -> the BLOCK_TABLE entries to adopt in
+  ops/pallas/flash_attention.py,
+- a dense-vs-best-flash verdict per T -> whether the auto-dispatch
+  crossover in ops/attention.py can drop below 4096.
+
+Confirmation rule: a candidate must beat dense in >= 2 of 3 trials AND
+its median must beat dense's median — sub-5 ms single readings on this
+tunnel must never drive retunes (r4 lesson, recorded in
+flash_attention_holes_r4.json).
+
+Protocol per reading: marginal fwd+bwd from two chained-scan lengths,
+all three grads feeding the carry, device-computed scalar readback.
+Run alone on the real chip. Writes results/flash_blocks_r5.json.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+from fedml_tpu.ops.attention import multihead_attention  # noqa: E402
+from fedml_tpu.ops.pallas.flash_attention import flash_attention  # noqa: E402
+
+N1, N2 = 4, 36
+TRIALS = 3
+SHAPES = ((1024, 4, 8), (2048, 4, 8), (4096, 4, 8))
+# candidates per T: auto square, the r4 rectangular winner family, and
+# the transposed rectangle as a control
+CANDS = {
+    1024: ((512, 512), (128, 1024), (1024, 128), (128, 512), (256, 256)),
+    2048: ((1024, 1024), (128, 1024), (1024, 128), (128, 2048), (256, 1024),
+           (128, 512)),
+    4096: ((1024, 1024), (128, 1024), (256, 1024), (128, 2048)),
+}
+
+if "--smoke" in sys.argv:  # CPU interpret-mode plumbing check only
+    N1, N2, TRIALS = 1, 3, 2
+    SHAPES = ((256, 1, 2),)
+    CANDS = {256: ((128, 128), (128, 256))}
+
+
+def timed_train(fn, q, k, v):
+    grad = jax.grad(lambda q, k, v: jnp.sum(
+        fn(q, k, v).astype(jnp.float32) ** 2), argnums=(0, 1, 2))
+    res = {}
+    for n in (N1, N2):
+        @jax.jit
+        def loop(q, k, v):
+            def body(c, _):
+                dq, dk, dv = grad(c, k, v)
+                return c + 1e-12 * (dq + dk + dv), None
+            c, _ = jax.lax.scan(body, q, None, length=n)
+            return jnp.sum(c.astype(jnp.float32))
+        float(loop(q, k, v))  # compile
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(loop(q, k, v))
+            ts.append(time.perf_counter() - t0)
+        res[n] = min(ts)
+    return (res[N2] - res[N1]) / (N2 - N1)
+
+
+def qkv(T, B, H, Dh=64):
+    ks = jax.random.split(jax.random.PRNGKey(0), 3)
+    return tuple(jax.random.normal(k, (B, T, H, Dh), jnp.bfloat16) * 0.3
+                 for k in ks)
+
+
+def median(xs):
+    s = sorted(xs)
+    return s[len(s) // 2]
+
+
+def main():
+    print("devices:", jax.devices(), flush=True)
+    out = {
+        "protocol": (f"marginal fwd+bwd from chained-scan lengths {N1}/{N2}"
+                     f", min of 3 walls per length, {TRIALS} independent "
+                     "trials per config interleaved with dense, "
+                     "median-of-trials decides"),
+        "dtype": "bf16", "Dh": 64, "points": [],
+        "table_adopt": {}, "crossover": {},
+    }
+    for T, B, H in SHAPES:
+        q, k, v = qkv(T, B, H)
+        pt = {"T": T, "B": B, "H": H, "dense_ms": [], "cands": {}}
+        # interleave trials: dense, then each candidate, repeated — a slow
+        # tunnel phase hits all configs equally instead of one
+        for _trial in range(TRIALS):
+            md = timed_train(lambda q, k, v: multihead_attention(
+                q, k, v, causal=True, impl="dense"), q, k, v)
+            pt["dense_ms"].append(round(md * 1e3, 3))
+            for bq, bk in CANDS[T]:
+                # per-candidate LIST always; failures append a sentinel so
+                # a transient tunnel error neither crashes the sweep nor
+                # overwrites good readings (review finding)
+                readings = pt["cands"].setdefault(f"{bq}x{bk}", [])
+                try:
+                    m = timed_train(lambda q, k, v: flash_attention(
+                        q, k, v, causal=True, block_q=bq, block_k=bk),
+                        q, k, v)
+                    readings.append(round(m * 1e3, 3))
+                except Exception as e:
+                    readings.append(f"failed: {repr(e)[:120]}")
+            print(f"T={T} trial done: dense={pt['dense_ms'][-1]} ms",
+                  flush=True)
+        dmed = median(pt["dense_ms"])
+        best_key, best_med = None, None
+        for key, ms in pt["cands"].items():
+            good = [m for m in ms if isinstance(m, (int, float))]
+            if len(good) < TRIALS:
+                # record WHY it's out — 'lost' and 'not fully measured'
+                # must be distinguishable in the artifact (review finding)
+                pt.setdefault("verdicts", {})[key] = {
+                    "trials_ok": len(good), "excluded": True,
+                    "confirmed": False,
+                }
+                continue
+            wins = sum(m < pt["dense_ms"][i] for i, m in enumerate(good))
+            cmed = median(good)
+            pt.setdefault("verdicts", {})[key] = {
+                "median_ms": cmed, "wins_vs_dense": wins,
+                "vs_dense": round(dmed / cmed, 3),
+                "confirmed": wins >= 2 and cmed < dmed,
+            }
+            if best_med is None or cmed < best_med:
+                best_key, best_med = key, cmed
+        pt["dense_median_ms"] = dmed
+        pt["best"] = best_key
+        out["points"].append(pt)
+        if best_key and pt["verdicts"][best_key]["confirmed"]:
+            bq, bk = (int(x) for x in best_key.split("x"))
+            out["table_adopt"][T] = [bq, bk]
+            out["crossover"][T] = "flash"
+        else:
+            out["crossover"][T] = "dense"
+        print(json.dumps(pt), flush=True)
+
+    out["recommendation"] = (
+        "adopt table_adopt into BLOCK_TABLE; lower auto_attention_impl "
+        "crossover to the smallest T whose crossover says 'flash' (only "
+        "if contiguous up to 4096)")
+    with open("results/flash_blocks_r5.json", "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print("wrote results/flash_blocks_r5.json", flush=True)
+
+
+if __name__ == "__main__":
+    main()

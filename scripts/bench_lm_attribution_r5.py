@@ -1,0 +1,313 @@
+"""Op-level attribution of the flagship LM step vs the MEASURED chip
+ceiling (VERDICT r4 #3).
+
+The r4 headline (48-51% of nominal 197 TF/s) leaves ~75% of the chip's
+measured ~400 TF/s bf16 dense ceiling unexplained. This script breaks the
+dim-1024/12-layer flagship step into op groups, times each with the
+corrected protocol (chained-scan marginals, device-computed scalar
+readbacks, same-process comparisons only), and pulls the levers found:
+
+measured groups per (T, B):
+  full_step      fwd + bwd + AdamW (best config: remat + chunked CE)
+  fwd_bwd        loss grad only            -> opt = full_step - fwd_bwd
+  fwd_only       loss value only           -> bwd = fwd_bwd - fwd_only
+  attention      12x flash fwd+bwd at the model's (B, T, 16, 64)
+  ce_chunked     chunked CE fwd+bwd on (B, T, D) hidden + (D, V) head
+  adamw_only     opt.update + apply over a fixed grad tree
+  matmul_core    the step's big matmuls (qkv/proj/mlp/head) fwd+bwd
+  hbm_bw         elementwise-pass GB/s (memory-bound denominator)
+
+Each group records FLOPs, a bytes-moved estimate, achieved TF/s, and a
+bound verdict: compute-bound (time ~ flops/400TF) vs memory-bound
+(time ~ bytes/measured-BW). levers: mu_dtype=bf16, batch growth, and the
+flash BLOCK_TABLE from results/flash_blocks_r5.json when present.
+
+Run alone on the real chip. Writes results/lm_mfu_bench_r5.json.
+CPU plumbing check: --smoke (tiny shapes, numbers meaningless).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+sys.path.insert(0, ".")
+from fedml_tpu.models.transformer import TransformerLM  # noqa: E402
+from fedml_tpu.ops.losses import chunked_lm_cross_entropy  # noqa: E402
+from fedml_tpu.ops.pallas.flash_attention import (  # noqa: E402
+    BLOCK_TABLE, flash_attention)
+
+NOMINAL_TF = 197.0
+MEASURED_TF = 400.0
+VOCAB, DIM, LAYERS, HEADS = 32000, 1024, 12, 16
+DH = DIM // HEADS
+N1, N2 = 3, 23
+POINTS = ((2048, 8), (2048, 16), (8192, 4))
+SMOKE = "--smoke" in sys.argv
+if SMOKE:
+    VOCAB, DIM, LAYERS, HEADS = 256, 64, 2, 4
+    DH = DIM // HEADS
+    N1, N2 = 1, 3
+    POINTS = ((256, 2),)
+
+
+def marginal(build_loop) -> float:
+    """build_loop(n) -> jitted fn returning a scalar; marginal sec/step."""
+    res = {}
+    for n in (N1, N2):
+        f = build_loop(n)
+        float(f())  # compile + warm
+        ts = []
+        for _ in range(4):
+            t0 = time.perf_counter()
+            float(f())
+            ts.append(time.perf_counter() - t0)
+        res[n] = min(ts)
+    return (res[N2] - res[N1]) / (N2 - N1)
+
+
+def scan_loop(step_fn, carry_init):
+    """Standard chained-scan harness: step_fn(carry) -> carry."""
+    def build(n):
+        @jax.jit
+        def run():
+            def body(c, _):
+                return step_fn(c), None
+            c, _ = jax.lax.scan(body, carry_init, None, length=n)
+            return jax.tree_util.tree_reduce(
+                lambda a, l: a + l.astype(jnp.float32).sum() * 1e-12,
+                jax.tree_util.tree_leaves(c), 0.0)
+        return run
+    return build
+
+
+def bound_verdict(sec, flops, bytes_moved, bw_gbs):
+    t_flops = flops / (MEASURED_TF * 1e12)
+    t_mem = bytes_moved / (bw_gbs * 1e9) if bw_gbs else 0.0
+    pred = max(t_flops, t_mem)
+    return {
+        "tflops_per_sec": round(flops / sec / 1e12, 1),
+        "pct_of_measured_ceiling": round(100 * flops / sec / 1e12
+                                         / MEASURED_TF, 1),
+        "compute_floor_ms": round(t_flops * 1e3, 3),
+        "memory_floor_ms": round(t_mem * 1e3, 3),
+        "measured_ms": round(sec * 1e3, 3),
+        "bound": ("memory" if t_mem > t_flops else "compute"),
+        "efficiency_vs_floor": round(pred / sec, 2) if sec > 0 else None,
+    }
+
+
+def main():
+    print("devices:", jax.devices(), flush=True)
+    out = {"model": {"vocab": VOCAB, "dim": DIM, "layers": LAYERS,
+                     "heads": HEADS},
+           "protocol": (f"chained-scan marginal {N1}/{N2}, min of 4 walls, "
+                        "scalar readback; same-process comparisons only"),
+           "denominators": {"nominal_tf": NOMINAL_TF,
+                           "measured_ceiling_tf": MEASURED_TF},
+           "points": []}
+
+    # adopt confirmed flash blocks if the r5 sweep artifact exists
+    fb = "results/flash_blocks_r5.json"
+    if os.path.exists(fb):
+        adopt = json.load(open(fb)).get("table_adopt", {})
+        for tt, (bq, bk) in adopt.items():
+            BLOCK_TABLE[int(tt)] = (bq, bk)
+        out["flash_block_table"] = {int(t): v for t, v in adopt.items()}
+
+    # --- HBM bandwidth denominator --------------------------------------
+    nbytes = 1 << 28 if not SMOKE else 1 << 20  # 256 MB bf16 elements
+    big = jnp.ones(nbytes // 2, jnp.bfloat16)
+    sec = marginal(scan_loop(lambda x: x * 1.000001, big))
+    bw_gbs = 2 * nbytes / sec / 1e9  # one read + one write per pass
+    out["hbm_bw_gbs"] = round(bw_gbs, 1)
+    print(f"hbm bw: {bw_gbs:.0f} GB/s", flush=True)
+
+    for T, B in POINTS:
+        pt = {"T": T, "B": B, "groups": {}}
+        model = TransformerLM(vocab_size=VOCAB, dim=DIM, num_heads=HEADS,
+                              num_layers=LAYERS, max_len=max(T, 2048),
+                              dtype=jnp.bfloat16, remat=True)
+        rng = jax.random.PRNGKey(0)
+        tokens = jax.random.randint(rng, (B, T), 0, VOCAB)
+        params = model.init(rng, tokens[:, :8])
+        n_params = sum(x.size for x in jax.tree_util.tree_leaves(params))
+        n_active = n_params - (VOCAB * DIM + max(T, 2048) * DIM)
+        ce_chunk = 256 if T % 256 == 0 else T // 4
+
+        def loss_fn(p, toks):
+            hid = model.apply(p, toks, train=True, return_hidden=True)
+            head = p["params"]["head"]["kernel"].astype(hid.dtype)
+            return chunked_lm_cross_entropy(hid, head,
+                                            jnp.roll(toks, -1, axis=1),
+                                            chunk=ce_chunk)
+
+        grad_fn = jax.value_and_grad(loss_fn)
+
+        def steps_for(opt):
+            st = opt.init(params)
+
+            def full(c):
+                p, s, toks = c
+                _, g = grad_fn(p, toks)
+                up, s = opt.update(g, s, p)
+                return (optax.apply_updates(p, up), s,
+                        jnp.roll(toks, 1, axis=0))
+            return full, st
+
+        opt = optax.adamw(3e-4, weight_decay=0.01)
+        full, opt_state = steps_for(opt)
+
+        # FLOP accounting (PaLM convention)
+        toks_step = B * T
+        attn_flops = 2 * 2 * 2 * LAYERS * (T * T / 2) * DIM * B
+        fwd_flops = 2 * n_active * toks_step + attn_flops
+        train_flops = 3 * fwd_flops
+        pbytes = 4 * n_params  # f32 params
+
+        # 1. full step
+        sec = marginal(scan_loop(full, (params, opt_state, tokens)))
+        pt["groups"]["full_step"] = dict(
+            bound_verdict(sec, train_flops,
+                          # params read+write, mu/nu read+write, grads
+                          bytes_moved=pbytes * 6,
+                          bw_gbs=bw_gbs),
+            tokens_per_sec=int(toks_step / sec))
+        full_sec = sec
+
+        # 2. fwd+bwd only
+        def fwd_bwd(c):
+            p, toks = c
+            l, g = grad_fn(p, toks)
+            scale = 1e-12 * l
+            p2 = jax.tree.map(lambda a, b: a + scale * b.astype(a.dtype)
+                              if a.dtype.kind == "f" else a, p, g)
+            return (p2, jnp.roll(toks, 1, axis=0))
+        sec_fb = marginal(scan_loop(fwd_bwd, (params, tokens)))
+        pt["groups"]["fwd_bwd"] = bound_verdict(
+            sec_fb, train_flops, pbytes * 3, bw_gbs)
+
+        # 3. fwd only
+        def fwd_only(c):
+            p, toks, acc = c
+            return (p, jnp.roll(toks, 1, axis=0), acc + loss_fn(p, toks))
+        sec_f = marginal(scan_loop(fwd_only, (params, tokens, 0.0)))
+        pt["groups"]["fwd_only"] = bound_verdict(
+            sec_f, fwd_flops, pbytes, bw_gbs)
+
+        # derived splits
+        pt["derived"] = {
+            "bwd_ms": round((sec_fb - sec_f) * 1e3, 2),
+            "optimizer_ms": round((full_sec - sec_fb) * 1e3, 2),
+        }
+
+        # 4. attention isolated (12 layers' worth)
+        qkv = tuple(jax.random.normal(k, (B, T, HEADS, DH), jnp.bfloat16) * .3
+                    for k in jax.random.split(rng, 3))
+        ag = jax.grad(lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, True).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2))
+
+        def attn_step(c):
+            q = c
+            dq, dk, dv = ag(q, *qkv[1:])
+            return q + 1e-12 * (dq + dk + dv)
+        sec_a = marginal(scan_loop(attn_step, qkv[0]))
+        pt["groups"]["attention_x12"] = bound_verdict(
+            LAYERS * sec_a, 3 * attn_flops,
+            LAYERS * 3 * (3 * B * T * HEADS * DH * 2), bw_gbs)
+
+        # 5. chunked CE isolated
+        hid0 = jax.random.normal(rng, (B, T, DIM), jnp.bfloat16) * 0.3
+        head0 = params["params"]["head"]["kernel"].astype(jnp.bfloat16)
+        tgt = jnp.roll(tokens, -1, axis=1)
+        cg = jax.grad(lambda h: chunked_lm_cross_entropy(
+            h, head0, tgt, chunk=ce_chunk))
+
+        def ce_step(c):
+            return c + 1e-3 * cg(c)
+        sec_c = marginal(scan_loop(ce_step, hid0))
+        ce_flops = 3 * 2 * B * T * DIM * VOCAB
+        pt["groups"]["ce_chunked"] = bound_verdict(
+            sec_c, ce_flops, 3 * DIM * VOCAB * 2, bw_gbs)
+
+        # 6. AdamW isolated (fixed grads)
+        g0 = jax.tree.map(jnp.ones_like, params)
+
+        def adamw_step(c):
+            p, s = c
+            up, s = opt.update(g0, s, p)
+            return (optax.apply_updates(p, up), s)
+        sec_o = marginal(scan_loop(adamw_step, (params, opt.init(params))))
+        pt["groups"]["adamw_only"] = bound_verdict(
+            sec_o, 10 * n_params, pbytes * 6, bw_gbs)
+
+        # 7. matmul core: the step's big matmuls fwd+bwd (qkv, proj,
+        # mlp x2 per layer + head), as plain dense matmuls
+        x0 = jax.random.normal(rng, (B * T, DIM), jnp.bfloat16) * 0.3
+        shapes = {"qkv": (DIM, 3 * DIM), "proj": (DIM, DIM),
+                  "up": (DIM, 4 * DIM), "down": (4 * DIM, DIM),
+                  "head": (DIM, VOCAB)}
+        wm = {k: jax.random.normal(jax.random.PRNGKey(i), s, jnp.bfloat16)
+              * 0.02 for i, (k, s) in enumerate(shapes.items())}
+
+        def mm_loss(x):
+            # chain the step's big matmuls per layer so none is DCE-able
+            h = x
+            acc = jnp.float32(0)
+            for _ in range(LAYERS):
+                qkv = h @ wm["qkv"]
+                acc += jnp.sum(qkv.astype(jnp.float32) ** 2) * 1e-9
+                h = h @ wm["proj"]
+                u = h @ wm["up"]
+                h = (u @ wm["down"]) * 0.01 + h
+            logits = h @ wm["head"]
+            return acc + jnp.sum(logits.astype(jnp.float32) ** 2) * 1e-9
+        mg = jax.grad(mm_loss)
+        ws = ([shapes["qkv"], shapes["proj"], shapes["up"], shapes["down"]]
+              * LAYERS + [shapes["head"]])
+
+        def mm_step(c):
+            return c + 1e-12 * mg(c)
+        sec_m = marginal(scan_loop(mm_step, x0))
+        mm_flops = 3 * sum(2 * B * T * a * b for a, b in ws)
+        pt["groups"]["matmul_core"] = bound_verdict(
+            sec_m, mm_flops, sum(a * b for a, b in ws) * 2 * 3, bw_gbs)
+
+        # --- levers (same process) --------------------------------------
+        levers = {}
+        opt_bf = optax.adamw(3e-4, weight_decay=0.01,
+                             mu_dtype=jnp.bfloat16)
+        full_bf, st_bf = steps_for(opt_bf)
+        sec_bf = marginal(scan_loop(full_bf, (params, st_bf, tokens)))
+        levers["mu_dtype_bf16"] = {
+            "step_ms": round(sec_bf * 1e3, 2),
+            "vs_f32_mu": round(full_sec / sec_bf, 3),
+        }
+        pt["levers"] = levers
+        pt["headline"] = {
+            "best_step_ms": round(min(full_sec, sec_bf) * 1e3, 2),
+            "train_tflops_per_sec": round(
+                train_flops / min(full_sec, sec_bf) / 1e12, 1),
+            "mfu_vs_nominal": round(
+                train_flops / min(full_sec, sec_bf) / 1e12 / NOMINAL_TF, 3),
+            "mfu_vs_measured_ceiling": round(
+                train_flops / min(full_sec, sec_bf) / 1e12 / MEASURED_TF, 3),
+        }
+        out["points"].append(pt)
+        print(json.dumps(pt), flush=True)
+
+    with open("results/lm_mfu_bench_r5.json", "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print("wrote results/lm_mfu_bench_r5.json", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,152 @@
+"""Flat-carry validation + lane re-sweep on the flagship bench workload
+(VERDICT r4 #1b/#1c).
+
+Round 4 attributed the packed-step cost to per-leaf update/flush/reset
+ops over ~173 tensors and built the flat-carry executor (one ravelled
+vector per lane; 5.08 -> 3.16 ms/step in the 2-lane microbench) — but
+the tunnel died before end-to-end chip validation, and the lane count
+was never re-swept under flat carry (with the per-leaf cost gone, more
+lanes may win: padded-work reduction returns as the dominant term).
+
+This script, run alone on the real chip:
+1. parity: 3 bench rounds flat vs tree carry — params must agree to
+   bf16-accumulation tolerance (the CPU parity tests are exact; this
+   guards the TPU compilation path).
+2. rate A/B at lanes=2: tree vs flat end-to-end rounds/sec (the bench.py
+   protocol: wall around sim.run over compiled-shape-warm blocks).
+3. lane sweep under flat carry: lanes in {1, 2, 4, 8} (pow2: compiled
+   (G, L_pad) shape reuse round-to-round — see packed-lane notes),
+   median block rate each.
+
+Writes results/lane_sweep_r5.json; prints the winning (carry, lanes)
+combo to adopt as bench.py defaults.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, ".")
+
+
+SMOKE = "--smoke" in sys.argv  # CPU plumbing check: tiny model/data
+
+
+def build(lanes: int, flat: bool, rounds: int = 6):
+    import fedml_tpu
+    from fedml_tpu.simulation import build_simulator
+
+    cfg = dict(
+        dataset="cifar10", model="resnet56", partition_method="hetero",
+        partition_alpha=0.5, client_num_in_total=100,
+        client_num_per_round=10, comm_round=rounds, learning_rate=0.01,
+        epochs=1, batch_size=64, frequency_of_the_test=10_000,
+        random_seed=0, use_bf16=True, packed_lanes=lanes,
+        packed_flat_carry=flat,
+    )
+    if SMOKE:
+        cfg.update(model="resnet8", debug_small_data=True, batch_size=8,
+                   client_num_in_total=20, client_num_per_round=4,
+                   cohort_schedule="packed")
+    args = fedml_tpu.init(config=cfg)
+    sim, apply_fn = build_simulator(args)
+    assert sim._packed
+    return sim
+
+
+def timed_rate(sim, blocks: int = 3, rounds: int = 6) -> list:
+    sim.run(apply_fn=None, log_fn=None)   # compile + upload
+    sim.history.clear()
+    sim.run(apply_fn=None, log_fn=None)   # burn-in block
+    rates = []
+    for _ in range(blocks):
+        sim.history.clear()
+        t0 = time.perf_counter()
+        sim.run(apply_fn=None, log_fn=None)
+        rates.append(rounds / (time.perf_counter() - t0))
+    return sorted(rates)
+
+
+def flat_params(sim):
+    import jax
+
+    return np.concatenate([
+        np.asarray(x, np.float32).ravel()
+        for x in jax.tree_util.tree_leaves(sim.params)])
+
+
+def main():
+    import jax
+
+    print("devices:", jax.devices(), flush=True)
+    out = {"workload": "bench.py flagship (FedAvg CIFAR-10 ResNet-56, "
+                       "10 clients x bs64, packed)",
+           "protocol": "wall around sim.run, warm + burn-in block, "
+                       "median of 3 blocks of 6 rounds"}
+
+    # 1. on-chip parity flat vs tree (3 rounds)
+    p = {}
+    for flat in (False, True):
+        sim = build(2, flat, rounds=3)
+        sim.run(apply_fn=None, log_fn=None)
+        p["flat" if flat else "tree"] = flat_params(sim)
+    d = np.abs(p["flat"] - p["tree"])
+    denom = np.maximum(np.abs(p["tree"]), 1e-6)
+    out["parity_3rounds"] = {
+        "max_abs_diff": float(d.max()),
+        "max_rel_diff": float((d / denom).max()),
+        # bf16 accumulation: chaotic divergence is possible over many
+        # steps; 3 rounds should stay within loose tolerance
+        "pass": bool(float((d / denom).max()) < 0.05
+                     or float(d.max()) < 5e-3),
+    }
+    print("parity:", out["parity_3rounds"], flush=True)
+
+    # 2. A/B at lanes=2
+    ab = {}
+    for flat in (False, True):
+        sim = build(2, flat)
+        rates = timed_rate(sim)
+        ab["flat" if flat else "tree"] = {
+            "block_rates": [round(r, 3) for r in rates],
+            "median_rps": round(rates[len(rates) // 2], 4),
+        }
+        print(f"lanes=2 flat={flat}: {ab['flat' if flat else 'tree']}",
+              flush=True)
+    ab["speedup"] = round(
+        ab["flat"]["median_rps"] / ab["tree"]["median_rps"], 3)
+    out["ab_lanes2"] = ab
+
+    # 3. lane sweep under flat carry
+    sweep = {}
+    for lanes in ((1, 2) if SMOKE else (1, 2, 4, 8)):
+        sim = build(lanes, True)
+        rates = timed_rate(sim)
+        sweep[lanes] = {
+            "block_rates": [round(r, 3) for r in rates],
+            "median_rps": round(rates[len(rates) // 2], 4),
+            "packed_shape": list(getattr(sim, "_last_packed_shape", ())),
+        }
+        print(f"flat lanes={lanes}: {sweep[lanes]}", flush=True)
+    out["flat_lane_sweep"] = sweep
+    best_lanes = max(sweep, key=lambda k: sweep[k]["median_rps"])
+    out["winner"] = {
+        "carry": ("flat" if ab["flat"]["median_rps"]
+                  >= ab["tree"]["median_rps"] else "tree"),
+        "lanes": best_lanes,
+        "median_rps": sweep[best_lanes]["median_rps"],
+    }
+    print("winner:", out["winner"], flush=True)
+
+    with open("results/lane_sweep_r5.json", "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print("wrote results/lane_sweep_r5.json", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -9,6 +9,7 @@ absent.
 import os
 
 import numpy as np
+import pytest
 
 import fedml_tpu
 from fedml_tpu import data as data_mod
@@ -133,9 +134,11 @@ def test_chexpert_layout():
     assert infer_loss_kind(object(), fed) == "bce"
 
 
+@pytest.mark.slow
 def test_chexpert_e2e_learns():
     """Real-format CheXpert fixtures through the full engine with the bce
-    loss: loss must drop (labels are image-correlated by construction)."""
+    loss: loss must drop (labels are image-correlated by construction).
+    Slow tier: 64x64 conv compiles dominate (~1 min on one CPU core)."""
     args = _args("chexpert", os.path.join(FIX, "chexpert"),
                  model="cnn_fedavg", comm_round=6, learning_rate=0.05,
                  epochs=2, batch_size=4, client_num_in_total=2,
