@@ -1,0 +1,99 @@
+"""bench.py outage contract: the driver artifact must ALWAYS parse.
+
+Round 4 lost its only trusted perf number because one transient tunnel
+outage left BENCH_r04.json as bare rc=1 with a traceback tail. The
+hardened bench must print exactly one JSON line with an "error" field on
+any failure path (backend unavailable, bench crash, unreadable
+baseline)."""
+
+import io
+import json
+import sys
+from contextlib import redirect_stdout
+
+import bench
+
+
+def _run_main(monkeypatch, **patches):
+    for name, val in patches.items():
+        monkeypatch.setattr(bench, name, val, raising=True)
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = bench.main()
+    lines = [ln for ln in buf.getvalue().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"expected exactly one stdout line: {lines}"
+    return rc, json.loads(lines[0])
+
+
+def _unavailable(monkeypatch):
+    import fedml_tpu.utils.chip_probe as cp
+
+    monkeypatch.setattr(
+        cp, "wait_for_chip",
+        lambda *a, **k: (False, "probe hung >240s (backend init stuck)"))
+
+
+def test_backend_unavailable_emits_error_json(monkeypatch):
+    _unavailable(monkeypatch)
+    rc, rec = _run_main(monkeypatch)
+    assert rc == 1
+    assert rec["metric"] == "fedavg_cifar10_resnet56_rounds_per_sec"
+    assert rec["value"] is None and rec["vs_baseline"] is None
+    assert "unavailable" in rec["error"]
+    assert "probe hung" in rec["error"]
+
+
+def test_bench_crash_emits_error_json(monkeypatch):
+    import fedml_tpu.utils.chip_probe as cp
+
+    monkeypatch.setattr(cp, "wait_for_chip", lambda *a, **k: (True, "ok"))
+
+    def boom():
+        raise RuntimeError("mid-bench tunnel drop")
+
+    rc, rec = _run_main(monkeypatch, run_bench=boom)
+    assert rc == 1
+    assert rec["value"] is None
+    assert "RuntimeError: mid-bench tunnel drop" in rec["error"]
+
+
+def test_success_emits_value(monkeypatch):
+    import fedml_tpu.utils.chip_probe as cp
+
+    monkeypatch.setattr(cp, "wait_for_chip", lambda *a, **k: (True, "ok"))
+    rc, rec = _run_main(monkeypatch, run_bench=lambda: 6.25)
+    assert rc == 0
+    assert rec["value"] == 6.25
+    assert "error" not in rec
+    assert rec["vs_baseline"] > 0
+
+
+def test_unreadable_baseline_still_emits(monkeypatch):
+    _unavailable(monkeypatch)
+    monkeypatch.setattr(
+        bench, "load_baseline",
+        lambda: (_ for _ in ()).throw(ValueError("corrupt json")))
+    rc, rec = _run_main(monkeypatch)
+    assert rc == 1
+    assert "undocumented-1.0" in rec["unit"]
+
+
+def test_cpu_fallback_counts_as_unavailable(monkeypatch):
+    """probe_once must report a cpu-fallback success as failure — the
+    bench must never silently measure CPU (review contract). The probe
+    subprocess is faked to echo a cpu-platform result."""
+    import subprocess
+    import sys as _sys
+
+    from fedml_tpu.utils import chip_probe
+
+    real_run = subprocess.run
+
+    def forced_cpu(cmd, **kw):
+        return real_run([_sys.executable, "-c",
+                         "print('CHIP_PROBE cpu 42.0')"],
+                        capture_output=True, text=True)
+
+    monkeypatch.setattr(chip_probe.subprocess, "run", forced_cpu)
+    ok, detail = chip_probe.probe_once(timeout=30)
+    assert not ok and "cpu" in detail
