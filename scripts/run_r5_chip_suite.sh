@@ -1,0 +1,43 @@
+#!/usr/bin/env bash
+# Round-5 chip measurement suite: runs every staged on-chip task in
+# dependency order, one JAX process at a time (the tunnel wedges under
+# concurrent holders). Safe to re-run; each stage logs to results/.
+#
+#   ./scripts/run_r5_chip_suite.sh [probe_attempts] [probe_sleep_s]
+#
+# Order:
+#   1. availability probe (bounded)
+#   2. flash block confirmation  -> results/flash_blocks_r5.json
+#      (bench_lm_attribution auto-adopts its table_adopt output)
+#   3. LM step op attribution    -> results/lm_mfu_bench_r5.json
+#   4. flat-carry validation + lane re-sweep -> results/lane_sweep_r5.json
+#   5. the flagship bench        -> one JSON line on stdout
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+ATTEMPTS=${1:-3}
+SLEEP=${2:-120}
+
+echo "[suite] probing chip (${ATTEMPTS} attempts)..."
+if ! python scripts/probe_chip.py "$ATTEMPTS" "$SLEEP"; then
+    echo "[suite] chip unavailable; aborting (re-run when the tunnel is up)"
+    exit 1
+fi
+
+run_stage() {
+    local name=$1; shift
+    echo "[suite] === $name ==="
+    if ! timeout 3600 "$@" 2>&1 | tee "results/${name}.log"; then
+        echo "[suite] $name FAILED (continuing — stages are independent)"
+    fi
+    # post-kill settle: a failed/killed JAX process wedges the tunnel
+    # claim for minutes
+    sleep 60
+}
+
+run_stage flash_blocks_r5      python -u scripts/bench_flash_blocks_r5.py
+run_stage lm_attribution_r5    python -u scripts/bench_lm_attribution_r5.py
+run_stage lane_sweep_r5        python -u scripts/lane_sweep_r5.py
+echo "[suite] === bench.py ==="
+timeout 3600 python bench.py | tee results/bench_r5.log
+echo "[suite] done; artifacts under results/"
