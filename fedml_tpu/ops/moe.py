@@ -124,3 +124,25 @@ class MoEBlock(nn.Module):
         expert_out = jnp.einsum("ech,ehd->ecd", hidden, w_out)
         out = jnp.einsum("nec,ecd->nd", combine.astype(self.dtype), expert_out)
         return out.reshape(B, T, D), aux
+
+
+EXPERT_STACKED_LEAVES = ("w_in", "w_out")
+
+
+def expert_param_shardings(mesh, params):
+    """NamedShardings for a ``MoEBlock`` param tree on a mesh with an
+    ``AXIS_EXPERT`` axis: the stacked expert kernels shard over the expert
+    axis, everything else (gate, norms) replicates. The ONE place the
+    expert-stacked leaf names live — used by the EP dryrun plane and the
+    expert-parallel tests alike."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..parallel.mesh import AXIS_EXPERT
+
+    def spec_for(path, leaf):
+        names = [str(getattr(p, "key", p)) for p in path]
+        which = (P(AXIS_EXPERT) if names[-1] in EXPERT_STACKED_LEAVES
+                 else P())
+        return NamedSharding(mesh, which)
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)

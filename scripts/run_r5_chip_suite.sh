@@ -24,11 +24,13 @@ if ! python scripts/probe_chip.py "$ATTEMPTS" "$SLEEP"; then
     exit 1
 fi
 
+FAILED=0
 run_stage() {
     local name=$1; shift
     echo "[suite] === $name ==="
     if ! timeout 3600 "$@" 2>&1 | tee "results/${name}.log"; then
         echo "[suite] $name FAILED (continuing — stages are independent)"
+        FAILED=1
     fi
     # post-kill settle: a failed/killed JAX process wedges the tunnel
     # claim for minutes
@@ -38,6 +40,9 @@ run_stage() {
 run_stage flash_blocks_r5      python -u scripts/bench_flash_blocks_r5.py
 run_stage lm_attribution_r5    python -u scripts/bench_lm_attribution_r5.py
 run_stage lane_sweep_r5        python -u scripts/lane_sweep_r5.py
-echo "[suite] === bench.py ==="
-timeout 3600 python bench.py | tee results/bench_r5.log
+run_stage bench_r5             python bench.py
+if [ "$FAILED" -ne 0 ]; then
+    echo "[suite] done WITH FAILURES — check results/*.log"
+    exit 1
+fi
 echo "[suite] done; artifacts under results/"

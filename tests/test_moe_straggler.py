@@ -26,19 +26,17 @@ def test_moe_block_runs_and_shards():
 
     from fedml_tpu.parallel import AXIS_DATA, AXIS_EXPERT, MeshConfig, create_mesh
 
+    from fedml_tpu.ops.moe import expert_param_shardings
+
     mesh = create_mesh(MeshConfig(axes=((AXIS_DATA, 2), (AXIS_EXPERT, 4))))
     block = MoEBlock(num_experts=4, dim=32, hidden_mult=2)
     x = jnp.asarray(np.random.default_rng(1).normal(size=(4, 8, 32)), jnp.float32)
     params = block.init(jax.random.PRNGKey(0), x)
-
-    def spec_for(path, leaf):
-        names = [str(getattr(p, "key", p)) for p in path]
-        if names[-1] in ("w_in", "w_out"):
-            return NamedSharding(mesh, P(AXIS_EXPERT))
-        return NamedSharding(mesh, P())
-
-    shardings = jax.tree_util.tree_map_with_path(spec_for, params)
-    params = jax.device_put(params, shardings)
+    params = jax.device_put(params, expert_param_shardings(mesh, params))
+    # the helper's contract: expert-stacked kernels sharded, gate replicated
+    shardings = expert_param_shardings(mesh, params)
+    assert shardings["params"]["w_in"].spec == P(AXIS_EXPERT)
+    assert shardings["params"]["gate"]["kernel"].spec == P()
     x_sh = jax.device_put(x, NamedSharding(mesh, P(AXIS_DATA)))
     out, aux = jax.jit(lambda p, x: block.apply(p, x))(params, x_sh)
     assert out.shape == x.shape
