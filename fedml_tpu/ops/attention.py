@@ -33,11 +33,18 @@ def auto_attention_impl(B: int, H: int, T: int, Dh: int,
     12-layer stack at B=16 H=16 T=2048 pins 26 GB. Prefer flash whenever
     one layer's saved tensor crosses 512 MB (a meaningful slice of 16 GB
     HBM once multiplied by typical depths).
+
+    A BLOCK_TABLE entry for T (ops/pallas/flash_attention.py — populated
+    only from confirmed on-chip sweeps, scripts/bench_flash_blocks_r5.py)
+    means flash measured at-or-faster than dense at that length with the
+    tabled blocks, so it lowers the crossover for exactly that T.
     """
     from .pallas import flash_shapes_ok
+    from .pallas.flash_attention import BLOCK_TABLE
 
     dense_saved_bytes = B * H * T * T * itemsize
-    want_flash = T >= 4096 or dense_saved_bytes > 512 * 1024**2
+    want_flash = (T >= 4096 or dense_saved_bytes > 512 * 1024**2
+                  or T in BLOCK_TABLE)
     if want_flash and flash_shapes_ok(T, Dh, itemsize=itemsize):
         return "flash"
     return "dense"
