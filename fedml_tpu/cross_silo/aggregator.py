@@ -34,9 +34,20 @@ class FedMLAggregator:
         args,
         model_params: PyTree,
         apply_fn=None,
+        train_data_local_dict=None,
+        test_data_local_dict=None,
+        loss_kind: str = "ce",
     ):
         self.args = args
         self.test_global = test_global
+        # per-client local splits: when present, eval rounds report the
+        # reference MPI aggregator's weighted per-client train/test stats
+        # (FedAVGAggregator.test_on_server_for_all_clients) instead of the
+        # global-set accuracy alone
+        self.train_data_local_dict = train_data_local_dict
+        self.test_data_local_dict = test_data_local_dict
+        self.loss_kind = loss_kind
+        self._local_eval_fn = None
         self.all_train_data_num = all_train_data_num
         self.client_num = client_num
         self.apply_fn = apply_fn
@@ -152,9 +163,69 @@ class FedMLAggregator:
         )
 
     def test_on_server_for_all_clients(self, round_idx: int) -> Optional[Dict[str, float]]:
-        if self.apply_fn is None or self.test_global is None:
+        if self.apply_fn is None:
             return None
-        logits = self.apply_fn(self.model_params, jnp.asarray(self.test_global.x), train=False)
-        acc = float((jnp.argmax(logits, -1) == jnp.asarray(self.test_global.y)).mean())
-        logging.info("round %d server test_acc=%.4f", round_idx, acc)
-        return {"test_acc": acc}
+        out: Dict[str, float] = {}
+        if self.test_data_local_dict is not None:
+            out.update(self._local_test_on_all_clients())
+        if self.test_global is not None and len(self.test_global.x):
+            logits = self.apply_fn(
+                self.model_params, jnp.asarray(self.test_global.x), train=False)
+            acc = float((jnp.argmax(logits, -1)
+                         == jnp.asarray(self.test_global.y)).mean())
+            logging.info("round %d server test_acc=%.4f", round_idx, acc)
+            out["test_acc"] = acc
+        return out or None
+
+    def _local_test_on_all_clients(self) -> Dict[str, float]:
+        """Reference MPI ``test_on_server_for_all_clients``
+        (simulation/mpi/fedavg/FedAVGAggregator.py:128-180): evaluate the
+        CURRENT global params on every client's local train and test split;
+        report sample-weighted aggregates. Clients without local test data
+        are excluded from both sides (the reference's ``continue``).
+        Cross-silo cohorts are small (a handful of silos), so a per-client
+        padded-batch loop over one jitted eval is the right shape here —
+        the simulation engine's segmented single-program pass exists for
+        the 100+-client regime (simulation/fed_sim.py)."""
+        from ..algorithms.local_sgd import make_eval_fn
+        from ..simulation.fed_sim import FedSimulator
+
+        if self._local_eval_fn is None:
+            self._local_eval_fn = jax.jit(
+                lambda p, xs, ys, ms: jax.lax.scan(
+                    lambda c, b: (tuple(
+                        a + v for a, v in zip(
+                            c, make_eval_fn(self.apply_fn, self.loss_kind)(
+                                p, *b))), None),
+                    (0.0, 0.0, 0.0), (xs, ys, ms))[0])
+        keys = sorted(set((self.train_data_local_dict or {}).keys())
+                      | set((self.test_data_local_dict or {}).keys()))
+        out: Dict[str, float] = {}
+        for split, d, prefix in (
+            ("train", self.train_data_local_dict, "local_train"),
+            ("test", self.test_data_local_dict, "local_test"),
+        ):
+            if d is None:
+                continue
+            loss_sum = correct = valid = 0.0
+            for k in keys:
+                tpair = (self.test_data_local_dict or {}).get(k)
+                if tpair is None or len(tpair) == 0:
+                    continue  # reference: skip the client on BOTH sides
+                pair = d.get(k)
+                if pair is None or len(pair) == 0:
+                    continue
+                # fixed batch width: padded rows are exactly masked, and a
+                # size-dependent bs would force one XLA recompile per
+                # distinct client split size
+                xs, ys, ms = FedSimulator._pad_and_batch(pair.x, pair.y, 256)
+                ls, c, v = self._local_eval_fn(self.model_params, xs, ys, ms)
+                loss_sum += float(ls)
+                correct += float(c)
+                valid += float(v)
+            if valid > 0:
+                # no keys at all when nothing was evaluated — 0.0/0.0
+                # would be indistinguishable from a perfect-loss model
+                out[f"{prefix}_loss"] = loss_sum / valid
+                out[f"{prefix}_acc"] = correct / valid
+        return out

@@ -63,6 +63,9 @@ def FedML_Horizontal(args, client_rank: int, client_num: int, comm=None,
     manager so callers control the thread/process it runs on."""
     fed_data, variables, apply_fn, local_update = _assemble(args, mesh)
     if client_rank == 0:
+        from ..algorithms.local_sgd import infer_loss_kind
+
+        local_eval = bool(getattr(args, "local_test_on_all_clients", False))
         aggregator = FedMLAggregator(
             fed_data.test_data_global,
             fed_data.train_data_global,
@@ -71,6 +74,14 @@ def FedML_Horizontal(args, client_rank: int, client_num: int, comm=None,
             args,
             variables,
             apply_fn=apply_fn,
+            # per-client local-test evaluation at eval rounds (reference
+            # MPI FedAVGAggregator semantics) — opt-in, like the engine;
+            # the eval loss family must match what training used
+            train_data_local_dict=(
+                fed_data.train_data_local_dict if local_eval else None),
+            test_data_local_dict=(
+                fed_data.test_data_local_dict if local_eval else None),
+            loss_kind=infer_loss_kind(args, fed_data),
         )
         return FedMLServerManager(
             args, aggregator, comm=comm, rank=0, client_num=client_num,

@@ -37,14 +37,17 @@ def auto_attention_impl(B: int, H: int, T: int, Dh: int,
     A BLOCK_TABLE entry for T (ops/pallas/flash_attention.py — populated
     only from confirmed on-chip sweeps, scripts/bench_flash_blocks_r5.py)
     means flash measured at-or-faster than dense at that length with the
-    tabled blocks, so it lowers the crossover for exactly that T.
+    tabled blocks, so it lowers the crossover for exactly that T — but
+    only at the SWEPT shape family (Dh=64 bf16): at other Dh/itemsize the
+    kernel's guards would reject the tabled blocks and run unmeasured
+    auto squares, a config the table says nothing about.
     """
     from .pallas import flash_shapes_ok
     from .pallas.flash_attention import BLOCK_TABLE
 
     dense_saved_bytes = B * H * T * T * itemsize
     want_flash = (T >= 4096 or dense_saved_bytes > 512 * 1024**2
-                  or T in BLOCK_TABLE)
+                  or (T in BLOCK_TABLE and Dh == 64 and itemsize == 2))
     if want_flash and flash_shapes_ok(T, Dh, itemsize=itemsize):
         return "flash"
     return "dense"

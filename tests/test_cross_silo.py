@@ -112,3 +112,19 @@ def test_cross_silo_mqtt_s3_real_wire_full_run(tmp_path):
         # every rank held its own live MQTT session on the broker
     finally:
         broker.close()
+
+
+def test_cross_silo_per_client_local_eval():
+    """local_test_on_all_clients=True: eval rounds report the reference
+    MPI aggregator's weighted per-client local train/test stats
+    (FedAVGAggregator.py:128-180 semantics) alongside the global acc."""
+    args = _args(local_test_on_all_clients=True)
+    server = _run_deployment(args, n_clients=2)
+    assert len(server.history) == 3
+    for rec in server.history:
+        for key in ("local_train_acc", "local_train_loss",
+                    "local_test_acc", "local_test_loss", "test_acc"):
+            assert key in rec, (key, rec)
+        assert 0.0 <= rec["local_train_acc"] <= 1.0
+    # training on MNIST LR: local-train accuracy ends well above chance
+    assert server.history[-1]["local_train_acc"] > 0.5
