@@ -208,17 +208,24 @@ class FedMLAggregator:
             if d is None:
                 continue
             loss_sum = correct = valid = 0.0
-            for k in keys:
+
+            def eligible(k):
                 tpair = (self.test_data_local_dict or {}).get(k)
                 if tpair is None or len(tpair) == 0:
-                    continue  # reference: skip the client on BOTH sides
+                    return None  # reference: skip the client on BOTH sides
                 pair = d.get(k)
-                if pair is None or len(pair) == 0:
-                    continue
-                # fixed batch width: padded rows are exactly masked, and a
-                # size-dependent bs would force one XLA recompile per
-                # distinct client split size
-                xs, ys, ms = FedSimulator._pad_and_batch(pair.x, pair.y, 256)
+                return pair if pair is not None and len(pair) else None
+
+            pairs = [p for p in (eligible(k) for k in keys) if p is not None]
+            if not pairs:
+                continue
+            # every client padded to the SAME (cohort-max) row count:
+            # masked rows are exact, and one shape means ONE XLA compile
+            # for the whole loop instead of one per distinct split size
+            total = -(-max(len(p) for p in pairs) // 256) * 256
+            for pair in pairs:
+                xs, ys, ms = FedSimulator._pad_and_batch(
+                    pair.x, pair.y, 256, total=total)
                 ls, c, v = self._local_eval_fn(self.model_params, xs, ys, ms)
                 loss_sum += float(ls)
                 correct += float(c)

@@ -43,11 +43,12 @@ def auto_attention_impl(B: int, H: int, T: int, Dh: int,
     auto squares, a config the table says nothing about.
     """
     from .pallas import flash_shapes_ok
-    from .pallas.flash_attention import BLOCK_TABLE
+    from .pallas.flash_attention import BLOCK_TABLE, BLOCK_TABLE_SWEPT_SHAPE
 
     dense_saved_bytes = B * H * T * T * itemsize
     want_flash = (T >= 4096 or dense_saved_bytes > 512 * 1024**2
-                  or (T in BLOCK_TABLE and Dh == 64 and itemsize == 2))
+                  or (T in BLOCK_TABLE
+                      and (Dh, itemsize) == BLOCK_TABLE_SWEPT_SHAPE))
     if want_flash and flash_shapes_ok(T, Dh, itemsize=itemsize):
         return "flash"
     return "dense"

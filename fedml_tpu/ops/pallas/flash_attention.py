@@ -322,6 +322,12 @@ def _flash_backward(q, k, v, out, lse, g, causal, block_q, block_k, interpret):
 # T=2048 sweep saw (128, 1024) at 1.62x dense (flash_attention_holes_r4
 # t2048_block_sweep) pending confirmation under the r5 protocol.
 BLOCK_TABLE: dict = {}
+# the shape family the sweep measures (q/k/v head dim, element bytes):
+# table entries qualify ONLY here — other Dh/itemsize would resolve to
+# unmeasured auto blocks. Dispatch (ops/attention.py) and any future
+# sweep extension read this, so the qualifying condition lives in one
+# place next to the table it scopes.
+BLOCK_TABLE_SWEPT_SHAPE = (64, 2)
 
 
 def _resolve_blocks(T, block_q, block_k, Dh: int = 64, itemsize: int = 2):

@@ -922,14 +922,21 @@ class FedSimulator:
         }
 
     @staticmethod
-    def _pad_and_batch(x, y, bs, sid=None):
+    def _pad_and_batch(x, y, bs, sid=None, total=None):
         """Pad the tail batch to full size with masked-out rows and reshape
         into (num_batches, bs, ...) device arrays — eval covers every sample
         exactly (a truncated tail would bias parity numbers). Keeps trailing
         label dims (per-token/per-pixel targets). ``sid`` optionally carries
-        a per-sample segment id through the same batching."""
+        a per-sample segment id through the same batching. ``total`` pads to
+        a FIXED row count (a multiple of bs) instead of the next multiple —
+        callers evaluating many differently-sized sets through one jit pad
+        them all to the same shape so XLA compiles once."""
         n = len(x)
-        n_pad = (-n) % bs
+        if total is not None:
+            assert total % bs == 0 and total >= n, (total, bs, n)
+            n_pad = total - n
+        else:
+            n_pad = (-n) % bs
         m = np.ones(n + n_pad, np.float32)
         if n_pad:
             x = np.concatenate([x, np.zeros((n_pad,) + x.shape[1:], x.dtype)])
