@@ -170,6 +170,29 @@ class MLOpsProfilerEvent:
             "duration": (now - started) if started is not None else None,
         })
 
+    def device_trace(self, trace_dir: str):
+        """Context manager capturing an XLA device trace (TensorBoard
+        'trace_viewer' format) around the wrapped block — the TPU-native
+        answer to the reference's host-side-only profiler spans: device
+        op timelines, fusion boundaries, and transfer lanes come from the
+        runtime itself via ``jax.profiler``. A span event brackets the
+        capture in the sink so trace files correlate with round metrics."""
+        import contextlib
+
+        import jax
+
+        @contextlib.contextmanager
+        def _trace():
+            self.log_event_started("device_trace", event_value=trace_dir)
+            jax.profiler.start_trace(trace_dir)
+            try:
+                yield trace_dir
+            finally:
+                jax.profiler.stop_trace()
+                self.log_event_ended("device_trace", event_value=trace_dir)
+
+        return _trace()
+
 
 class SysStats:
     """psutil CPU/mem/disk/net + JAX device memory (reference

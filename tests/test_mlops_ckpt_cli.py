@@ -233,3 +233,24 @@ def test_mlops_configs_resolution(tmp_path, monkeypatch):
 
     with pytest.raises(ValueError, match="bad.json"):
         MLOpsConfigs(D()).fetch_configs()
+
+
+def test_device_trace_capture(tmp_path):
+    """device_trace captures a real XLA profiler trace (TensorBoard
+    trace-viewer files on disk) bracketed by sink span events."""
+    import glob
+
+    import jax.numpy as jnp
+
+    sink = MetricsSink()
+    ev = MLOpsProfilerEvent(sink=sink)
+    tdir = str(tmp_path / "trace")
+    with ev.device_trace(tdir):
+        x = jnp.ones((64, 64))
+        (x @ x).block_until_ready()
+    files = glob.glob(tdir + "/**/*", recursive=True)
+    assert any("trace" in f or f.endswith((".pb", ".json.gz", ".xplane.pb"))
+               for f in files if "." in f.split("/")[-1]), files
+    kinds = [r["kind"] for r in sink.records]
+    assert kinds == ["event_started", "event_ended"]
+    assert sink.records[0]["event"] == "device_trace"
