@@ -44,7 +44,7 @@ import sys
 import time
 
 
-def emit(value, vs_baseline, basis, error=None) -> None:
+def emit(value, vs_baseline, basis, error=None, candidate_errors=None) -> None:
     line = {
         "metric": "fedavg_cifar10_resnet56_rounds_per_sec",
         "value": value,
@@ -54,6 +54,12 @@ def emit(value, vs_baseline, basis, error=None) -> None:
     }
     if error is not None:
         line["error"] = error
+    if candidate_errors:
+        # a one-executor run is a DEGRADED measurement, not a clean A/B
+        # win — automation must be able to tell them apart
+        line["candidate_errors"] = {
+            ("flat" if k else "tree"): v for k, v in candidate_errors.items()
+        }
     print(json.dumps(line), flush=True)
 
 
@@ -130,21 +136,32 @@ def run_bench() -> float:
     # framework's job — the metric is achievable rounds/sec.
     # FEDML_BENCH_FLAT={0,1} pins a carry and skips the A/B.
     forced = os.environ.get("FEDML_BENCH_FLAT", "")
-    if forced in ("0", "1"):
-        cands = {forced == "1": _build(forced == "1")}
-    else:
-        cands = {flat: _build(flat) for flat in (True, False)}
-    warm = {}
-    for flat, sim in cands.items():
-        sim.run(apply_fn=None, log_fn=None)     # compile + upload
-        _timed_block(sim, rounds_per_block)     # burn-in (discarded)
-        # decide on a MEDIAN of 3 warm blocks — one-shot block rates fluke
-        # (that is why the timed phase prints its spread)
-        rates = sorted(_timed_block(sim, rounds_per_block)
-                       for _ in range(3))
+    flats = ((forced == "1",) if forced in ("0", "1") else (True, False))
+    cands, warm, errors = {}, {}, {}
+    for flat in flats:
+        # a candidate that fails to build/compile/run must not cost the
+        # round its number while the other executor works — record the
+        # error and measure the survivor (flat was chip-unvalidated when
+        # this A/B landed; see results/chip_outage_r5.json)
+        try:
+            sim = _build(flat)
+            sim.run(apply_fn=None, log_fn=None)   # compile + upload
+            _timed_block(sim, rounds_per_block)   # burn-in (discarded)
+            # decide on a MEDIAN of 3 warm blocks — one-shot block rates
+            # fluke (that is why the timed phase prints its spread)
+            rates = sorted(_timed_block(sim, rounds_per_block)
+                           for _ in range(3))
+        except Exception as e:  # noqa: BLE001
+            errors[flat] = f"{type(e).__name__}: {e}"
+            print(f"carry candidate flat={flat} FAILED: {errors[flat]}",
+                  file=sys.stderr, flush=True)
+            continue
+        cands[flat] = sim
         warm[flat] = rates[1]
         print(f"warm blocks: flat={flat} {[round(r, 3) for r in rates]} "
               f"median={warm[flat]:.4f} r/s", file=sys.stderr, flush=True)
+    if not cands:
+        raise RuntimeError(f"every carry candidate failed: {errors}")
     flat = max(warm, key=warm.get)
     sim = cands.pop(flat)
     cands.clear()  # drop the loser's device-resident data before timing
@@ -160,7 +177,7 @@ def run_bench() -> float:
         f"median={rounds_per_sec:.4f} spread={spread:.4f}",
         file=sys.stderr,
     )
-    return rounds_per_sec
+    return rounds_per_sec, errors
 
 
 def main() -> int:
@@ -180,11 +197,12 @@ def main() -> int:
              error=f"backend unavailable after bounded retries ({detail})")
         return 1
     try:
-        rounds_per_sec = run_bench()
+        rounds_per_sec, candidate_errors = run_bench()
     except Exception as e:  # noqa: BLE001 — driver artifact must parse
         emit(None, None, basis, error=f"{type(e).__name__}: {e}")
         return 1
-    emit(round(rounds_per_sec, 4), round(rounds_per_sec / baseline, 4), basis)
+    emit(round(rounds_per_sec, 4), round(rounds_per_sec / baseline, 4), basis,
+         candidate_errors=candidate_errors)
     return 0
 
 

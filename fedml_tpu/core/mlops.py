@@ -13,6 +13,7 @@ spans the reference wraps around its round FSM
 
 from __future__ import annotations
 
+import contextlib
 import json
 import logging
 import os
@@ -170,28 +171,25 @@ class MLOpsProfilerEvent:
             "duration": (now - started) if started is not None else None,
         })
 
+    @contextlib.contextmanager
     def device_trace(self, trace_dir: str):
         """Context manager capturing an XLA device trace (TensorBoard
         'trace_viewer' format) around the wrapped block — the TPU-native
         answer to the reference's host-side-only profiler spans: device
         op timelines, fusion boundaries, and transfer lanes come from the
         runtime itself via ``jax.profiler``. A span event brackets the
-        capture in the sink so trace files correlate with round metrics."""
-        import contextlib
-
+        capture in the sink so trace files correlate with round metrics.
+        start_trace runs BEFORE the started span so a failed start (dir
+        unwritable, trace already active) leaves no dangling open span."""
         import jax
 
-        @contextlib.contextmanager
-        def _trace():
-            self.log_event_started("device_trace", event_value=trace_dir)
-            jax.profiler.start_trace(trace_dir)
-            try:
-                yield trace_dir
-            finally:
-                jax.profiler.stop_trace()
-                self.log_event_ended("device_trace", event_value=trace_dir)
-
-        return _trace()
+        jax.profiler.start_trace(trace_dir)
+        self.log_event_started("device_trace", event_value=trace_dir)
+        try:
+            yield trace_dir
+        finally:
+            jax.profiler.stop_trace()
+            self.log_event_ended("device_trace", event_value=trace_dir)
 
 
 class SysStats:

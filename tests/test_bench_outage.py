@@ -61,11 +61,27 @@ def test_success_emits_value(monkeypatch):
     import fedml_tpu.utils.chip_probe as cp
 
     monkeypatch.setattr(cp, "wait_for_chip", lambda *a, **k: (True, "ok"))
-    rc, rec = _run_main(monkeypatch, run_bench=lambda: 6.25)
+    rc, rec = _run_main(monkeypatch, run_bench=lambda: (6.25, {}))
     assert rc == 0
     assert rec["value"] == 6.25
-    assert "error" not in rec
+    assert "error" not in rec and "candidate_errors" not in rec
     assert rec["vs_baseline"] > 0
+
+
+def test_degraded_ab_run_is_flagged(monkeypatch):
+    """A one-executor run (the other carry candidate crashed) must carry
+    candidate_errors in the JSON — it is a measurement, but not a clean
+    A/B, and automation needs to tell them apart."""
+    import fedml_tpu.utils.chip_probe as cp
+
+    monkeypatch.setattr(cp, "wait_for_chip", lambda *a, **k: (True, "ok"))
+    rc, rec = _run_main(
+        monkeypatch,
+        run_bench=lambda: (4.5, {True: "RuntimeError: flat compile blew up"}))
+    assert rc == 0
+    assert rec["value"] == 4.5
+    assert rec["candidate_errors"] == {
+        "flat": "RuntimeError: flat compile blew up"}
 
 
 def test_unreadable_baseline_still_emits(monkeypatch):

@@ -244,13 +244,14 @@ def test_device_trace_capture(tmp_path):
 
     sink = MetricsSink()
     ev = MLOpsProfilerEvent(sink=sink)
-    tdir = str(tmp_path / "trace")
+    tdir = str(tmp_path / "prof")  # name must not collide with patterns
     with ev.device_trace(tdir):
         x = jnp.ones((64, 64))
         (x @ x).block_until_ready()
     files = glob.glob(tdir + "/**/*", recursive=True)
-    assert any("trace" in f or f.endswith((".pb", ".json.gz", ".xplane.pb"))
-               for f in files if "." in f.split("/")[-1]), files
+    # a real capture writes trace-viewer/xplane payload files
+    assert any(f.endswith((".pb", ".json.gz", ".trace.json.gz"))
+               or "plugins/profile" in f for f in files), files
     kinds = [r["kind"] for r in sink.records]
     assert kinds == ["event_started", "event_ended"]
     assert sink.records[0]["event"] == "device_trace"
