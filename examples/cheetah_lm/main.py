@@ -37,6 +37,9 @@ if __name__ == "__main__":
     p.add_argument("--ce_chunk", type=int, default=256,
                    help="0 = full-logit CE; else sequence-chunk size "
                         "(seq_len must be divisible by it)")
+    p.add_argument("--mu_dtype", default=None, choices=[None, "bfloat16"],
+                   help="AdamW first-moment dtype; bfloat16 halves mu's "
+                        "HBM footprint and optimizer-stage traffic")
     a = p.parse_args()
     if a.ce_chunk and a.seq_len % a.ce_chunk:
         # fall back rather than crash on the first step: chunked CE needs
@@ -47,7 +50,8 @@ if __name__ == "__main__":
 
     trainer = DistributedLMTrainer(
         DistTrainConfig(dp=a.dp, tp=a.tp, sp=a.sp, lr=3e-4,
-                        use_remat=not a.no_remat, ce_chunk=a.ce_chunk),
+                        use_remat=not a.no_remat, ce_chunk=a.ce_chunk,
+                        mu_dtype=a.mu_dtype),
         vocab_size=32000, dim=a.dim, num_heads=8, num_layers=a.layers,
         max_len=a.seq_len,
     )
