@@ -10,7 +10,7 @@ module runs single-chip (fused softmax path) or sequence-sharded.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Union
 
 import flax.linen as nn
 import jax
@@ -127,8 +127,14 @@ class TransformerLM(nn.Module):
     mesh: Optional[object] = None
     sp_impl: str = "ring"
     attn_impl: Optional[str] = None
-    remat: bool = False   # rematerialize blocks in bwd: activation HBM ->
-                          # O(1) per layer at ~1.3x fwd FLOPs (jax.checkpoint)
+    # rematerialize blocks in bwd (jax.checkpoint): False = save all
+    # activations; True/"full" = recompute everything (O(1) activation HBM
+    # per layer at ~1.3x fwd FLOPs); "dots" = checkpoint_dots policy —
+    # matmul OUTPUTS are saved and only cheap elementwise/norm ops
+    # recompute, trading some of full-remat's memory win to reclaim most
+    # of its recompute FLOPs (the classic middle point on the
+    # memory/compute curve; A/B'd by scripts/bench_lm_attribution_r5.py)
+    remat: Union[bool, str] = False
 
     @nn.compact
     def __call__(self, tokens, train: bool = False,
@@ -139,7 +145,19 @@ class TransformerLM(nn.Module):
             jnp.arange(T)[None, :]
         )
         h = h + pos
-        block_cls = nn.remat(Block) if self.remat else Block
+        if self.remat == "dots":
+            block_cls = nn.remat(
+                Block, policy=jax.checkpoint_policies.checkpoint_dots)
+        elif self.remat in (True, "full"):
+            block_cls = nn.remat(Block)
+        elif not self.remat:
+            block_cls = Block
+        else:
+            # a typo'd policy string must not silently run full remat —
+            # every 'dots' conclusion would actually measure the wrong mode
+            raise ValueError(
+                f"unknown remat policy {self.remat!r}; use False, True, "
+                "'full', or 'dots'")
         for i in range(self.num_layers):
             h = block_cls(self.dim, self.num_heads, causal=True, dtype=self.dtype,
                           seq_axis=self.seq_axis, mesh=self.mesh,

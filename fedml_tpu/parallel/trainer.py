@@ -46,6 +46,11 @@ class DistTrainConfig:
     lr: float = 3e-4
     weight_decay: float = 0.01
     use_remat: bool = True   # jax.checkpoint the blocks: FLOPs for HBM
+    # "full" recomputes the whole block in bwd; "dots" saves matmul
+    # outputs and recomputes only elementwise/norm ops — most of full
+    # remat's memory win at a fraction of its recompute FLOPs
+    # (models/transformer.py remat; A/B'd in bench_lm_attribution_r5)
+    remat_policy: str = "full"
     # chunked LM cross-entropy (ops/losses.chunked_lm_cross_entropy):
     # never materializes the (B, T, V) f32 logits — the large-vocab HBM
     # hog. 0 disables; otherwise the sequence-chunk size.
@@ -123,7 +128,8 @@ class DistributedLMTrainer:
             # per-block remat: O(1) layers of activations alive in bwd —
             # strictly better than checkpointing the whole apply (which
             # still holds every layer alive during the recompute)
-            remat=cfg.use_remat,
+            remat=(cfg.remat_policy if cfg.remat_policy != "full" else True)
+            if cfg.use_remat else False,
         )
         # init on host with a tiny batch, then place with TP shardings; the
         # init token length must divide by sp (ring attention shards T)
