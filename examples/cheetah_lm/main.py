@@ -40,6 +40,10 @@ if __name__ == "__main__":
     p.add_argument("--mu_dtype", default=None, choices=[None, "bfloat16"],
                    help="AdamW first-moment dtype; bfloat16 halves mu's "
                         "HBM footprint and optimizer-stage traffic")
+    p.add_argument("--remat_policy", default="full", choices=["full", "dots"],
+                   help="'dots' saves matmul outputs and recomputes only "
+                        "elementwise ops in bwd (less recompute, more "
+                        "activation HBM than 'full')")
     a = p.parse_args()
     if a.ce_chunk and a.seq_len % a.ce_chunk:
         # fall back rather than crash on the first step: chunked CE needs
@@ -51,7 +55,7 @@ if __name__ == "__main__":
     trainer = DistributedLMTrainer(
         DistTrainConfig(dp=a.dp, tp=a.tp, sp=a.sp, lr=3e-4,
                         use_remat=not a.no_remat, ce_chunk=a.ce_chunk,
-                        mu_dtype=a.mu_dtype),
+                        mu_dtype=a.mu_dtype, remat_policy=a.remat_policy),
         vocab_size=32000, dim=a.dim, num_heads=8, num_layers=a.layers,
         max_len=a.seq_len,
     )
