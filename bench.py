@@ -44,7 +44,8 @@ import sys
 import time
 
 
-def emit(value, vs_baseline, basis, error=None, candidate_errors=None) -> None:
+def emit(value, vs_baseline, basis, error=None, candidate_errors=None,
+         host_pack=None) -> None:
     line = {
         "metric": "fedavg_cifar10_resnet56_rounds_per_sec",
         "value": value,
@@ -60,7 +61,24 @@ def emit(value, vs_baseline, basis, error=None, candidate_errors=None) -> None:
         line["candidate_errors"] = {
             ("flat" if k else "tree"): v for k, v in candidate_errors.items()
         }
+    if host_pack:
+        # per-round host-packing attribution from the final timed block
+        # (pack_time = build cost wherever it ran, pack_wait = round-loop
+        # stall, overlap = fraction hidden behind earlier device work)
+        line["host_pack"] = host_pack
     print(json.dumps(line), flush=True)
+
+
+def _host_pack_stats(history) -> dict:
+    recs = [r for r in history if "pack_time" in r]
+    if not recs:
+        return {}
+    mean = lambda k: sum(r[k] for r in recs) / len(recs)  # noqa: E731
+    return {
+        "pack_time_mean_s": round(mean("pack_time"), 6),
+        "pack_wait_mean_s": round(mean("pack_wait"), 6),
+        "overlap_mean": round(mean("overlap"), 4),
+    }
 
 
 def load_baseline() -> tuple[float, str]:
@@ -126,7 +144,7 @@ def _timed_block(sim, rounds_per_block: int) -> float:
     return rounds_per_block / (time.perf_counter() - t0)
 
 
-def run_bench() -> float:
+def run_bench() -> tuple[float, dict, dict]:
     blocks, rounds_per_block = 5, ROUNDS_PER_BLOCK
     # Carry selection: flat carry (lane scan state as ONE ravelled vector)
     # won the on-chip per-step microbench 1.6x (results/lane_sweep_r4.json)
@@ -177,7 +195,8 @@ def run_bench() -> float:
         f"median={rounds_per_sec:.4f} spread={spread:.4f}",
         file=sys.stderr,
     )
-    return rounds_per_sec, errors
+    # history of the LAST timed block (each block clears it first)
+    return rounds_per_sec, errors, _host_pack_stats(sim.history)
 
 
 def main() -> int:
@@ -197,14 +216,83 @@ def main() -> int:
              error=f"backend unavailable after bounded retries ({detail})")
         return 1
     try:
-        rounds_per_sec, candidate_errors = run_bench()
+        rounds_per_sec, candidate_errors, host_pack = run_bench()
     except Exception as e:  # noqa: BLE001 — driver artifact must parse
         emit(None, None, basis, error=f"{type(e).__name__}: {e}")
         return 1
     emit(round(rounds_per_sec, 4), round(rounds_per_sec / baseline, 4), basis,
-         candidate_errors=candidate_errors)
+         candidate_errors=candidate_errors, host_pack=host_pack)
     return 0
 
 
+def host_pack_bench(rounds: int = 20) -> int:
+    """``--host-pack``: CPU-only micro-mode isolating the per-round HOST
+    packing cost of the packed schedule (100-client Dirichlet cohort, full
+    participation). Times the vectorized builder (cohort-level pack + cached
+    lane plan + native row gather) against the pre-pipeline per-client loop
+    on identical inputs — the builders are bit-exact (tests/test_prefetch.py)
+    so this is a pure like-for-like host cost A/B. No chip probe: the win is
+    measurable wherever python runs, which is the point (the device never
+    waits on a host that packs ahead). Also runs a short prefetch-on block
+    and reports the recorded overlap fraction."""
+    import numpy as np
+
+    import fedml_tpu
+    from fedml_tpu.simulation import build_simulator
+    from fedml_tpu.simulation.fed_sim import reference_client_sampling
+
+    args = fedml_tpu.init(config=dict(
+        dataset="cifar10", model="lr", partition_method="hetero",
+        partition_alpha=0.5, client_num_in_total=100,
+        client_num_per_round=100, comm_round=4, learning_rate=0.05,
+        epochs=1, batch_size=16, frequency_of_the_test=10_000,
+        random_seed=0, debug_small_data=True, cohort_schedule="packed",
+    ))
+    sim, _ = build_simulator(args)
+    assert sim._packed, "packed cohort schedule must engage"
+    cfg = sim.cfg
+    cohorts = [
+        np.asarray(reference_client_sampling(
+            r, cfg.client_num_in_total, cfg.client_num_per_round))
+        for r in range(rounds)
+    ]
+    # steady state on both sides: lane-plan cache warm for the new builder
+    # (the loop has no cache to warm — it redoes everything every round)
+    sim._build_packed_inputs(cohorts[0], 0, None)
+    t_new, t_old = [], []
+    for r, ci in enumerate(cohorts):
+        t0 = time.perf_counter()
+        sim._build_packed_inputs(ci, r, None)
+        t_new.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        sim._build_packed_inputs_loop(ci, r, None)
+        t_old.append(time.perf_counter() - t0)
+    med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+    new_s, old_s = med(t_new), med(t_old)
+    hist = sim.run(apply_fn=None, log_fn=None)  # prefetch defaults on
+    overlap = _host_pack_stats(hist)
+    line = {
+        "metric": "host_pack_packed_round_build_seconds",
+        "unit": ("median s/round host packing, 100-client Dirichlet(0.5) "
+                 "cohort, packed schedule, full participation"),
+        "value": round(new_s, 6),
+        "loop_baseline": round(old_s, 6),
+        "speedup": round(old_s / new_s, 2) if new_s > 0 else None,
+        **({"host_pack": overlap} if overlap else {}),
+    }
+    print(json.dumps(line), flush=True)
+    ok = new_s > 0 and old_s / new_s >= 2.0 and \
+        overlap.get("overlap_mean", 0.0) > 0.0
+    print(f"host-pack: new={new_s * 1e3:.2f}ms loop={old_s * 1e3:.2f}ms "
+          f"speedup={old_s / new_s:.2f}x "
+          f"overlap_mean={overlap.get('overlap_mean')} "
+          f"{'OK' if ok else 'BELOW TARGET'}", file=sys.stderr, flush=True)
+    return 0 if ok else 1
+
+
 if __name__ == "__main__":
+    if "--host-pack" in sys.argv:
+        # host-side measurement only — never wait on (or measure) the chip
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        sys.exit(host_pack_bench())
     sys.exit(main())

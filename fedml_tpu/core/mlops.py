@@ -172,6 +172,20 @@ class MLOpsProfilerEvent:
         })
 
     @contextlib.contextmanager
+    def span(self, event_name: str, event_value: Optional[str] = None,
+             event_edge_id: Optional[int] = None):
+        """Paired started/ended emission around a block. The simulator brackets
+        its per-round phases with these (``host_pack`` on the prefetch worker,
+        ``round_dispatch`` on the round loop) so the sink shows how much of
+        each round's host packing ran under the previous round's device
+        compute. The ended event fires on exceptions too — no dangling spans."""
+        self.log_event_started(event_name, event_value, event_edge_id)
+        try:
+            yield
+        finally:
+            self.log_event_ended(event_name, event_value, event_edge_id)
+
+    @contextlib.contextmanager
     def device_trace(self, trace_dir: str):
         """Context manager capturing an XLA device trace (TensorBoard
         'trace_viewer' format) around the wrapped block — the TPU-native

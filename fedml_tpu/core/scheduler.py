@@ -12,6 +12,7 @@ per-shard client loop (Parrot-TPU) keeps rectangular shapes.
 
 from __future__ import annotations
 
+import functools
 from typing import List, Sequence, Tuple
 
 import numpy as np
@@ -100,7 +101,25 @@ def bucket_schedule(
 
     Returns: list of (positions, width) — positions index into
     ``batch_counts``; widths ascending powers of two.
+
+    Pure in its arguments, and on the per-round host hot path (the async
+    cohort pipeline rebuilds the schedule every round): results are
+    memoized on the (counts, axis, max_buckets, max_width) key, with
+    defensive copies returned so callers can never corrupt the cache.
     """
+    cached = _bucket_schedule_cached(
+        tuple(int(c) for c in batch_counts), int(axis), int(max_buckets),
+        None if max_width is None else int(max_width))
+    return [(pos.copy(), w) for pos, w in cached]
+
+
+@functools.lru_cache(maxsize=64)
+def _bucket_schedule_cached(
+    batch_counts: Tuple[int, ...],
+    axis: int,
+    max_buckets: int,
+    max_width: int | None,
+) -> List[Tuple[np.ndarray, int]]:
     counts = np.asarray(batch_counts, dtype=np.int64)
     n = len(counts)
     axis = max(1, int(axis))
@@ -170,7 +189,24 @@ def lane_schedule(
 
     Returns: (lanes, L) — lanes[g] is the ordered list of cohort positions
     lane g trains; L = max lane length in batches.
+
+    Memoized like ``bucket_schedule`` (pure, per-round hot path); lane
+    lists are copied on the way out so callers can't corrupt the cache.
     """
+    lanes, L = _lane_schedule_cached(
+        tuple(int(c) for c in batch_counts), int(axis),
+        None if max_lanes is None else int(max_lanes),
+        None if force_lanes is None else int(force_lanes))
+    return [list(lane) for lane in lanes], L
+
+
+@functools.lru_cache(maxsize=64)
+def _lane_schedule_cached(
+    batch_counts: Tuple[int, ...],
+    axis: int,
+    max_lanes: int | None,
+    force_lanes: int | None,
+) -> Tuple[List[List[int]], int]:
     counts = np.asarray(batch_counts, dtype=np.int64)
     n = len(counts)
     axis = max(1, int(axis))

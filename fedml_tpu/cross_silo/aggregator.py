@@ -201,31 +201,36 @@ class FedMLAggregator:
         keys = sorted(set((self.train_data_local_dict or {}).keys())
                       | set((self.test_data_local_dict or {}).keys()))
         out: Dict[str, float] = {}
-        for split, d, prefix in (
-            ("train", self.train_data_local_dict, "local_train"),
-            ("test", self.test_data_local_dict, "local_test"),
-        ):
-            if d is None:
-                continue
-            loss_sum = correct = valid = 0.0
+        bs = int(getattr(self.args, "eval_batch_size", 256))
 
-            def eligible(k):
-                tpair = (self.test_data_local_dict or {}).get(k)
-                if tpair is None or len(tpair) == 0:
-                    return None  # reference: skip the client on BOTH sides
-                pair = d.get(k)
-                return pair if pair is not None and len(pair) else None
+        def eligible(k, d):
+            tpair = (self.test_data_local_dict or {}).get(k)
+            if tpair is None or len(tpair) == 0:
+                return None  # reference: skip the client on BOTH sides
+            pair = d.get(k)
+            return pair if pair is not None and len(pair) else None
 
-            pairs = [p for p in (eligible(k) for k in keys) if p is not None]
+        split_pairs = {
+            split: [p for p in (eligible(k, d) for k in keys) if p is not None]
+            for split, d in (("train", self.train_data_local_dict),
+                             ("test", self.test_data_local_dict))
+            if d is not None
+        }
+        # every client on every split padded to the SAME (all-splits-max)
+        # row count: masked rows are exact, and one shape means ONE XLA
+        # compile for the whole evaluation instead of one per split
+        longest = max((len(p) for ps in split_pairs.values() for p in ps),
+                      default=0)
+        total = -(-max(longest, 1) // bs) * bs
+        for split, prefix in (("train", "local_train"),
+                              ("test", "local_test")):
+            pairs = split_pairs.get(split)
             if not pairs:
                 continue
-            # every client padded to the SAME (cohort-max) row count:
-            # masked rows are exact, and one shape means ONE XLA compile
-            # for the whole loop instead of one per distinct split size
-            total = -(-max(len(p) for p in pairs) // 256) * 256
+            loss_sum = correct = valid = 0.0
             for pair in pairs:
                 xs, ys, ms = FedSimulator._pad_and_batch(
-                    pair.x, pair.y, 256, total=total)
+                    pair.x, pair.y, bs, total=total)
                 ls, c, v = self._local_eval_fn(self.model_params, xs, ys, ms)
                 loss_sum += float(ls)
                 correct += float(c)

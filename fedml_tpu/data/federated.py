@@ -114,18 +114,23 @@ class FederatedData:
             num_batches = max(1, -(-int(sizes.max()) // batch_size))
         cap = num_batches * batch_size
         C = len(idx_lists)
+        ns = np.minimum(sizes, cap).astype(np.int64)
+        # vectorized over the cohort: one broadcast compare for the mask and
+        # one bulk row-major scatter for the rows, instead of 2C slice writes
+        # (rng is still consumed one permutation per client, in cohort order)
+        valid = np.arange(cap, dtype=np.int64)[None, :] < ns[:, None]
+        if perms is not None:
+            takes = [ix[np.asarray(p)[:n]]
+                     for ix, p, n in zip(idx_lists, perms, ns)]
+        elif rng is not None:
+            takes = [ix[rng.permutation(len(ix))[:n]]
+                     for ix, n in zip(idx_lists, ns)]
+        else:
+            takes = [ix[:n] for ix, n in zip(idx_lists, ns)]
         idx = np.zeros((C, cap), dtype=np.int32)
-        mask = np.zeros((C, cap), dtype=np.float32)
-        for i, ix in enumerate(idx_lists):
-            n = min(len(ix), cap)
-            if perms is not None:
-                order = np.asarray(perms[i])[:n]
-            elif rng is not None:
-                order = rng.permutation(len(ix))[:n]
-            else:
-                order = np.arange(n)
-            idx[i, :n] = ix[order]
-            mask[i, :n] = 1.0
+        if C:
+            idx[valid] = np.concatenate(takes)
+        mask = valid.astype(np.float32)
         shape = (C, num_batches, batch_size)
         return ClientIndexBatches(
             idx=idx.reshape(shape),

@@ -90,6 +90,10 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_int32,
         ]
+        lib.pack_lane_rows_i32.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+            ctypes.c_void_p, ctypes.c_int32,
+        ]
         lib.quant_i8_bound.argtypes = [ctypes.c_int64]
         lib.quant_i8_bound.restype = ctypes.c_int64
         lib.quantize_i8.argtypes = [
@@ -148,6 +152,26 @@ def dequantize_i8(q: np.ndarray, scales: np.ndarray, shape) -> np.ndarray:
             blk = q[c * _QCHUNK : (c + 1) * _QCHUNK].astype(np.float32)
             out[c * _QCHUNK : (c + 1) * _QCHUNK] = blk * scales[c]
     return out.reshape(shape)
+
+
+def pack_lane_rows(rows: np.ndarray, srcmap: np.ndarray,
+                   n_threads: int = 0) -> np.ndarray:
+    """Gather (n_rows, bs) int32 batch rows into the packed schedule's lane
+    tensor via a slot -> row map (see pack_lane_rows_i32). srcmap may have
+    any leading shape; the output matches it with a trailing bs axis."""
+    rows = np.ascontiguousarray(rows, np.int32)
+    sm = np.ascontiguousarray(srcmap, np.int64)
+    bs = rows.shape[-1]
+    out_shape = sm.shape + (bs,)
+    lib = get_lib()
+    if lib is None:
+        return rows[sm.ravel()].reshape(out_shape)
+    out = np.empty((sm.size, bs), np.int32)
+    lib.pack_lane_rows_i32(
+        rows.ctypes.data, sm.ctypes.data, sm.size, bs, out.ctypes.data,
+        int(n_threads),
+    )
+    return out.reshape(out_shape)
 
 
 def pack_cohort(

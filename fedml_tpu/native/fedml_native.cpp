@@ -83,6 +83,43 @@ void pack_cohort_f32(
 }
 
 // ---------------------------------------------------------------------------
+// 1b. lane-row gather — assembles the packed schedule's (n_slots, bs) lane
+//     index tensor from the cohort index rectangle in one pass.
+//
+// rows:    (n_rows, bs) int32 — per-client batch rows (last row all-zero pad)
+// srcmap:  (n_slots) int64 — source row per lane slot
+// out:     (n_slots, bs) int32
+// ---------------------------------------------------------------------------
+void pack_lane_rows_i32(
+    const int32_t* rows, const int64_t* srcmap,
+    int64_t n_slots, int64_t bs, int32_t* out, int32_t n_threads)
+{
+    if (n_threads <= 0) {
+        n_threads = (int32_t)std::min<int64_t>(
+            std::max<int64_t>(n_slots / 4096, 1),
+            std::max(1u, std::thread::hardware_concurrency()));
+    }
+    auto work = [&](int64_t s0, int64_t s1) {
+        for (int64_t s = s0; s < s1; ++s) {
+            std::memcpy(out + s * bs, rows + srcmap[s] * bs,
+                        sizeof(int32_t) * (size_t)bs);
+        }
+    };
+    if (n_threads == 1 || n_slots <= 1) {
+        work(0, n_slots);
+        return;
+    }
+    std::vector<std::thread> threads;
+    const int64_t chunk = (n_slots + n_threads - 1) / n_threads;
+    for (int64_t t = 0; t < n_threads; ++t) {
+        const int64_t s0 = t * chunk, s1 = std::min(n_slots, s0 + chunk);
+        if (s0 >= s1) break;
+        threads.emplace_back(work, s0, s1);
+    }
+    for (auto& th : threads) th.join();
+}
+
+// ---------------------------------------------------------------------------
 // 2. quantization codec: f32 <-> int8 with per-chunk absmax scales
 //    (chunk = 256 values; scales stored f32). Ratio ~3.9x vs f32.
 // ---------------------------------------------------------------------------

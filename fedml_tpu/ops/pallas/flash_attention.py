@@ -338,7 +338,8 @@ def _resolve_blocks(T, block_q, block_k, Dh: int = 64, itemsize: int = 2):
         # alignment (Mosaic needs multiples of 128) and scoped VMEM for
         # the larger tile — a mis-adopted (128, 2048) entry must fall
         # back to auto squares, not blow VMEM at chip time
-        if (T % bq == 0 and T % bk == 0
+        if ((Dh, itemsize) == BLOCK_TABLE_SWEPT_SHAPE
+                and T % bq == 0 and T % bk == 0
                 and bq % MIN_BLOCK == 0 and bk % MIN_BLOCK == 0
                 and flash_vmem_ok(T, Dh, itemsize, block=max(bq, bk))):
             return bq, bk

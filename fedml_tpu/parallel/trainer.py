@@ -61,7 +61,8 @@ class DistTrainConfig:
     sp_impl: str = "ring"
     # AdamW first-moment dtype: "bfloat16" halves mu's HBM footprint and
     # the optimizer stage's read/write traffic (mu tolerates bf16; nu
-    # stays f32 — its tiny values underflow bf16's 8-bit mantissa).
+    # stays f32 — bf16's 7-bit mantissa loses the small per-step squared
+    # gradients against the accumulated sum, stalling the second moment).
     # Optimizer-stage bandwidth is a measured lever on the tunneled v5e
     # (scripts/bench_lm_attribution_r5.py).
     mu_dtype: Optional[str] = None

@@ -98,6 +98,8 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
         loss_kind=cfg.loss_kind,
         local_test_on_all_clients=bool(
             getattr(args, "local_test_on_all_clients", False)),
+        prefetch=bool(getattr(args, "prefetch", True)),
+        prefetch_depth=int(getattr(args, "prefetch_depth", 2)),
     )
 
     attack_type = getattr(args, "attack_type", None)
@@ -168,6 +170,8 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
         # (ServerAggregator subclass) replaces the default eval when truthy
         server_tester=getattr(args, "server_tester", None),
         hook_args=args,
+        # MLOpsProfilerEvent (or None): emits host_pack/round_dispatch spans
+        profiler=getattr(args, "profiler", None),
     )
     return sim, apply_fn
 

@@ -20,6 +20,7 @@ so accuracy curves are comparable round-for-round.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -117,6 +118,16 @@ class SimConfig:
     # eval loss family — must match LocalTrainConfig.loss_kind
     # ("ce" | "mse" | "bce")
     loss_kind: str = "ce"
+    # asynchronous host-side cohort pipeline: build round r+1's cohort
+    # tensors on a background thread while round r's compiled step runs on
+    # the device (simulation/prefetch.py). Packing is a pure function of
+    # (seed, round_idx) — every RNG stream it consumes is round-indexed —
+    # so lookahead packing is bit-exact vs the synchronous path; history
+    # gains pack_time / pack_wait / overlap per round. prefetch_depth
+    # bounds the handoff queue (1-2 is plenty; each slot holds one round's
+    # host tensors).
+    prefetch: bool = True
+    prefetch_depth: int = 2
     # per-client local-test evaluation at eval rounds (reference
     # ``_local_test_on_all_clients``, fedavg_api.py:188-246): every client's
     # local train AND local test split is evaluated under the current global
@@ -125,6 +136,20 @@ class SimConfig:
     # stats scatter-added into per-client accumulators) — not a per-client
     # Python loop. Off by default: it roughly doubles eval cost.
     local_test_on_all_clients: bool = False
+
+
+@dataclasses.dataclass
+class RoundInputs:
+    """One round's host-built cohort tensors (all numpy — device conversion
+    happens at dispatch on the main thread). Produced by
+    ``FedSimulator.build_round_inputs``, possibly on the prefetch worker."""
+
+    round_idx: int
+    client_ids: np.ndarray
+    drop: Optional[np.ndarray]
+    kind: str  # "even" | "bucketed" | "packed"
+    payload: Any
+    pack_time: float  # host seconds spent building (wherever it ran)
 
 
 def _gather_from_device(data: Dict[str, Any], x_all, y_all) -> Dict[str, Any]:
@@ -169,6 +194,7 @@ class FedSimulator:
         packed_ctx: Optional[tuple] = None,
         server_tester=None,
         hook_args=None,
+        profiler=None,
     ):
         self.fed = fed_data
         self.alg = algorithm
@@ -194,6 +220,14 @@ class FedSimulator:
         self._hook_args = hook_args  # original args object, for the hook
         self._local_eval_fn = None
         self._local_eval_cache: Dict[str, Any] = {}
+        # observability: an MLOpsProfilerEvent-shaped object (span()) gets
+        # host_pack spans from the builder (prefetch worker included) and
+        # round_dispatch spans from the round loop
+        self._profiler = profiler
+        self._prefetcher = None  # live only inside run()
+        # packed schedule: round-independent lane structure per (cohort,
+        # drop) pattern — full-participation runs hit every round
+        self._lane_plan_cache: Dict[Any, Dict[str, Any]] = {}
 
         sizes = [len(v) for v in fed_data.train_data_local_dict.values()]
         if cfg.num_local_batches is None:
@@ -574,74 +608,49 @@ class FedSimulator:
                 start_round = restore_simulator_state(ckpt, self)
                 if log_fn:
                     log_fn(f"[resume] from round {start_round} @ {cfg.checkpoint_dir}")
+        rounds = range(start_round, cfg.comm_round)
+        if cfg.prefetch and len(rounds) > 0:
+            from .prefetch import RoundPrefetcher
+
+            self._prefetcher = RoundPrefetcher(
+                self.build_round_inputs, rounds, depth=cfg.prefetch_depth)
         pending = None  # deferred round record awaiting its metric readback
         self._last_round_end = time.perf_counter()
-        for round_idx in range(start_round, cfg.comm_round):
-            t0 = time.perf_counter()
-            client_ids = reference_client_sampling(
-                round_idx, cfg.client_num_in_total, cfg.client_num_per_round
-            )
-            # round-indexed RNG streams: resume at round k reproduces an
-            # uninterrupted run exactly
-            pack_rng = np.random.default_rng([cfg.seed, round_idx])
-            step_rng = jax.random.fold_in(base_rng, round_idx)
-            # drop mask is drawn FIRST (before any packing) and the
-            # per-client shuffle comes from per-client-seeded generators, so
-            # the even and bucketed schedules consume identical randomness
-            # whatever order they pack clients in
-            drop = None
-            if cfg.client_dropout_rate > 0.0:
-                drop = pack_rng.random(len(client_ids)) < cfg.client_dropout_rate
-                if drop.all():
-                    drop[0] = False  # a round needs at least one survivor
-            if self._packed:
-                metrics_vec = self._run_packed_round(
-                    np.asarray(client_ids), round_idx, drop, step_rng
-                )
+        try:
+            for round_idx in rounds:
+                t0 = time.perf_counter()
+                if self._prefetcher is not None:
+                    inputs = self._prefetcher.get(round_idx)
+                else:
+                    inputs = self.build_round_inputs(round_idx)
+                # host stall on packing: with the pipeline warm this is a
+                # queue pop (~µs) while pack_time was spent on the worker
+                # under the PREVIOUS round's device compute
+                pack_wait = time.perf_counter() - t0
+                step_rng = jax.random.fold_in(base_rng, round_idx)
+                with self._span("round_dispatch", str(round_idx)):
+                    if inputs.kind == "packed":
+                        metrics_vec = self._dispatch_packed(inputs, step_rng)
+                    elif inputs.kind == "bucketed":
+                        metrics_vec = self._dispatch_bucketed(inputs, step_rng)
+                    else:
+                        metrics_vec = self._dispatch_even(inputs, step_rng)
+                timing = {
+                    "pack_time": inputs.pack_time,
+                    "pack_wait": pack_wait,
+                    # fraction of this round's host packing hidden behind
+                    # earlier device work (0 when synchronous)
+                    "overlap": (max(0.0, 1.0 - pack_wait / inputs.pack_time)
+                                if inputs.pack_time > 0 else 0.0),
+                }
                 pending = self._defer_rec(
-                    round_idx, t0, metrics_vec, pending, apply_fn, ckpt, log_fn
+                    round_idx, t0, metrics_vec, pending, apply_fn, ckpt,
+                    log_fn, timing,
                 )
-                continue
-            if self._bucketed:
-                metrics_vec = self._run_bucketed_round(
-                    np.asarray(client_ids), round_idx, drop, step_rng
-                )
-                pending = self._defer_rec(
-                    round_idx, t0, metrics_vec, pending, apply_fn, ckpt, log_fn
-                )
-                continue
-            perms = self._client_perms(client_ids, round_idx)
-            if self._use_device_data:
-                packed = self.fed.pack_client_index(
-                    client_ids, cfg.batch_size, self.num_local_batches,
-                    perms=perms,
-                )
-                payload = {"idx": packed.idx}
-            else:
-                packed = self.fed.pack_clients(
-                    client_ids, cfg.batch_size, self.num_local_batches,
-                    perms=perms,
-                )
-                payload = {"x": packed.x, "y": packed.y}
-            mask_np, samples_np = packed.mask, packed.num_samples
-            if drop is not None:
-                mask_np = mask_np * (~drop)[:, None, None]
-                samples_np = samples_np * (~drop)
-            cohort = {k: jnp.asarray(v) for k, v in payload.items()}
-            cohort["mask"] = jnp.asarray(mask_np)
-            cohort["num_samples"] = jnp.asarray(samples_np)
-            cohort["pos"] = jnp.arange(len(client_ids), dtype=jnp.uint32)
-            states = self._cohort_states(client_ids)
-            step_args = (self.params, self.server_state, cohort, states, step_rng)
-            if self._use_device_data:
-                step_args += (self._x_dev, self._y_dev)
-            self.params, self.server_state, new_states, metrics_vec = (
-                self._round_step(*step_args)
-            )
-            self._store_states(client_ids, new_states)
-            pending = self._defer_rec(
-                round_idx, t0, metrics_vec, pending, apply_fn, ckpt, log_fn
-            )
+        finally:
+            if self._prefetcher is not None:
+                self._prefetcher.close()
+                self._prefetcher = None
         if pending is not None:
             self._finalize_rec(pending, apply_fn, ckpt, log_fn)
         # drain the async dispatch queue: per-round host reads (metric
@@ -653,8 +662,21 @@ class FedSimulator:
             ckpt.close()
         return self.history
 
+    def _span(self, name: str, value: Optional[str] = None):
+        if self._profiler is not None:
+            return self._profiler.span(name, event_value=value)
+        return contextlib.nullcontext()
+
+    def _paused_prefetch(self):
+        """Sync point: guarantees the prefetch worker is quiescent for the
+        block (eval hooks / checkpoint writes must never race a background
+        build — see prefetch.py's contract)."""
+        if self._prefetcher is not None:
+            return self._prefetcher.paused()
+        return contextlib.nullcontext()
+
     def _defer_rec(self, round_idx, t0, metrics_vec, pending,
-                   apply_fn, ckpt, log_fn):
+                   apply_fn, ckpt, log_fn, timing=None):
         """Deferred metric readback: finalize the PREVIOUS round's record now
         that this round is dispatched, so its device->host transfer overlaps
         this round's compute instead of stalling the pipeline. Rounds that
@@ -667,6 +689,8 @@ class FedSimulator:
             "dispatch_time": time.perf_counter() - t0,
             "_mvec": metrics_vec,
         }
+        if timing:
+            rec.update(timing)
         if pending is not None:
             self._finalize_rec(pending, apply_fn, ckpt, log_fn)
         if (apply_fn is not None and self._should_eval(round_idx)) or (
@@ -702,6 +726,16 @@ class FedSimulator:
         self._post_round(rec, rec["round"], apply_fn, ckpt, log_fn)
 
     def _post_round(self, rec, round_idx, apply_fn, ckpt, log_fn) -> None:
+        # eval hooks and checkpoint writes run with the prefetch worker
+        # quiescent (forced sync point — the builder is pure, but user
+        # test_on_the_server hooks may touch the dataset, and np.random's
+        # global state must not be shared mid-build)
+        need_sync = (apply_fn is not None and self._should_eval(round_idx)) \
+            or (ckpt is not None and self._should_checkpoint(round_idx))
+        with self._paused_prefetch() if need_sync else contextlib.nullcontext():
+            self._post_round_body(rec, round_idx, apply_fn, ckpt, log_fn)
+
+    def _post_round_body(self, rec, round_idx, apply_fn, ckpt, log_fn) -> None:
         if apply_fn is not None and self._should_eval(round_idx):
             handled = False
             if self._server_tester is not None:
@@ -742,10 +776,94 @@ class FedSimulator:
             for c in client_ids
         ]
 
-    def _run_packed_round(self, client_ids: np.ndarray, round_idx: int,
-                          drop, step_rng):
-        """Host side of the packed schedule: lane assignment (LPT over
-        epoch-expanded batch counts), sequence tensors, one dispatch."""
+    # --- pure round-input builders (prefetchable host side) -----------------
+
+    def build_round_inputs(self, round_idx: int) -> RoundInputs:
+        """The whole host side of one round as a pure function of
+        ``(seed, round_idx)``: client sampling, drop mask, per-client
+        shuffles, and the schedule's cohort tensors — every RNG stream is
+        round-indexed, so the prefetch worker may run this ahead of the
+        round loop and the result is bit-identical to inline packing.
+        Reads no mutable simulator state (params, client_states, history)."""
+        cfg = self.cfg
+        t0 = time.perf_counter()
+        with self._span("host_pack", str(round_idx)):
+            client_ids = np.asarray(reference_client_sampling(
+                round_idx, cfg.client_num_in_total, cfg.client_num_per_round
+            ))
+            # round-indexed RNG streams: resume at round k reproduces an
+            # uninterrupted run exactly
+            pack_rng = np.random.default_rng([cfg.seed, round_idx])
+            # drop mask is drawn FIRST (before any packing) and the
+            # per-client shuffle comes from per-client-seeded generators, so
+            # all schedules consume identical randomness whatever order they
+            # pack clients in
+            drop = None
+            if cfg.client_dropout_rate > 0.0:
+                drop = pack_rng.random(len(client_ids)) < cfg.client_dropout_rate
+                if drop.all():
+                    drop[0] = False  # a round needs at least one survivor
+            if self._packed:
+                kind = "packed"
+                payload = self._build_packed_inputs(client_ids, round_idx, drop)
+            elif self._bucketed:
+                kind = "bucketed"
+                payload = self._build_bucketed_inputs(client_ids, round_idx, drop)
+            else:
+                kind = "even"
+                payload = self._build_even_inputs(client_ids, round_idx, drop)
+        return RoundInputs(round_idx, client_ids, drop, kind, payload,
+                           time.perf_counter() - t0)
+
+    def _build_even_inputs(self, client_ids, round_idx: int, drop):
+        cfg = self.cfg
+        perms = self._client_perms(client_ids, round_idx)
+        if self._use_device_data:
+            packed = self.fed.pack_client_index(
+                client_ids, cfg.batch_size, self.num_local_batches,
+                perms=perms,
+            )
+            payload = {"idx": packed.idx}
+        else:
+            packed = self.fed.pack_clients(
+                client_ids, cfg.batch_size, self.num_local_batches,
+                perms=perms,
+            )
+            payload = {"x": packed.x, "y": packed.y}
+        mask_np, samples_np = packed.mask, packed.num_samples
+        if drop is not None:
+            mask_np = mask_np * (~drop)[:, None, None]
+            samples_np = samples_np * (~drop)
+        payload["mask"] = mask_np
+        payload["num_samples"] = samples_np
+        payload["pos"] = np.arange(len(client_ids), dtype=np.uint32)
+        return payload
+
+    def _dispatch_even(self, inputs: RoundInputs, step_rng):
+        cohort = {k: jnp.asarray(v) for k, v in inputs.payload.items()}
+        states = self._cohort_states(inputs.client_ids)
+        step_args = (self.params, self.server_state, cohort, states, step_rng)
+        if self._use_device_data:
+            step_args += (self._x_dev, self._y_dev)
+        self.params, self.server_state, new_states, metrics_vec = (
+            self._round_step(*step_args)
+        )
+        self._store_states(inputs.client_ids, new_states)
+        return metrics_vec
+
+    def _packed_lane_plan(self, client_ids: np.ndarray, drop):
+        """Round-independent structure of a packed round: lane assignment
+        plus every permutation-independent lane tensor (mask, boundary,
+        bweight, pos, sic) and the slot -> (client, batch-row) gather map.
+        Cached across rounds keyed by the (cohort, drop) pattern — the
+        per-round work left is the RNG shuffles and one bulk row gather.
+        Full-participation runs hit the cache every round; sampled cohorts
+        hit whenever the (cohort, drop) pattern repeats."""
+        key = (client_ids.tobytes(),
+               None if drop is None else drop.tobytes())
+        plan = self._lane_plan_cache.get(key)
+        if plan is not None:
+            return plan
         from ..core.scheduler import lane_schedule
 
         cfg = self.cfg
@@ -760,15 +878,124 @@ class FedSimulator:
         positions = np.arange(cohort_n)
         if drop is not None:
             positions = positions[~drop]
+        counts = np.asarray([
+            min(self._batch_counts[int(client_ids[p])], self.num_local_batches)
+            for p in positions
+        ], dtype=np.int64)
+        lanes, L = lane_schedule(list(counts * epochs), self._axis_size,
+                                 max_lanes=len(positions),
+                                 force_lanes=cfg.packed_lanes)
+        L_pad = -(-L // 4) * 4  # quantize: few compiled (G, L) shapes
+        G = len(lanes)
+        NB = int(counts.max()) if len(counts) else 1
+        P = len(positions)
+        # true per-client sample counts, capped at each client's own batch
+        # budget (== the per-client packer's num_samples)
+        n_samples = np.asarray([
+            min(len(self.fed._global_index[int(client_ids[p])]), c * bs)
+            for p, c in zip(positions, counts)
+        ], dtype=np.int64)
+        # slot -> flat row into the (P, NB) cohort index rectangle; row P*NB
+        # is a dedicated all-zero pad row, so padded slots stay exactly the
+        # zeros the per-client loop produced
+        pad_row = P * NB
+        srcmap = np.full((G, L_pad), pad_row, np.int64)
+        slot_m = np.zeros((G, L_pad), np.int64)  # valid samples per slot row
+        boundary = np.zeros((G, L_pad), np.float32)
+        bweight = np.zeros((G, L_pad), np.float32)
+        pos_arr = np.zeros((G, L_pad), np.uint32)
+        sic = np.zeros((G, L_pad), np.int32)
+        for g, lane in enumerate(lanes):
+            if not lane:
+                continue
+            li = np.asarray(lane, dtype=np.int64)
+            cs = counts[li]
+            steps = cs * epochs
+            total = int(steps.sum())
+            # client index per slot, batch row per slot (epoch-tiled)
+            cli = np.repeat(li, steps)
+            row_b = np.concatenate([np.tile(np.arange(c), epochs) for c in cs])
+            srcmap[g, :total] = cli * NB + row_b
+            slot_m[g, :total] = n_samples[cli]
+            pos_arr[g, :total] = positions[cli].astype(np.uint32)
+            sic[g, :total] = np.concatenate(
+                [np.arange(s, dtype=np.int64) for s in steps])
+            ends = np.cumsum(steps) - 1
+            boundary[g, ends] = 1.0
+            bweight[g, ends] = n_samples[li].astype(np.float32)
+        # mask depends only on per-client sample counts: slot row (i, b)
+        # has min(n_i, c_i*bs) - b*bs valid entries (clipped to [0, bs])
+        row_start = np.where(srcmap < pad_row, srcmap % NB, 0) * bs
+        mask = ((np.arange(bs, dtype=np.int64)[None, None, :] + row_start[..., None]
+                 < slot_m[..., None])).astype(np.float32)
+        plan = {
+            "G": G, "L_pad": L_pad, "NB": NB, "cohort_n": cohort_n,
+            "positions": positions, "srcmap": srcmap, "mask": mask,
+            "boundary": boundary, "bweight": bweight, "pos": pos_arr,
+            "sic": sic,
+        }
+        if len(self._lane_plan_cache) >= 32:  # FIFO bound, dropout patterns
+            self._lane_plan_cache.pop(next(iter(self._lane_plan_cache)))
+        self._lane_plan_cache[key] = plan
+        return plan
+
+    def _build_packed_inputs(self, client_ids: np.ndarray, round_idx: int,
+                             drop):
+        """Host side of the packed schedule, vectorized: ONE cohort-level
+        ``pack_client_index`` call (not one per client), the cached lane
+        plan for everything permutation-independent, and a single bulk row
+        gather (native ``pack_lane_rows`` when available) for the lane idx
+        tensor. Bit-identical to ``_build_packed_inputs_loop``."""
+        from .. import native
+
+        cfg = self.cfg
+        bs = cfg.batch_size
+        plan = self._packed_lane_plan(client_ids, drop)
+        positions = plan["positions"]
+        sel_ids = client_ids[positions]
+        if len(positions):
+            perms = self._client_perms(sel_ids, round_idx)
+            packed = self.fed.pack_client_index(sel_ids, bs, plan["NB"],
+                                                perms=perms)
+            rows = packed.idx.reshape(len(positions) * plan["NB"], bs)
+        else:
+            rows = np.zeros((0, bs), np.int32)
+        # dedicated zero pad row (plan srcmap points padded slots here)
+        rows = np.concatenate([rows, np.zeros((1, bs), np.int32)])
+        idx = native.pack_lane_rows(rows, plan["srcmap"])
+        return {
+            "idx": idx, "mask": plan["mask"], "boundary": plan["boundary"],
+            "bweight": plan["bweight"], "pos": plan["pos"], "sic": plan["sic"],
+            "shape": (plan["G"], plan["L_pad"]), "cohort_n": plan["cohort_n"],
+        }
+
+    def _build_packed_inputs_loop(self, client_ids: np.ndarray,
+                                  round_idx: int, drop):
+        """Pre-pipeline reference packer: per-client Python loop with
+        slice-by-slice lane writes. Kept as the bit-exactness oracle for
+        ``_build_packed_inputs`` (tests) and as the baseline the
+        ``bench.py --host-pack`` micro-mode measures the speedup against —
+        so it bypasses the lane-schedule memo cache (pre-PR code paid the
+        LPT search every round; same result either way)."""
+        from ..core.scheduler import _lane_schedule_cached
+
+        cfg = self.cfg
+        bs = cfg.batch_size
+        epochs = int(self._packed_ctx[1].epochs)
+        cohort_n = len(client_ids)
+        positions = np.arange(cohort_n)
+        if drop is not None:
+            positions = positions[~drop]
         counts = [
             min(self._batch_counts[int(client_ids[p])], self.num_local_batches)
             for p in positions
         ]
         seq_counts = [c * epochs for c in counts]
-        lanes, L = lane_schedule(seq_counts, self._axis_size,
-                                 max_lanes=len(positions),
-                                 force_lanes=cfg.packed_lanes)
-        L_pad = -(-L // 4) * 4  # quantize: few compiled (G, L) shapes
+        lanes, L = _lane_schedule_cached.__wrapped__(
+            tuple(int(c) for c in seq_counts), int(self._axis_size),
+            len(positions),
+            None if cfg.packed_lanes is None else int(cfg.packed_lanes))
+        L_pad = -(-L // 4) * 4
         G = len(lanes)
         idx = np.zeros((G, L_pad, bs), np.int32)
         mask = np.zeros((G, L_pad, bs), np.float32)
@@ -792,31 +1019,32 @@ class FedSimulator:
                     t += c
                 boundary[g, t - 1] = 1.0
                 bweight[g, t - 1] = float(packed.num_samples[0])
+        return {
+            "idx": idx, "mask": mask, "boundary": boundary,
+            "bweight": bweight, "pos": pos_arr, "sic": sic,
+            "shape": (G, L_pad), "cohort_n": cohort_n,
+        }
+
+    def _dispatch_packed(self, inputs: RoundInputs, step_rng):
+        p = inputs.payload
         cohort = {
-            "idx": jnp.asarray(idx),
-            "mask": jnp.asarray(mask),
-            "boundary": jnp.asarray(boundary),
-            "bweight": jnp.asarray(bweight),
-            "pos": jnp.asarray(pos_arr),
-            "sic": jnp.asarray(sic),
+            k: jnp.asarray(p[k])
+            for k in ("idx", "mask", "boundary", "bweight", "pos", "sic")
         }
         # introspection for tests/driver dryrun: lane grid of the last round
         # (G is always a multiple of the mesh client axis, so per-device
         # shards are G/axis_size lanes)
-        self._last_packed_shape = (G, L_pad)
+        self._last_packed_shape = p["shape"]
         self.params, self.server_state, metrics_vec = self._packed_step(
             self.params, self.server_state, cohort, step_rng,
-            jnp.float32(cohort_n), self._x_dev, self._y_dev,
+            jnp.float32(p["cohort_n"]), self._x_dev, self._y_dev,
         )
         return metrics_vec
 
-    def _run_bucketed_round(self, client_ids: np.ndarray, round_idx: int,
-                            drop, step_rng):
-        """Width-bucketed cohort execution (SimConfig.cohort_schedule doc):
-        one partial-aggregation program per width-class, a single finalize.
-        Numerically the same weighted mean as the even path (per-client RNG
-        and shuffles keyed by cohort position / client id, f32 partial
-        sums), modulo fp summation order."""
+    def _build_bucketed_inputs(self, client_ids: np.ndarray, round_idx: int,
+                               drop):
+        """Host side of the bucketed schedule: the exact-DP width classes
+        and each bucket's packed payload, all numpy."""
         from ..core.scheduler import bucket_schedule
 
         cfg = self.cfg
@@ -828,12 +1056,7 @@ class FedSimulator:
             counts, self._axis_size, cfg.max_width_buckets,
             max_width=self.num_local_batches,
         )
-        sum_wu = None
-        total_w = None
-        # metric accumulators stay DEVICE scalars (lazy): the caller defers
-        # the single readback so it overlaps the next round's compute
-        loss_sum = correct_sum = valid_sum = None
-        n_clients = 0
+        out = []
         for positions, width in buckets:
             ids = client_ids[positions]
             n_real = len(ids)
@@ -872,10 +1095,27 @@ class FedSimulator:
                 samples_np = samples_np.copy()
                 mask_np[:n_real] *= (~d)[:, None, None]
                 samples_np[:n_real] *= ~d
-            cohort = {k: jnp.asarray(v) for k, v in payload.items()}
-            cohort["mask"] = jnp.asarray(mask_np)
-            cohort["num_samples"] = jnp.asarray(samples_np)
-            cohort["pos"] = jnp.asarray(positions.astype(np.uint32))
+            payload["mask"] = mask_np
+            payload["num_samples"] = samples_np
+            payload["pos"] = positions.astype(np.uint32)
+            out.append({"ids": ids, "n_real": n_real, "payload": payload})
+        return out
+
+    def _dispatch_bucketed(self, inputs: RoundInputs, step_rng):
+        """Width-bucketed cohort execution (SimConfig.cohort_schedule doc):
+        one partial-aggregation program per width-class, a single finalize.
+        Numerically the same weighted mean as the even path (per-client RNG
+        and shuffles keyed by cohort position / client id, f32 partial
+        sums), modulo fp summation order."""
+        sum_wu = None
+        total_w = None
+        # metric accumulators stay DEVICE scalars (lazy): the caller defers
+        # the single readback so it overlaps the next round's compute
+        loss_sum = correct_sum = valid_sum = None
+        n_clients = 0
+        for bucket in inputs.payload:
+            ids, n_real = bucket["ids"], bucket["n_real"]
+            cohort = {k: jnp.asarray(v) for k, v in bucket["payload"].items()}
             states = self._cohort_states(ids)
             step_args = (self.params, cohort, states, step_rng)
             if self._use_device_data:

@@ -1,0 +1,163 @@
+"""Background round-input prefetcher: overlap round r+1 host packing with
+round r device compute.
+
+The packed-lane executor collapsed each FL round into one compiled program,
+but the host still built every round's cohort tensors inline between
+dispatches. ``FedSimulator.build_round_inputs`` is a *pure* function of
+``(seed, round_idx)`` — client sampling, the drop mask, and every per-client
+shuffle come from round-indexed RNG streams — so round r+1's packing can run
+any number of rounds ahead without changing a single bit of the result.
+``RoundPrefetcher`` runs it one to two rounds ahead on a daemon thread with a
+bounded handoff queue, shrinking the round loop's host critical path to a
+queue pop.
+
+Contracts:
+
+- **Ordering**: rounds are built and delivered strictly in sequence;
+  ``get(round_idx)`` checks the popped round matches.
+- **Exception propagation**: a builder exception is enqueued in round order
+  and re-raised from ``get`` on the round that failed (not swallowed on the
+  worker, not raised early for rounds that already built cleanly).
+- **Clean shutdown**: ``close`` is idempotent, unblocks a worker stuck on a
+  full queue, and joins the thread; the thread is a daemon as a backstop.
+- **Sync points**: ``paused()`` guarantees the worker is quiescent (not
+  inside the build function) for the duration of the block. The round loop
+  wraps eval/checkpoint work in it — mirroring the deferred-metric-readback
+  contract — so user hooks (``test_on_the_server``) that may touch the
+  dataset never race a background build, and orbax resume stays
+  bit-reproducible.
+
+One caveat the purity argument rests on: ``reference_client_sampling`` seeds
+numpy's *global* RNG (bit-parity with the reference), so nothing else may
+consume global ``np.random`` state concurrently with a build. The simulator
+upholds this by pausing the worker around the only user-code hook points.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import queue
+import threading
+from typing import Any, Callable, Iterable
+
+
+class RoundPrefetcher:
+    """Runs ``build_fn(round_idx)`` for each round on a background thread,
+    ``depth`` rounds ahead of the consumer."""
+
+    def __init__(
+        self,
+        build_fn: Callable[[int], Any],
+        rounds: Iterable[int],
+        depth: int = 2,
+        name: str = "round-prefetch",
+    ):
+        self._build_fn = build_fn
+        self._rounds = list(rounds)
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, int(depth)))
+        self._stop = threading.Event()
+        self._cond = threading.Condition()
+        self._paused = False
+        self._building = False
+        self._closed = False
+        self._thread = threading.Thread(target=self._worker, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    # --- worker side --------------------------------------------------------
+
+    def _worker(self) -> None:
+        for r in self._rounds:
+            with self._cond:
+                while self._paused and not self._stop.is_set():
+                    self._cond.wait(timeout=0.1)
+                if self._stop.is_set():
+                    return
+                self._building = True
+            exc = None
+            try:
+                item = self._build_fn(r)
+            except BaseException as e:  # noqa: BLE001 — re-raised in get()
+                item, exc = None, e
+            finally:
+                with self._cond:
+                    self._building = False
+                    self._cond.notify_all()
+            # bounded handoff; poll stop so close() never deadlocks a worker
+            # blocked on a full queue
+            while not self._stop.is_set():
+                try:
+                    self._q.put((r, item, exc), timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+            if exc is not None:
+                return  # fail-stop: later rounds would be built on thin air
+
+    # --- consumer side ------------------------------------------------------
+
+    def get(self, round_idx: int):
+        """Pop the next round's inputs (blocking); re-raises a worker
+        exception on the round it occurred."""
+        if self._closed:
+            raise RuntimeError("RoundPrefetcher is closed")
+        while True:
+            try:
+                r, item, exc = self._q.get(timeout=0.1)
+                break
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    raise RuntimeError(
+                        "prefetch worker exited without producing round "
+                        f"{round_idx}") from None
+        if exc is not None:
+            self.close()
+            raise exc
+        if r != round_idx:
+            self.close()
+            raise RuntimeError(
+                f"prefetch out of order: expected round {round_idx}, got {r}")
+        return item
+
+    def pause(self) -> None:
+        """Block until the worker is outside the build function and keep it
+        there until ``resume`` — the eval/checkpoint sync point."""
+        with self._cond:
+            self._paused = True
+            while self._building and not self._stop.is_set():
+                self._cond.wait(timeout=0.1)
+
+    def resume(self) -> None:
+        with self._cond:
+            self._paused = False
+            self._cond.notify_all()
+
+    @contextlib.contextmanager
+    def paused(self):
+        self.pause()
+        try:
+            yield
+        finally:
+            self.resume()
+
+    def close(self) -> None:
+        """Idempotent shutdown: stop the worker, drain the queue, join."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+        # drain so a worker blocked on put() can observe the stop flag
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                break
+        self._thread.join(timeout=10.0)
+
+    def __enter__(self) -> "RoundPrefetcher":
+        return self
+
+    def __exit__(self, *_exc) -> None:
+        self.close()

@@ -49,7 +49,11 @@ class CheckpointManager:
             return self.manager.restore(
                 step, args=self._ocp.args.StandardRestore(template)
             )
-        return self.manager.restore(step)
+        # explicit StandardRestore (structure inferred from the checkpoint):
+        # a bare restore(step) needs the manager to already know the item's
+        # handler, which only holds in the process that SAVED the step —
+        # a fresh resume process would hit orbax's handler-registry KeyError
+        return self.manager.restore(step, args=self._ocp.args.StandardRestore())
 
     def close(self) -> None:
         self.manager.close()

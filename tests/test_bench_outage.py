@@ -61,11 +61,14 @@ def test_success_emits_value(monkeypatch):
     import fedml_tpu.utils.chip_probe as cp
 
     monkeypatch.setattr(cp, "wait_for_chip", lambda *a, **k: (True, "ok"))
-    rc, rec = _run_main(monkeypatch, run_bench=lambda: (6.25, {}))
+    rc, rec = _run_main(
+        monkeypatch,
+        run_bench=lambda: (6.25, {}, {"overlap_mean": 0.8}))
     assert rc == 0
     assert rec["value"] == 6.25
     assert "error" not in rec and "candidate_errors" not in rec
     assert rec["vs_baseline"] > 0
+    assert rec["host_pack"] == {"overlap_mean": 0.8}
 
 
 def test_degraded_ab_run_is_flagged(monkeypatch):
@@ -77,7 +80,8 @@ def test_degraded_ab_run_is_flagged(monkeypatch):
     monkeypatch.setattr(cp, "wait_for_chip", lambda *a, **k: (True, "ok"))
     rc, rec = _run_main(
         monkeypatch,
-        run_bench=lambda: (4.5, {True: "RuntimeError: flat compile blew up"}))
+        run_bench=lambda: (4.5, {True: "RuntimeError: flat compile blew up"},
+                           {}))
     assert rc == 0
     assert rec["value"] == 4.5
     assert rec["candidate_errors"] == {

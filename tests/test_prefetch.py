@@ -1,0 +1,248 @@
+"""Asynchronous host-side cohort pipeline: prefetch parity + unit tests.
+
+The round loop overlaps round r+1's host packing with round r's device
+compute (simulation/prefetch.py). Correctness rests on two claims, both
+tested here: ``build_round_inputs`` is a pure function of (seed, round_idx)
+— so lookahead packing is BIT-exact, not approximately equal — and the
+vectorized packed builder produces byte-identical lane tensors to the
+pre-pipeline per-client loop it replaced.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+import fedml_tpu
+from fedml_tpu.simulation import build_simulator
+from fedml_tpu.simulation.prefetch import RoundPrefetcher
+
+# keys whose values are wall-clock measurements, not training results
+TIMING_KEYS = {"round_time", "dispatch_time", "pack_time", "pack_wait",
+               "overlap"}
+
+
+def _args(**kw):
+    base = dict(
+        dataset="cifar10", model="lr", partition_method="hetero",
+        partition_alpha=0.3, debug_small_data=True,
+        client_num_in_total=12, client_num_per_round=6, comm_round=3,
+        learning_rate=0.05, epochs=1, batch_size=16,
+        frequency_of_the_test=3, random_seed=0,
+    )
+    base.update(kw)
+    return fedml_tpu.init(config=base)
+
+
+def _flat(params):
+    return np.concatenate(
+        [np.asarray(l, np.float64).ravel() for l in jax.tree.leaves(params)])
+
+
+def _run(prefetch, **kw):
+    sim, apply_fn = build_simulator(_args(prefetch=prefetch, **kw))
+    assert sim.cfg.prefetch == prefetch
+    hist = sim.run(apply_fn, log_fn=None)
+    return _flat(sim.params), hist
+
+
+def _strip_timing(hist):
+    return [{k: v for k, v in rec.items() if k not in TIMING_KEYS}
+            for rec in hist]
+
+
+# --- prefetcher unit tests --------------------------------------------------
+
+
+def test_prefetcher_delivers_in_order():
+    with RoundPrefetcher(lambda r: r * 10, range(5), depth=2) as pf:
+        assert [pf.get(r) for r in range(5)] == [0, 10, 20, 30, 40]
+
+
+def test_prefetcher_propagates_worker_exception_on_its_round():
+    def build(r):
+        if r == 2:
+            raise ValueError("boom at round 2")
+        return r
+
+    pf = RoundPrefetcher(build, range(4), depth=1)
+    assert pf.get(0) == 0
+    assert pf.get(1) == 1
+    with pytest.raises(ValueError, match="boom at round 2"):
+        pf.get(2)
+    # the failure closed the pipeline — no zombie thread, no stale queue
+    assert pf._closed
+    assert not pf._thread.is_alive()
+
+
+def test_prefetcher_clean_shutdown_with_full_queue():
+    # depth-1 queue + an abandoned consumer: close() must unblock the
+    # worker (stuck on put) and join it, idempotently
+    pf = RoundPrefetcher(lambda r: r, range(100), depth=1)
+    assert pf.get(0) == 0
+    time.sleep(0.05)  # let the worker fill the queue and block on put
+    pf.close()
+    pf.close()
+    assert not pf._thread.is_alive()
+    with pytest.raises(RuntimeError, match="closed"):
+        pf.get(1)
+
+
+def test_prefetcher_pause_guarantees_quiescence():
+    in_build = threading.Event()
+    release = threading.Event()
+
+    def build(r):
+        in_build.set()
+        release.wait(timeout=5)
+        return r
+
+    pf = RoundPrefetcher(build, range(3), depth=1)
+    try:
+        assert in_build.wait(timeout=5)  # worker is INSIDE build(0)
+        release.set()
+        with pf.paused():
+            # pause blocked until the in-flight build finished; while
+            # paused the worker must not enter the next build
+            in_build.clear()
+            release.clear()
+            assert not in_build.wait(timeout=0.3)
+        release.set()
+        assert pf.get(0) == 0
+        assert pf.get(1) == 1
+    finally:
+        release.set()
+        pf.close()
+
+
+# --- bit-exact sync-vs-prefetch parity --------------------------------------
+
+
+@pytest.mark.parametrize("schedule", ["even", "bucketed", "packed"])
+def test_prefetch_parity_with_dropout(schedule):
+    """Prefetch on vs off: identical params (bit-exact) and identical
+    history modulo timing keys, with dropout injection exercising the
+    round-indexed drop RNG."""
+    kw = dict(cohort_schedule=schedule, client_dropout_rate=0.3)
+    f_sync, h_sync = _run(False, **kw)
+    f_pre, h_pre = _run(True, **kw)
+    np.testing.assert_array_equal(f_sync, f_pre)
+    assert _strip_timing(h_sync) == _strip_timing(h_pre)
+    # the pipeline actually overlapped: some round's packing was (mostly)
+    # hidden behind earlier device work
+    assert max(r["overlap"] for r in h_pre) > 0.0
+    assert all(r["overlap"] == 0.0 for r in h_sync)
+
+
+@pytest.mark.slow
+def test_prefetch_checkpoint_resume_matches_uninterrupted_sync(tmp_path):
+    """Interrupted-at-2 prefetch resume == uninterrupted synchronous run,
+    bit-exact (forced sync points at checkpoint rounds keep orbax state
+    consistent with the round the loop believes it is on)."""
+    kw = dict(cohort_schedule="packed", client_dropout_rate=0.3,
+              comm_round=4, frequency_of_the_test=100)
+    full, _ = _run(False, **kw)
+    ck = str(tmp_path / "ck")
+    _run(True, **dict(kw, comm_round=2, checkpoint_dir=ck,
+                      checkpoint_frequency=1))
+    f_res, h_res = _run(True, **dict(kw, checkpoint_dir=ck,
+                                     checkpoint_frequency=1))
+    assert h_res[0]["round"] == 2
+    np.testing.assert_array_equal(full, f_res)
+
+
+# --- vectorized packed builder == legacy per-client loop --------------------
+
+
+@pytest.mark.parametrize("epochs,drop", [(1, 0.0), (2, 0.3)])
+def test_packed_builder_matches_legacy_loop(epochs, drop):
+    sim, _ = build_simulator(_args(
+        cohort_schedule="packed", epochs=epochs, client_dropout_rate=drop))
+    assert sim._packed
+    from fedml_tpu.simulation.fed_sim import reference_client_sampling
+
+    cfg = sim.cfg
+    for r in range(3):
+        ci = np.asarray(reference_client_sampling(
+            r, cfg.client_num_in_total, cfg.client_num_per_round))
+        rng = np.random.default_rng([cfg.seed, r])
+        dmask = None
+        if drop > 0:
+            dmask = rng.random(len(ci)) < drop
+            if dmask.all():
+                dmask[0] = False
+        new = sim._build_packed_inputs(ci, r, dmask)
+        old = sim._build_packed_inputs_loop(ci, r, dmask)
+        for k in ("idx", "mask", "boundary", "bweight", "pos", "sic"):
+            np.testing.assert_array_equal(
+                np.asarray(new[k]), np.asarray(old[k]), err_msg=f"r={r} {k}")
+        assert new["shape"] == old["shape"]
+        assert new["cohort_n"] == old["cohort_n"]
+
+
+def test_packed_lane_plan_cache_reused():
+    sim, _ = build_simulator(_args(
+        cohort_schedule="packed", client_num_per_round=12))
+    ci = np.arange(12)
+    sim._build_packed_inputs(ci, 0, None)
+    assert len(sim._lane_plan_cache) == 1
+    plan = next(iter(sim._lane_plan_cache.values()))
+    sim._build_packed_inputs(ci, 1, None)
+    assert next(iter(sim._lane_plan_cache.values())) is plan
+    # a different drop pattern is a different plan
+    d = np.zeros(12, bool)
+    d[3] = True
+    sim._build_packed_inputs(ci, 2, d)
+    assert len(sim._lane_plan_cache) == 2
+
+
+# --- pack_client_index vectorization keeps rng/perm semantics ---------------
+
+
+def test_pack_client_index_rng_and_perm_paths_consistent():
+    sim, _ = build_simulator(_args())
+    fed, bs = sim.fed, 4
+    ids = list(fed.train_data_local_dict.keys())[:5]
+    # the rng path must consume one permutation per client IN COHORT ORDER
+    # (bit-compat with the pre-vectorization loop)
+    r1 = fed.pack_client_index(ids, bs, 3, rng=np.random.default_rng(7))
+    rng = np.random.default_rng(7)
+    perms = [rng.permutation(len(fed._global_index[c])) for c in ids]
+    r2 = fed.pack_client_index(ids, bs, 3, perms=perms)
+    np.testing.assert_array_equal(r1.idx, r2.idx)
+    np.testing.assert_array_equal(r1.mask, r2.mask)
+    np.testing.assert_array_equal(r1.num_samples, r2.num_samples)
+    # no-shuffle path: rows are each client's index list, in order, padded
+    r3 = fed.pack_client_index(ids[:1], bs, None)
+    n = min(len(fed._global_index[ids[0]]), r3.idx.size)
+    np.testing.assert_array_equal(
+        r3.idx.ravel()[:n], fed._global_index[ids[0]][:n])
+
+
+# --- profiler spans ---------------------------------------------------------
+
+
+def test_profiler_emits_pack_and_dispatch_spans():
+    from fedml_tpu.core.mlops import MetricsSink, MLOpsProfilerEvent
+
+    events = []
+    sink = MetricsSink()
+    sink.emit = events.append
+    prof = MLOpsProfilerEvent(sink=sink)
+    args = _args(cohort_schedule="even", comm_round=2)
+    args.profiler = prof
+    sim, _ = build_simulator(args)
+    assert sim._profiler is prof
+    sim.run(apply_fn=None, log_fn=None)
+    by_event = {}
+    for e in events:
+        by_event.setdefault((e["event"], e["kind"]), []).append(e)
+    assert len(by_event[("host_pack", "event_started")]) == 2
+    assert len(by_event[("host_pack", "event_ended")]) == 2
+    assert len(by_event[("round_dispatch", "event_ended")]) == 2
+    # ended spans carry a measured duration
+    assert all(e["duration"] is not None
+               for e in by_event[("host_pack", "event_ended")])
