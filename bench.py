@@ -45,7 +45,7 @@ import time
 
 
 def emit(value, vs_baseline, basis, error=None, candidate_errors=None,
-         host_pack=None) -> None:
+         host_pack=None, telemetry=None) -> None:
     line = {
         "metric": "fedavg_cifar10_resnet56_rounds_per_sec",
         "value": value,
@@ -66,6 +66,10 @@ def emit(value, vs_baseline, basis, error=None, candidate_errors=None,
         # (pack_time = build cost wherever it ran, pack_wait = round-loop
         # stall, overlap = fraction hidden behind earlier device work)
         line["host_pack"] = host_pack
+    if telemetry:
+        # phase breakdown + metrics-registry snapshot of the final timed
+        # block (fedml_tpu.core.telemetry) — where the round wall went
+        line["telemetry"] = telemetry
     print(json.dumps(line), flush=True)
 
 
@@ -78,6 +82,28 @@ def _host_pack_stats(history) -> dict:
         "pack_time_mean_s": round(mean("pack_time"), 6),
         "pack_wait_mean_s": round(mean("pack_wait"), 6),
         "overlap_mean": round(mean("overlap"), 4),
+    }
+
+
+def _phase_stats(history) -> dict:
+    """Mean per-round phase attribution over a run's history: where the
+    round wall-clock went (device wait vs dispatch vs eval vs host slack)
+    and how much of it the named phases cover (coverage_frac ~1.0 — the
+    accumulator is drained at the same stamp round_time is taken)."""
+    recs = [r for r in history if r.get("phases")]
+    if not recs:
+        return {}
+    acc: dict = {}
+    for r in recs:
+        for k, v in r["phases"].items():
+            acc[k] = acc.get(k, 0.0) + v
+    n = len(recs)
+    round_mean = sum(r["round_time"] for r in recs) / n
+    covered = sum(acc.values()) / n
+    return {
+        "round_time_mean_s": round(round_mean, 6),
+        "coverage_frac": round(covered / round_mean, 4) if round_mean else None,
+        "phase_breakdown_s": {k: round(v / n, 6) for k, v in acc.items()},
     }
 
 
@@ -186,6 +212,9 @@ def run_bench() -> tuple[float, dict, dict]:
     print(f"carry selected: {'flat' if flat else 'tree'}",
           file=sys.stderr, flush=True)
 
+    from fedml_tpu.core import telemetry as _telemetry
+
+    _telemetry.get_registry().reset()  # snapshot covers the timed blocks only
     block_rates = sorted(
         _timed_block(sim, rounds_per_block) for _ in range(blocks))
     rounds_per_sec = block_rates[len(block_rates) // 2]
@@ -195,8 +224,13 @@ def run_bench() -> tuple[float, dict, dict]:
         f"median={rounds_per_sec:.4f} spread={spread:.4f}",
         file=sys.stderr,
     )
+    telemetry_stats = {
+        **_phase_stats(sim.history),
+        "registry": _telemetry.get_registry().snapshot(),
+    }
     # history of the LAST timed block (each block clears it first)
-    return rounds_per_sec, errors, _host_pack_stats(sim.history)
+    return (rounds_per_sec, errors, _host_pack_stats(sim.history),
+            telemetry_stats)
 
 
 def main() -> int:
@@ -216,12 +250,13 @@ def main() -> int:
              error=f"backend unavailable after bounded retries ({detail})")
         return 1
     try:
-        rounds_per_sec, candidate_errors, host_pack = run_bench()
+        rounds_per_sec, candidate_errors, host_pack, telem = run_bench()
     except Exception as e:  # noqa: BLE001 — driver artifact must parse
         emit(None, None, basis, error=f"{type(e).__name__}: {e}")
         return 1
     emit(round(rounds_per_sec, 4), round(rounds_per_sec / baseline, 4), basis,
-         candidate_errors=candidate_errors, host_pack=host_pack)
+         candidate_errors=candidate_errors, host_pack=host_pack,
+         telemetry=telem)
     return 0
 
 
@@ -290,9 +325,74 @@ def host_pack_bench(rounds: int = 20) -> int:
     return 0 if ok else 1
 
 
+def telemetry_overhead_bench(rounds: int = 20, trials: int = 3,
+                             threshold: float = 0.01) -> int:
+    """``--telemetry-overhead``: CPU-only guard for the telemetry cost
+    budget (ISSUE: enabled-vs-disabled delta < 1% of round wall-clock).
+    One simulator, interleaved enabled/disabled 20-round blocks (interleaving
+    cancels thermal/allocator drift), compared on MIN wall per arm — min is
+    the noise-robust estimator for a lower-bounded cost. Also asserts the
+    per-round phase breakdown covers round_time within 5%."""
+    import fedml_tpu
+    from fedml_tpu.core import telemetry
+    from fedml_tpu.simulation import build_simulator
+
+    args = fedml_tpu.init(config=dict(
+        dataset="mnist", model="lr", debug_small_data=True,
+        client_num_in_total=20, client_num_per_round=10, comm_round=rounds,
+        learning_rate=0.1, epochs=1, batch_size=8,
+        frequency_of_the_test=10_000, random_seed=0,
+    ))
+    sim, _ = build_simulator(args)
+    sim.run(apply_fn=None, log_fn=None)  # compile warm-up (discarded)
+
+    def _block(enabled: bool) -> float:
+        telemetry.configure(enabled=enabled)
+        sim.history.clear()
+        t0 = time.perf_counter()
+        sim.run(apply_fn=None, log_fn=None)
+        return time.perf_counter() - t0
+
+    walls = {True: [], False: []}
+    for _ in range(trials):
+        for enabled in (True, False):
+            walls[enabled].append(_block(enabled))
+    on, off = min(walls[True]), min(walls[False])
+    overhead = (on - off) / off if off > 0 else 0.0
+    # phase coverage from the last ENABLED block's history
+    telemetry.configure(enabled=True)
+    sim.history.clear()
+    sim.run(apply_fn=None, log_fn=None)
+    phases = _phase_stats(sim.history)
+    cov = phases.get("coverage_frac") or 0.0
+    cov_ok = abs(cov - 1.0) <= 0.05
+    ok = overhead < threshold and cov_ok
+    line = {
+        "metric": "telemetry_overhead_frac",
+        "unit": (f"(min wall enabled - disabled)/disabled over {trials}x"
+                 f"{rounds}-round interleaved CPU blocks; budget <"
+                 f" {threshold}"),
+        "value": round(overhead, 5),
+        "wall_enabled_s": round(on, 4),
+        "wall_disabled_s": round(off, 4),
+        "phase_coverage_frac": cov,
+        "telemetry": phases,
+    }
+    print(json.dumps(line), flush=True)
+    print(f"telemetry-overhead: {overhead * 100:.3f}% (budget "
+          f"{threshold * 100:.0f}%) phase_coverage={cov} "
+          f"{'OK' if ok else 'OVER BUDGET' if cov_ok else 'COVERAGE GAP'}",
+          file=sys.stderr, flush=True)
+    return 0 if ok else 1
+
+
 if __name__ == "__main__":
     if "--host-pack" in sys.argv:
         # host-side measurement only — never wait on (or measure) the chip
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         sys.exit(host_pack_bench())
+    if "--telemetry-overhead" in sys.argv:
+        # host-side guard only — never wait on (or measure) the chip
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        sys.exit(telemetry_overhead_bench())
     sys.exit(main())

@@ -37,6 +37,9 @@ def init(args: Optional[Arguments] = None, config: Optional[Dict[str, Any]] = No
     if args is None:
         args = load_arguments(override=config)
     set_seeds(int(getattr(args, "random_seed", 0)))
+    from .core import telemetry
+
+    telemetry.configure_from_args(args)
     from .parallel.mesh import maybe_initialize_distributed
 
     maybe_initialize_distributed(args)

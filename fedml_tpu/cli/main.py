@@ -230,6 +230,77 @@ def run(config_file, backend):
         raise
 
 
+@cli.group("telemetry", help="Inspect telemetry artifacts.")
+def telemetry_group():
+    pass
+
+
+@telemetry_group.command(
+    "summary", help="Summarize a telemetry JSONL file (spans + registry).")
+@click.argument("jsonl_path", type=click.Path(exists=True))
+def telemetry_summary(jsonl_path):
+    spans = {}
+    snapshot = None
+    skipped = 0
+    with open(jsonl_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                skipped += 1
+                continue
+            kind = rec.get("kind")
+            if kind == "span":
+                s = spans.setdefault(
+                    rec.get("name", "?"), {"durations": [], "traces": set()})
+                s["durations"].append(float(rec.get("duration", 0.0)))
+                if rec.get("trace_id"):
+                    s["traces"].add(rec["trace_id"])
+            elif kind == "registry_snapshot":
+                snapshot = rec.get("registry")  # keep the LAST one
+    if spans:
+        click.echo("spans:")
+        click.echo(f"  {'name':<28}{'count':>7}{'total_s':>10}"
+                   f"{'mean_s':>10}{'p95_s':>10}{'traces':>8}")
+        for name in sorted(spans):
+            ds = sorted(spans[name]["durations"])
+            total = sum(ds)
+            p95 = ds[min(len(ds) - 1, int(0.95 * (len(ds) - 1)))]
+            click.echo(f"  {name:<28}{len(ds):>7}{total:>10.4f}"
+                       f"{total / len(ds):>10.5f}{p95:>10.5f}"
+                       f"{len(spans[name]['traces']):>8}")
+    if snapshot:
+        counters = snapshot.get("counters", {})
+        if counters:
+            click.echo("counters:")
+            for key in sorted(counters):
+                click.echo(f"  {key} = {counters[key]:g}")
+        hists = snapshot.get("histograms", {})
+        phase_rows = []
+        if hists:
+            click.echo("histograms:")
+            for key in sorted(hists):
+                h = hists[key]
+                n = h.get("count", 0)
+                mean = h["sum"] / n if n else 0.0
+                click.echo(f"  {key}: count={n:g} mean={mean:.6g}")
+                if key.startswith("fedml_round_phase_seconds{"):
+                    phase = key.split("phase=", 1)[-1].rstrip("}")
+                    phase_rows.append((phase, h["sum"]))
+        if phase_rows:
+            total = sum(v for _, v in phase_rows) or 1.0
+            click.echo("round phase breakdown (share of attributed wall):")
+            for phase, v in sorted(phase_rows, key=lambda kv: -kv[1]):
+                click.echo(f"  {phase:<12}{v:>12.4f}s{v / total:>9.1%}")
+    if not spans and not snapshot:
+        click.echo("no span or registry_snapshot records found")
+    if skipped:
+        click.echo(f"({skipped} unparseable lines skipped)")
+
+
 def main():
     cli()
 

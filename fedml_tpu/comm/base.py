@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import abc
 
+from ..core import telemetry
 from .message import Message
 
 
@@ -16,6 +17,22 @@ class Observer(abc.ABC):
     @abc.abstractmethod
     def receive_message(self, msg_type, msg_params: Message) -> None:
         ...
+
+
+def dispatch_to_observers(msg: Message, observers) -> None:
+    """Shared receive-side dispatch for every backend: restore the sender's
+    trace context (if the message carries one) around the observer calls, so
+    handlers — and any messages THEY send — run inside the sender's trace.
+    This is what makes one FL round share a single ``trace_id`` across the
+    server and every client, on any transport."""
+    ctx = telemetry.extract_trace(msg)
+    if ctx is not None:
+        with telemetry.use_context(ctx):
+            for observer in list(observers):
+                observer.receive_message(msg.get_type(), msg)
+    else:
+        for observer in list(observers):
+            observer.receive_message(msg.get_type(), msg)
 
 
 class BaseCommunicationManager(abc.ABC):

@@ -17,12 +17,14 @@ import csv
 import logging
 import os
 import queue
+import time
 from concurrent import futures
 from typing import Dict, List, Optional, Union
 
 import grpc
 
-from .base import BaseCommunicationManager, Observer
+from ..core import telemetry
+from .base import BaseCommunicationManager, Observer, dispatch_to_observers
 from .message import Message
 
 SERVICE_NAME = "fedml_tpu.CommService"
@@ -146,6 +148,7 @@ class GRPCCommManager(BaseCommunicationManager):
         self._inbox: "queue.Queue[Optional[Message]]" = queue.Queue()
 
         def _handle_send(request: bytes, context) -> bytes:
+            telemetry.record_receive("grpc", len(request))
             self._inbox.put(Message.from_bytes(request))
             return b"ok"
 
@@ -189,11 +192,15 @@ class GRPCCommManager(BaseCommunicationManager):
         )
 
     def send_message(self, msg: Message) -> None:
+        telemetry.inject_trace(msg)
+        t0 = time.perf_counter()
+        data = msg.to_bytes()
+        telemetry.record_send("grpc", len(data), time.perf_counter() - t0)
         # wait_for_ready rides out transient reconnects, but the deadline
         # bounds PERSISTENT failures (e.g. a TLS handshake that can never
         # succeed) — without it a misconfigured peer stalls the run silently
         self._stub(msg.get_receiver_id())(
-            msg.to_bytes(), wait_for_ready=True, timeout=self.send_timeout)
+            data, wait_for_ready=True, timeout=self.send_timeout)
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)
@@ -209,8 +216,7 @@ class GRPCCommManager(BaseCommunicationManager):
             msg = self._inbox.get()
             if msg is None:  # poison pill from stop_receive_message
                 break
-            for observer in list(self._observers):
-                observer.receive_message(msg.get_type(), msg)
+            dispatch_to_observers(msg, self._observers)
 
     def stop_receive_message(self) -> None:
         self._inbox.put(None)

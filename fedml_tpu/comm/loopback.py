@@ -11,9 +11,11 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Dict, List, Optional
 
-from .base import BaseCommunicationManager, Observer
+from ..core import telemetry
+from .base import BaseCommunicationManager, Observer, dispatch_to_observers
 from .message import Message
 
 
@@ -60,7 +62,12 @@ class LoopbackCommManager(BaseCommunicationManager):
         self._running = False
 
     def send_message(self, msg: Message) -> None:
-        self.hub.post(msg.get_receiver_id(), msg.to_bytes())
+        telemetry.inject_trace(msg)
+        t0 = time.perf_counter()
+        data = msg.to_bytes()
+        telemetry.record_send("loopback", len(data),
+                              time.perf_counter() - t0)
+        self.hub.post(msg.get_receiver_id(), data)
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)
@@ -76,8 +83,8 @@ class LoopbackCommManager(BaseCommunicationManager):
             if data is None:  # poison pill from stop_receive_message
                 break
             msg = Message.from_bytes(data)
-            for observer in list(self._observers):
-                observer.receive_message(msg.get_type(), msg)
+            telemetry.record_receive("loopback", len(data))
+            dispatch_to_observers(msg, self._observers)
 
     def stop_receive_message(self) -> None:
         self._running = False

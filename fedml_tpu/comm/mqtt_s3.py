@@ -18,10 +18,12 @@ from __future__ import annotations
 
 import logging
 import queue
+import time
 import uuid
 from typing import List, Optional
 
-from .base import BaseCommunicationManager, Observer
+from ..core import telemetry
+from .base import BaseCommunicationManager, Observer, dispatch_to_observers
 from .message import Message
 from .pubsub import PubSubBroker
 from .store import BlobStore
@@ -70,13 +72,16 @@ class MqttS3CommManager(BaseCommunicationManager):
         msg = Message.from_bytes(payload)
         key = msg.get(Message.MSG_ARG_KEY_MODEL_PARAMS)
         url = msg.get(Message.MSG_ARG_KEY_MODEL_PARAMS_URL)
+        nbytes = len(payload)
         if url is not None and isinstance(key, str):
             # control message carries key+URL; fetch the blob and restore the
             # real params (reference receiver path)
             from .message import unpack_payload
 
             blob = self.store.get(key)
+            nbytes += len(blob)
             msg.add_params(Message.MSG_ARG_KEY_MODEL_PARAMS, unpack_payload(blob))
+        telemetry.record_receive("mqtt_s3", nbytes)
         self._inbox.put(msg)
 
     def _topic_for(self, msg: Message) -> str:
@@ -98,9 +103,13 @@ class MqttS3CommManager(BaseCommunicationManager):
         out = Message()
         out.init(params)
         logging.debug("mqtt_s3: payload %d B -> store key %s", len(blob), key)
-        self.broker.publish(topic, out.to_bytes())
+        control = out.to_bytes()
+        telemetry.record_send("mqtt_s3", len(blob) + len(control))
+        self.broker.publish(topic, control)
 
     def send_message(self, msg: Message) -> None:
+        telemetry.inject_trace(msg)
+        t0 = time.perf_counter()
         topic = self._topic_for(msg)
         params = msg.get_params()
         model_params = params.get(Message.MSG_ARG_KEY_MODEL_PARAMS)
@@ -112,7 +121,9 @@ class MqttS3CommManager(BaseCommunicationManager):
                 self._offload_and_publish(
                     topic, params, blob, Message.MSG_ARG_KEY_MODEL_PARAMS)
                 return
-        self.broker.publish(topic, msg.to_bytes())
+        data = msg.to_bytes()
+        telemetry.record_send("mqtt_s3", len(data), time.perf_counter() - t0)
+        self.broker.publish(topic, data)
 
     # --- BaseCommunicationManager contract ----------------------------------
     def add_observer(self, observer: Observer) -> None:
@@ -127,8 +138,7 @@ class MqttS3CommManager(BaseCommunicationManager):
             msg = self._inbox.get()
             if msg is None:
                 break
-            for observer in list(self._observers):
-                observer.receive_message(msg.get_type(), msg)
+            dispatch_to_observers(msg, self._observers)
 
     def stop_receive_message(self) -> None:
         self._inbox.put(None)
@@ -168,6 +178,7 @@ class MqttS3MnnCommManager(MqttS3CommManager):
     def send_message(self, msg: Message) -> None:
         import os
 
+        telemetry.inject_trace(msg)
         path = msg.get(MSG_ARG_KEY_MODEL_FILE)
         if path is not None:
             if not os.path.exists(str(path)):

@@ -38,7 +38,8 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 import msgpack
 import numpy as np
 
-from .base import BaseCommunicationManager, Observer
+from ..core import telemetry
+from .base import BaseCommunicationManager, Observer, dispatch_to_observers
 from .grpc_backend import build_ip_table
 from .message import Message, _dtype_token, _resolve_dtype
 
@@ -279,6 +280,7 @@ class TRPCCommManager(BaseCommunicationManager):
                 return
             msg = Message()
             msg.init(params)
+            telemetry.record_receive("trpc")
             self._inbox.put(msg)
 
     def _pipe(self, receiver_id: int) -> socket.socket:
@@ -302,9 +304,13 @@ class TRPCCommManager(BaseCommunicationManager):
 
     # --- BaseCommunicationManager -------------------------------------------
     def send_message(self, msg: Message) -> None:
+        telemetry.inject_trace(msg)
         receiver = msg.get_receiver_id()
         sock = self._pipe(receiver)
+        t0 = time.perf_counter()
         chunks = encode_frames(msg.get_params())
+        telemetry.record_send("trpc", sum(len(c) for c in chunks),
+                              time.perf_counter() - t0)
         with self._send_locks[receiver]:
             # scatter-gather send: tensor buffers go to the kernel as-is
             try:
@@ -328,8 +334,7 @@ class TRPCCommManager(BaseCommunicationManager):
             msg = self._inbox.get()
             if msg is None:
                 break
-            for observer in list(self._observers):
-                observer.receive_message(msg.get_type(), msg)
+            dispatch_to_observers(msg, self._observers)
 
     def stop_receive_message(self) -> None:
         self._stopping.set()

@@ -13,6 +13,7 @@ spans the reference wraps around its round FSM
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import logging
@@ -25,17 +26,25 @@ from typing import Any, Dict, List, Optional
 
 
 class MetricsSink:
-    """Default sink: bounded in-memory record list + optional JSONL file."""
+    """Default sink: bounded in-memory record ring + optional JSONL file.
+
+    The in-memory buffer is a RING: at ``max_records`` the oldest record is
+    evicted (a long run keeps its most recent telemetry, and the JSONL file
+    — when configured — still holds everything). Eviction is counted in
+    ``dropped_records`` so truncation is visible, never silent."""
 
     def __init__(self, path: Optional[str] = None, max_records: int = 100_000):
         self.path = path
-        self.records: List[Dict[str, Any]] = []
+        self.records: "collections.deque[Dict[str, Any]]" = collections.deque(
+            maxlen=max_records)
         self.max_records = max_records
+        self.dropped_records = 0
         self._fh = open(path, "a") if path else None
 
     def emit(self, record: Dict[str, Any]) -> None:
-        if len(self.records) < self.max_records:
-            self.records.append(record)
+        if len(self.records) == self.max_records:
+            self.dropped_records += 1  # deque evicts the oldest on append
+        self.records.append(record)
         if self._fh:
             self._fh.write(json.dumps(record, default=str) + "\n")
             self._fh.flush()
@@ -61,6 +70,10 @@ class MLOpsRuntimeLog:
     def get_instance(cls, args) -> "MLOpsRuntimeLog":
         if cls._instance is None:
             cls._instance = cls(args)
+        else:
+            # re-bind on every call: a second run in one process must log
+            # the NEW rank/run_id, not the args of whoever called first
+            cls._instance.args = args
         return cls._instance
 
     def init_logs(self, show_stdout: bool = True) -> None:
@@ -209,21 +222,55 @@ class MLOpsProfilerEvent:
 class SysStats:
     """psutil CPU/mem/disk/net + JAX device memory (reference
     ``system_stats.py:8`` uses psutil+pynvml; TPU memory comes from
-    ``device.memory_stats()`` instead of NVML)."""
+    ``device.memory_stats()`` instead of NVML).
+
+    ``net_*_mb``/``disk_*_mb`` are PER-INTERVAL deltas since the previous
+    ``SysStats()`` sample in this process (the first sample anchors the
+    baseline and reports 0.0) — psutil's raw counters are monotonic
+    host-lifetime cumulatives, useless for "what did this round ship". The
+    psutil process handle is created once and cached (each ``Process()``
+    construction re-reads /proc)."""
+
+    _process = None           # cached psutil.Process handle
+    _last_counters = None     # (monotonic_ts, net_sent, net_recv, disk_r, disk_w)
+    _lock = threading.Lock()
 
     def __init__(self):
         import psutil
 
+        cls = SysStats
+        if cls._process is None:
+            cls._process = psutil.Process()
         self.cpu_utilization = psutil.cpu_percent(interval=None)
         vm = psutil.virtual_memory()
-        self.process_memory_gb = psutil.Process().memory_info().rss / 1e9
+        self.process_memory_gb = cls._process.memory_info().rss / 1e9
         self.host_memory_used_gb = vm.used / 1e9
         self.host_memory_total_gb = vm.total / 1e9
         du = psutil.disk_usage("/")
         self.disk_utilization = du.percent
         net = psutil.net_io_counters()
-        self.net_sent_mb = net.bytes_sent / 1e6
-        self.net_recv_mb = net.bytes_recv / 1e6
+        dio = None
+        try:
+            dio = psutil.disk_io_counters()
+        except Exception:  # unavailable in some containers
+            pass
+        now = time.monotonic()
+        cur = (now, net.bytes_sent, net.bytes_recv,
+               dio.read_bytes if dio else 0, dio.write_bytes if dio else 0)
+        with cls._lock:
+            prev = cls._last_counters
+            cls._last_counters = cur
+        if prev is None:
+            self.interval_s = 0.0
+            self.net_sent_mb = self.net_recv_mb = 0.0
+            self.disk_read_mb = self.disk_write_mb = 0.0
+        else:
+            self.interval_s = now - prev[0]
+            # max(0): counters can reset (interface bounce, container restart)
+            self.net_sent_mb = max(0, cur[1] - prev[1]) / 1e6
+            self.net_recv_mb = max(0, cur[2] - prev[2]) / 1e6
+            self.disk_read_mb = max(0, cur[3] - prev[3]) / 1e6
+            self.disk_write_mb = max(0, cur[4] - prev[4]) / 1e6
         self.device_memory: List[Dict[str, float]] = []
         try:
             import jax

@@ -10,10 +10,12 @@ reference's weighted param mean but half the numerical drift in bf16.
 from __future__ import annotations
 
 import logging
+from typing import Dict
 
 
 from ..comm import Message, ClientManager
 from ..comm.utils import log_communication_tick, log_communication_tock
+from ..core import telemetry
 from .message_define import MyMessage
 
 
@@ -24,6 +26,9 @@ class FedMLClientManager(ClientManager):
         self.trainer = trainer
         self.num_rounds = int(getattr(args, "comm_round", 1))
         self.round_idx = 0
+        # trace ids observed per round (restored from the server's stamped
+        # init/sync messages) — the client half of round-trace parity
+        self.round_trace_ids: Dict[int, str] = {}
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -62,7 +67,16 @@ class FedMLClientManager(ClientManager):
 
     def _train(self) -> None:
         logging.info("client %d: round %d train start", self.rank, self.round_idx)
-        update, local_sample_num = self.trainer.train(self.round_idx)
+        # handler dispatch restored the server's round trace context before
+        # calling us — record it (parity check hook) and span the local train;
+        # the upload below then inherits the same trace via inject_trace.
+        ctx = telemetry.current_context()
+        if ctx is not None:
+            self.round_trace_ids[self.round_idx] = ctx.trace_id
+        with telemetry.get_tracer().span(
+            "client.train", round_idx=self.round_idx, client=self.rank
+        ):
+            update, local_sample_num = self.trainer.train(self.round_idx)
         if getattr(self.args, "comm_quantize", False):
             from ..comm.message import compress_tree
 

@@ -13,6 +13,7 @@ ride the binary codec instead of pickle/S3 URLs.
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import threading
 import time
@@ -20,6 +21,7 @@ from typing import Dict, List, Optional
 
 from ..comm import Message, ServerManager
 from ..comm.utils import log_round_end, log_round_start
+from ..core import telemetry
 from .message_define import MyMessage
 
 
@@ -60,6 +62,13 @@ class FedMLServerManager(ServerManager):
         self._round_lock = threading.Lock()
         self._round_gen = 0  # increments at each round completion
         self._timer: Optional[threading.Timer] = None
+        # telemetry: one root trace context per round (init/sync messages are
+        # stamped with it, clients inherit it on receive and their replies
+        # carry it back) + per-client round-trip timing from broadcast to
+        # model receipt — the straggler-tail histogram
+        self._round_ctx: Optional[telemetry.TraceContext] = None
+        self.round_trace_ids: Dict[int, str] = {}
+        self._client_send_ts: Dict[int, float] = {}
         # event spans around the round FSM (reference wraps server.wait /
         # server.agg_and_eval the same way, fedml_server_manager.py:66-69)
         self.mlops_event = None
@@ -82,16 +91,26 @@ class FedMLServerManager(ServerManager):
         self.aggregator.set_expected_this_round(len(self.client_id_list_in_this_round))
         global_model_params = self.aggregator.get_global_model_params()
         round_gen = self._round_gen
-        for idx, client_id in enumerate(self.client_id_list_in_this_round):
-            msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank, client_id)
-            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model_params)
-            msg.add_params(
-                MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(self.data_silo_index_list[idx])
-            )
-            self.send_message(msg)
+        self._round_ctx = telemetry.new_round_context(self.round_idx)
+        if self._round_ctx is not None:
+            self.round_trace_ids[self.round_idx] = self._round_ctx.trace_id
+        with self._in_round_ctx():
+            for idx, client_id in enumerate(self.client_id_list_in_this_round):
+                msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank, client_id)
+                msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model_params)
+                msg.add_params(
+                    MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(self.data_silo_index_list[idx])
+                )
+                self._client_send_ts[client_id] = time.perf_counter()
+                self.send_message(msg)
         # arm at round start: a round where every client dies before its first
         # upload must still time out
         self._arm_round_timer(round_gen)
+
+    def _in_round_ctx(self, ctx: Optional[telemetry.TraceContext] = None):
+        ctx = ctx or self._round_ctx
+        return telemetry.use_context(ctx) if ctx is not None \
+            else contextlib.nullcontext()
 
     def _arm_round_timer(self, expected_gen: int) -> None:
         """Arm the straggler timer for the round that started at generation
@@ -153,6 +172,14 @@ class FedMLServerManager(ServerManager):
     def _on_model_from_client(self, msg: Message) -> None:
         model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         local_sample_num = msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
+        sent_at = self._client_send_ts.get(msg.get_sender_id())
+        if sent_at is not None:
+            # broadcast -> model receipt: wire + local training + wire, per
+            # client — the tail of this histogram IS the straggler tail
+            telemetry.get_registry().histogram(
+                "fedml_client_round_trip_seconds",
+                client=str(msg.get_sender_id()),
+            ).observe(time.perf_counter() - sent_at)
         outcome = None
         with self._round_lock:
             msg_round = msg.get(MyMessage.MSG_ARG_KEY_ROUND_INDEX)
@@ -213,8 +240,14 @@ class FedMLServerManager(ServerManager):
         if self.mlops_event:
             self.mlops_event.log_event_started("server.agg_and_eval",
                                                event_value=str(self.round_idx))
-        self.aggregator.aggregate()
-        metrics = self.aggregator.test_on_server_for_all_clients(self.round_idx) or {}
+        # span under the completed round's trace context (the timeout path
+        # arrives on a timer thread with no inherited context)
+        with self._in_round_ctx():
+            with telemetry.get_tracer().span("server.agg_and_eval",
+                                             round_idx=self.round_idx):
+                self.aggregator.aggregate()
+                metrics = self.aggregator.test_on_server_for_all_clients(
+                    self.round_idx) or {}
         if self.mlops_event:
             self.mlops_event.log_event_ended("server.agg_and_eval",
                                              event_value=str(self.round_idx))
@@ -227,7 +260,7 @@ class FedMLServerManager(ServerManager):
                 Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank, client_id)
                 for client_id in self.client_real_ids
             ]
-            return msgs, True, self._round_gen
+            return msgs, True, self._round_gen, self._round_ctx
         # next cohort
         self.client_id_list_in_this_round = self.aggregator.client_selection(
             self.round_idx, self.client_real_ids,
@@ -240,6 +273,10 @@ class FedMLServerManager(ServerManager):
         )
         self.aggregator.set_expected_this_round(len(self.client_id_list_in_this_round))
         log_round_start(self.rank, self.round_idx)
+        # fresh root trace for the round that starts with these SYNC messages
+        self._round_ctx = telemetry.new_round_context(self.round_idx)
+        if self._round_ctx is not None:
+            self.round_trace_ids[self.round_idx] = self._round_ctx.trace_id
         global_model_params = self.aggregator.get_global_model_params()
         msgs = []
         for idx, client_id in enumerate(self.client_id_list_in_this_round):
@@ -250,16 +287,18 @@ class FedMLServerManager(ServerManager):
             )
             sync.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
             msgs.append(sync)
-        return msgs, False, self._round_gen
+        return msgs, False, self._round_gen, self._round_ctx
 
     def _dispatch_round_end(self, outcome) -> None:
         """Send the round-end messages prepared under the lock, then either
         finish or arm the next round's straggler timer."""
         if outcome is None:
             return
-        msgs, finished, gen = outcome
-        for m in msgs:
-            self.send_message(m)
+        msgs, finished, gen, ctx = outcome
+        with self._in_round_ctx(ctx):
+            for m in msgs:
+                self._client_send_ts[m.get_receiver_id()] = time.perf_counter()
+                self.send_message(m)
         if finished:
             logging.info(
                 "server: training finished in %.1fs",

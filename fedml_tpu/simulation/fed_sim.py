@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import telemetry
 from ..core.algframe import FedAlgorithm
 from ..data.federated import FederatedData
 from ..algorithms.local_sgd import make_eval_fn
@@ -228,6 +229,10 @@ class FedSimulator:
         # packed schedule: round-independent lane structure per (cohort,
         # drop) pattern — full-participation runs hit every round
         self._lane_plan_cache: Dict[Any, Dict[str, Any]] = {}
+        # phase attribution: (phase, seconds) intervals accrued since the
+        # last round-completion stamp; drained into rec["phases"] by
+        # _finalize_rec so the named phases + host_other sum to round_time
+        self._phase_acc: List[Any] = []
 
         sizes = [len(v) for v in fed_data.train_data_local_dict.values()]
         if cfg.num_local_batches is None:
@@ -627,7 +632,9 @@ class FedSimulator:
                 # queue pop (~µs) while pack_time was spent on the worker
                 # under the PREVIOUS round's device compute
                 pack_wait = time.perf_counter() - t0
+                self._phase_acc.append(("pack_wait", pack_wait))
                 step_rng = jax.random.fold_in(base_rng, round_idx)
+                t_disp = time.perf_counter()
                 with self._span("round_dispatch", str(round_idx)):
                     if inputs.kind == "packed":
                         metrics_vec = self._dispatch_packed(inputs, step_rng)
@@ -635,6 +642,8 @@ class FedSimulator:
                         metrics_vec = self._dispatch_bucketed(inputs, step_rng)
                     else:
                         metrics_vec = self._dispatch_even(inputs, step_rng)
+                self._phase_acc.append(
+                    ("dispatch", time.perf_counter() - t_disp))
                 timing = {
                     "pack_time": inputs.pack_time,
                     "pack_wait": pack_wait,
@@ -660,6 +669,7 @@ class FedSimulator:
         jax.block_until_ready(self.params)
         if ckpt is not None:
             ckpt.close()
+        telemetry.flush()
         return self.history
 
     def _span(self, name: str, value: Optional[str] = None):
@@ -717,12 +727,38 @@ class FedSimulator:
         metric read proves the round's executables retired); with the
         pipelined readback this is the honest per-round throughput number —
         the raw host dispatch time is kept as ``dispatch_time``."""
+        t_dev = time.perf_counter()
         mvec = np.asarray(rec.pop("_mvec"))
         now = time.perf_counter()
+        # the blocking readback IS the wait on device compute still in flight
+        self._phase_acc.append(("device", now - t_dev))
         rec["round_time"] = now - self._last_round_end
         self._last_round_end = now
         rec["train_loss"] = float(mvec[0])
         rec["train_acc"] = float(mvec[1])
+        # drain the interval accumulator: everything the host did between the
+        # previous completion stamp and this one, keyed by phase; the
+        # remainder (logging, bookkeeping, deferred eval of earlier rounds'
+        # records...) is host_other, so the breakdown sums to round_time
+        phases: Dict[str, float] = {}
+        for name, dt in self._phase_acc:
+            phases[name] = phases.get(name, 0.0) + dt
+        self._phase_acc.clear()
+        phases["host_other"] = max(
+            0.0, rec["round_time"] - sum(phases.values()))
+        rec["phases"] = phases
+        reg = telemetry.get_registry()
+        if reg.enabled:
+            reg.counter("fedml_rounds_total").inc()
+            reg.histogram("fedml_round_seconds").observe(rec["round_time"])
+            for name, dt in phases.items():
+                reg.histogram(
+                    "fedml_round_phase_seconds", phase=name).observe(dt)
+            if rec.get("pack_time"):
+                # overlapped with the previous round's device compute, so
+                # tracked separately — NOT part of the round_time breakdown
+                reg.histogram(
+                    "fedml_host_pack_seconds").observe(rec["pack_time"])
         self._post_round(rec, rec["round"], apply_fn, ckpt, log_fn)
 
     def _post_round(self, rec, round_idx, apply_fn, ckpt, log_fn) -> None:
@@ -737,6 +773,7 @@ class FedSimulator:
 
     def _post_round_body(self, rec, round_idx, apply_fn, ckpt, log_fn) -> None:
         if apply_fn is not None and self._should_eval(round_idx):
+            t_eval = time.perf_counter()
             handled = False
             if self._server_tester is not None:
                 # reference signature (FedAVGAggregator.py:130): the real
@@ -755,15 +792,19 @@ class FedSimulator:
                 rec.update(self.evaluate(apply_fn))
                 if self.cfg.local_test_on_all_clients:
                     rec.update(self.local_test_on_all_clients(apply_fn))
+            self._phase_acc.append(("eval", time.perf_counter() - t_eval))
         self.history.append(rec)
         if ckpt is not None and self._should_checkpoint(round_idx):
             from ..utils.checkpoint import save_simulator_state
 
+            t_ckpt = time.perf_counter()
             save_simulator_state(ckpt, self, round_idx)
+            self._phase_acc.append(
+                ("checkpoint", time.perf_counter() - t_ckpt))
         if log_fn:
             log_fn(f"[round {round_idx}] " + " ".join(
                 f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}"
-                for k, v in rec.items() if k not in ("round", "per_client")
+                for k, v in rec.items() if k not in ("round", "per_client", "phases")
             ))
 
     def _client_perms(self, client_ids, round_idx: int):

@@ -63,7 +63,7 @@ def test_success_emits_value(monkeypatch):
     monkeypatch.setattr(cp, "wait_for_chip", lambda *a, **k: (True, "ok"))
     rc, rec = _run_main(
         monkeypatch,
-        run_bench=lambda: (6.25, {}, {"overlap_mean": 0.8}))
+        run_bench=lambda: (6.25, {}, {"overlap_mean": 0.8}, {}))
     assert rc == 0
     assert rec["value"] == 6.25
     assert "error" not in rec and "candidate_errors" not in rec
@@ -81,7 +81,7 @@ def test_degraded_ab_run_is_flagged(monkeypatch):
     rc, rec = _run_main(
         monkeypatch,
         run_bench=lambda: (4.5, {True: "RuntimeError: flat compile blew up"},
-                           {}))
+                           {}, {}))
     assert rc == 0
     assert rec["value"] == 4.5
     assert rec["candidate_errors"] == {

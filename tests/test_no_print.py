@@ -1,0 +1,29 @@
+"""Tier-1 lint: no bare print() in fedml_tpu/ library code (scripts/check_no_print.py)."""
+
+import os
+import subprocess
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_no_bare_print_in_library():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "scripts", "check_no_print.py")],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, r.stderr
+
+
+def test_lint_catches_a_planted_print(tmp_path):
+    """The checker must actually flag a bare call — but not a bare
+    reference (``log_fn=print`` stays legal)."""
+    sys.path.insert(0, os.path.join(REPO_ROOT, "scripts"))
+    try:
+        from check_no_print import find_print_calls
+    finally:
+        sys.path.pop(0)
+    p = tmp_path / "mod.py"
+    p.write_text("def f(log_fn=print):\n    print('hot path')\n")
+    hits = find_print_calls(str(p))
+    assert [ln for ln, _ in hits] == [2]

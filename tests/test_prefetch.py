@@ -22,7 +22,7 @@ from fedml_tpu.simulation.prefetch import RoundPrefetcher
 
 # keys whose values are wall-clock measurements, not training results
 TIMING_KEYS = {"round_time", "dispatch_time", "pack_time", "pack_wait",
-               "overlap"}
+               "overlap", "phases"}
 
 
 def _args(**kw):
