@@ -386,6 +386,35 @@ def telemetry_overhead_bench(rounds: int = 20, trials: int = 3,
     return 0 if ok else 1
 
 
+def chaos_bench(seed: int = 7) -> int:
+    """``--chaos``: CPU-only robustness gate — a full loopback cross-silo
+    deployment under a seeded fault plan (message drops + injected transient
+    send failures + one client crash) must still complete every round. Same
+    drill as ``fedml-tpu chaos-drill`` / tests/test_chaos.py; the JSON line
+    reports rounds completed, wall time, and resilience-plane counters."""
+    from fedml_tpu.cross_silo.chaos import run_chaos_drill
+
+    result = run_chaos_drill(
+        fault_seed=seed, fault_drop_rate=0.2, fault_fail_send_rate=0.2,
+        fault_crash_rank=3, fault_crash_at_round=1,
+    )
+    line = {
+        "metric": "chaos_drill_rounds_completed",
+        "unit": (f"rounds closed under seeded faults (seed={seed}, drop 20%, "
+                 "fail-send 20%, rank-3 crash at round 1) / rounds expected"),
+        "value": result.rounds_completed,
+        "expected": result.rounds_expected,
+        "elapsed_s": round(result.elapsed_s, 3),
+        "faults_injected": {k: int(v)
+                            for k, v in result.faults_injected.items()},
+        "send_retries": int(result.send_retries),
+        "send_failures": int(result.send_failures),
+    }
+    print(json.dumps(line), flush=True)
+    print(result.summary(), file=sys.stderr, flush=True)
+    return 0 if result.ok else 1
+
+
 if __name__ == "__main__":
     if "--host-pack" in sys.argv:
         # host-side measurement only — never wait on (or measure) the chip
@@ -395,4 +424,8 @@ if __name__ == "__main__":
         # host-side guard only — never wait on (or measure) the chip
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         sys.exit(telemetry_overhead_bench())
+    if "--chaos" in sys.argv:
+        # protocol-level drill — loopback only, never touches the chip
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        sys.exit(chaos_bench())
     sys.exit(main())

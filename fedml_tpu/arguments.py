@@ -35,6 +35,9 @@ SECTION_FAMILIES = (
     "security_args",
     "attack_args",
     "defense_args",
+    # fault injection / retry / recovery (fault_*, send_retry*,
+    # handshake_timeout, round_ckpt_path, ... — see docs/robustness.md)
+    "robustness_args",
 )
 
 

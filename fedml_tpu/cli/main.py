@@ -230,6 +230,44 @@ def run(config_file, backend):
         raise
 
 
+@cli.command("chaos-drill",
+             help="Run a seeded fault-injection drill over loopback.")
+@click.option("--seed", default=7, type=int, help="Fault plan seed.")
+@click.option("--rounds", default=3, type=int)
+@click.option("--clients", default=3, type=int)
+@click.option("--drop-rate", default=0.2, type=float,
+              help="Per-message drop probability.")
+@click.option("--duplicate-rate", default=0.0, type=float)
+@click.option("--fail-send-rate", default=0.0, type=float,
+              help="Per-attempt transient send-failure probability.")
+@click.option("--crash-rank", default=None, type=int,
+              help="Rank to crash (black-hole) mid-run.")
+@click.option("--crash-at-round", default=1, type=int)
+@click.option("--timeout", default=120.0, type=float,
+              help="Hang bound: the drill fails if the run outlives this.")
+def chaos_drill(seed, rounds, clients, drop_rate, duplicate_rate,
+                fail_send_rate, crash_rank, crash_at_round, timeout):
+    """Stand up a full cross-silo deployment (server + clients, real codec,
+    real round FSM) under the given fault plan and verify every round still
+    closes. Exits 1 if the run hangs or loses rounds — the same check
+    ``tests/test_chaos.py`` gates CI with, runnable against any config."""
+    from ..cross_silo.chaos import run_chaos_drill
+
+    kw = dict(
+        fault_seed=seed, comm_round=rounds, client_num_in_total=clients,
+        client_num_per_round=clients, fault_drop_rate=drop_rate,
+        fault_duplicate_rate=duplicate_rate,
+        fault_fail_send_rate=fail_send_rate,
+    )
+    if crash_rank is not None:
+        kw.update(fault_crash_rank=crash_rank,
+                  fault_crash_at_round=crash_at_round)
+    result = run_chaos_drill(join_timeout_s=timeout, **kw)
+    click.echo(result.summary())
+    if not result.ok:
+        raise SystemExit(1)
+
+
 @cli.group("telemetry", help="Inspect telemetry artifacts.")
 def telemetry_group():
     pass

@@ -8,6 +8,8 @@ Parity: reference ``core/distributed/communication/base_com_manager.py:7`` and
 from __future__ import annotations
 
 import abc
+import contextlib
+import logging
 
 from ..core import telemetry
 from .message import Message
@@ -24,15 +26,24 @@ def dispatch_to_observers(msg: Message, observers) -> None:
     trace context (if the message carries one) around the observer calls, so
     handlers — and any messages THEY send — run inside the sender's trace.
     This is what makes one FL round share a single ``trace_id`` across the
-    server and every client, on any transport."""
+    server and every client, on any transport.
+
+    A handler exception must not kill the backend's receive/drain loop (one
+    bad message would deafen the actor for the rest of the run): it is
+    logged with the message type, counted in the registry, and the loop
+    keeps draining.
+    """
     ctx = telemetry.extract_trace(msg)
-    if ctx is not None:
-        with telemetry.use_context(ctx):
-            for observer in list(observers):
-                observer.receive_message(msg.get_type(), msg)
-    else:
+    with (telemetry.use_context(ctx) if ctx is not None
+          else contextlib.nullcontext()):
         for observer in list(observers):
-            observer.receive_message(msg.get_type(), msg)
+            try:
+                observer.receive_message(msg.get_type(), msg)
+            except Exception:
+                telemetry.record_observer_error(msg.get_type())
+                logging.exception(
+                    "observer %r failed handling msg_type=%r — receive loop "
+                    "continues", type(observer).__name__, msg.get_type())
 
 
 class BaseCommunicationManager(abc.ABC):

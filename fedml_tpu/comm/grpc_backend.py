@@ -26,6 +26,7 @@ import grpc
 from ..core import telemetry
 from .base import BaseCommunicationManager, Observer, dispatch_to_observers
 from .message import Message
+from .resilience import retry_send
 
 SERVICE_NAME = "fedml_tpu.CommService"
 METHOD_SEND = "SendMessage"
@@ -109,6 +110,8 @@ class GrpcTls:
 
 
 class GRPCCommManager(BaseCommunicationManager):
+    _metrics_name = "grpc"
+
     def __init__(
         self,
         host: str = "0.0.0.0",
@@ -119,11 +122,13 @@ class GRPCCommManager(BaseCommunicationManager):
         base_port: int = 8890,
         tls: Optional["GrpcTls"] = None,
         send_timeout: float = 300.0,
+        retry_policy=None,
     ):
         self.rank = int(rank)
         self.size = int(size)
         self.base_port = int(base_port)
         self.tls = tls
+        self.retry_policy = retry_policy
         self.send_timeout = float(send_timeout)
         self.port = int(port) if port is not None else self.base_port + self.rank
         self.ip_table = build_ip_table(ip_config, size)
@@ -174,10 +179,19 @@ class GRPCCommManager(BaseCommunicationManager):
         logging.info("grpc server started: rank %d @ %s:%d (tls=%s)",
                      rank, host, self.port, self.tls is not None)
 
+    def _target(self, receiver_id: int) -> str:
+        entry = self.ip_table.get(receiver_id)
+        if entry is None:
+            # keep this printable for failure context; _stub's table lookup
+            # is what actually raises on a missing peer
+            return f"<no ip-table entry for rank {receiver_id}>"
+        return entry if ":" in entry else f"{entry}:{self.base_port + receiver_id}"
+
     def _stub(self, receiver_id: int):
         if receiver_id not in self._channels:
-            entry = self.ip_table[receiver_id]
-            target = entry if ":" in entry else f"{entry}:{self.base_port + receiver_id}"
+            entry = self.ip_table[receiver_id]  # missing peer: loud KeyError
+            target = (entry if ":" in entry
+                      else f"{entry}:{self.base_port + receiver_id}")
             if self.tls is not None:
                 channel = grpc.secure_channel(
                     target, self.tls.channel_credentials(),
@@ -196,11 +210,21 @@ class GRPCCommManager(BaseCommunicationManager):
         t0 = time.perf_counter()
         data = msg.to_bytes()
         telemetry.record_send("grpc", len(data), time.perf_counter() - t0)
+        receiver = msg.get_receiver_id()
         # wait_for_ready rides out transient reconnects, but the deadline
         # bounds PERSISTENT failures (e.g. a TLS handshake that can never
-        # succeed) — without it a misconfigured peer stalls the run silently
-        self._stub(msg.get_receiver_id())(
-            data, wait_for_ready=True, timeout=self.send_timeout)
+        # succeed) — without it a misconfigured peer stalls the run silently.
+        # Retryable RpcError codes (UNAVAILABLE/DEADLINE_EXCEEDED/...) back
+        # off and retry; the terminal SendFailure names the sending rank and
+        # dialed address so a dead-peer failure is diagnosable from the log.
+        retry_send(
+            lambda: self._stub(receiver)(
+                data, wait_for_ready=True, timeout=self.send_timeout),
+            policy=self.retry_policy,
+            backend="grpc",
+            receiver_id=receiver,
+            describe=f"rank {self.rank} -> {self._target(receiver)}",
+        )
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)

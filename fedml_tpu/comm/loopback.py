@@ -17,6 +17,7 @@ from typing import Dict, List, Optional
 from ..core import telemetry
 from .base import BaseCommunicationManager, Observer, dispatch_to_observers
 from .message import Message
+from .resilience import retry_send
 
 
 class LoopbackHub:
@@ -53,10 +54,14 @@ class LoopbackCommManager(BaseCommunicationManager):
     exercised even though no wire exists.
     """
 
-    def __init__(self, rank: int, size: int, hub: Optional[LoopbackHub] = None):
+    _metrics_name = "loopback"
+
+    def __init__(self, rank: int, size: int, hub: Optional[LoopbackHub] = None,
+                 retry_policy=None):
         self.rank = int(rank)
         self.size = int(size)
         self.hub = hub or get_default_hub()
+        self.retry_policy = retry_policy
         self._inbox = self.hub.register(self.rank)
         self._observers: List[Observer] = []
         self._running = False
@@ -67,7 +72,12 @@ class LoopbackCommManager(BaseCommunicationManager):
         data = msg.to_bytes()
         telemetry.record_send("loopback", len(data),
                               time.perf_counter() - t0)
-        self.hub.post(msg.get_receiver_id(), data)
+        # in-process queues cannot fail transiently; the retry wrapper exists
+        # so the full taxonomy (incl. SendFailure context) is uniform across
+        # backends and chaos plans can exercise it over loopback
+        retry_send(lambda: self.hub.post(msg.get_receiver_id(), data),
+                   policy=self.retry_policy, backend="loopback",
+                   receiver_id=msg.get_receiver_id())
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)

@@ -16,15 +16,18 @@ from typing import Callable, Dict
 from .. import constants
 from .base import BaseCommunicationManager, Observer
 from .message import Message
+from .resilience import FaultPlan, FaultyCommManager, RetryPolicy
 
 
 def create_comm_backend(backend: str, rank: int, size: int, args=None, **kw) -> BaseCommunicationManager:
     """Backend switch — reference ``client_manager.py:25-105`` inlines this."""
     backend = (backend or constants.COMM_BACKEND_LOOPBACK).upper()
+    retry_policy = kw.get("retry_policy") or RetryPolicy.from_args(args)
     if backend == constants.COMM_BACKEND_LOOPBACK:
         from .loopback import LoopbackCommManager
 
-        return LoopbackCommManager(rank=rank, size=size, hub=kw.get("hub"))
+        return LoopbackCommManager(rank=rank, size=size, hub=kw.get("hub"),
+                                   retry_policy=retry_policy)
     if backend == constants.COMM_BACKEND_GRPC:
         from .grpc_backend import GRPCCommManager, GrpcTls
 
@@ -34,6 +37,7 @@ def create_comm_backend(backend: str, rank: int, size: int, args=None, **kw) -> 
             ip_config=kw.get("ip_config") or getattr(args, "grpc_ipconfig_path", None),
             base_port=int(kw.get("base_port") or getattr(args, "grpc_base_port", 8890)),
             tls=kw.get("tls") or GrpcTls.from_args(args),
+            retry_policy=retry_policy,
         )
     if backend == constants.COMM_BACKEND_TRPC:
         from .trpc_backend import TRPCCommManager
@@ -43,6 +47,7 @@ def create_comm_backend(backend: str, rank: int, size: int, args=None, **kw) -> 
             size=size,
             ip_config=kw.get("ip_config") or getattr(args, "trpc_master_config_path", None),
             base_port=int(kw.get("base_port") or getattr(args, "trpc_base_port", 9890)),
+            retry_policy=retry_policy,
         )
     if backend in (constants.COMM_BACKEND_MQTT_S3,
                    constants.COMM_BACKEND_MQTT_S3_MNN):
@@ -116,6 +121,7 @@ def create_comm_backend(backend: str, rank: int, size: int, args=None, **kw) -> 
             broker, store, rank=rank, size=size,
             run_id=str(getattr(args, "run_id", 0)),
             owns_broker=owns_broker,  # factory-created broker dies with the manager
+            retry_policy=retry_policy,
             **extra,
         )
     raise ValueError(f"unknown comm backend '{backend}'")
@@ -133,6 +139,16 @@ class FedMLCommManager(Observer):
         self.com_manager: BaseCommunicationManager = comm or create_comm_backend(
             backend, rank, size, args=args, **kw
         )
+        # Seeded chaos: when any fault_* key is configured, every message in
+        # and out of this actor passes through the plan. No fault config ⇒
+        # no wrapper ⇒ byte-identical message flow.
+        fault_plan = kw.get("fault_plan") or FaultPlan.from_args(args)
+        if fault_plan is not None and not isinstance(
+                self.com_manager, FaultyCommManager):
+            self.com_manager = FaultyCommManager(
+                self.com_manager, fault_plan, rank=self.rank,
+                retry_policy=(kw.get("retry_policy")
+                              or RetryPolicy.from_args(args)))
         self.com_manager.add_observer(self)
 
     # --- reference API -------------------------------------------------------

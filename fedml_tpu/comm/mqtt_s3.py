@@ -26,6 +26,7 @@ from ..core import telemetry
 from .base import BaseCommunicationManager, Observer, dispatch_to_observers
 from .message import Message
 from .pubsub import PubSubBroker
+from .resilience import SendFailure, retry_send
 from .store import BlobStore
 
 TOPIC_PREFIX = "fedml_"
@@ -36,6 +37,8 @@ INLINE_PAYLOAD_MAX_BYTES = 8 * 1024
 class MqttS3CommManager(BaseCommunicationManager):
     """rank 0 = server, ranks 1..N = clients (reference client_id scheme)."""
 
+    _metrics_name = "mqtt_s3"
+
     def __init__(
         self,
         broker: PubSubBroker,
@@ -44,10 +47,12 @@ class MqttS3CommManager(BaseCommunicationManager):
         size: int = 1,
         run_id: str = "0",
         owns_broker: bool = False,
+        retry_policy=None,
     ):
         self.broker = broker
         self.store = store
         self._owns_broker = owns_broker
+        self.retry_policy = retry_policy
         self.rank = int(rank)
         self.size = int(size)
         self.run_id = str(run_id)
@@ -92,11 +97,19 @@ class MqttS3CommManager(BaseCommunicationManager):
         )
 
     def _offload_and_publish(self, topic: str, params, blob: bytes,
-                             param_key: str, suffix: str = "") -> None:
+                             param_key: str, suffix: str = "",
+                             receiver_id: Optional[int] = None) -> None:
         """Shared store-offload: upload ``blob``, rewrite ``param_key`` to
-        the store key (+URL), publish the small control message."""
+        the store key (+URL), publish the small control message. Both the
+        store put and the broker publish retry transient failures; if the
+        publish still fails terminally, the just-uploaded blob is deleted —
+        no subscriber will ever learn its key, so leaving it would leak
+        store space every failed round."""
         key = f"{topic}_{uuid.uuid4()}{suffix}"
-        url = self.store.put(key, blob)
+        url = retry_send(
+            lambda: self.store.put(key, blob),
+            policy=self.retry_policy, backend="mqtt_s3",
+            receiver_id=receiver_id, describe=f"store put key {key}")
         params = dict(params)
         params[param_key] = key
         params[Message.MSG_ARG_KEY_MODEL_PARAMS_URL] = url
@@ -105,12 +118,27 @@ class MqttS3CommManager(BaseCommunicationManager):
         logging.debug("mqtt_s3: payload %d B -> store key %s", len(blob), key)
         control = out.to_bytes()
         telemetry.record_send("mqtt_s3", len(blob) + len(control))
-        self.broker.publish(topic, control)
+        try:
+            retry_send(
+                lambda: self.broker.publish(topic, control),
+                policy=self.retry_policy, backend="mqtt_s3",
+                receiver_id=receiver_id, describe=f"publish topic {topic}")
+        except SendFailure:
+            try:
+                self.store.delete(key)
+                logging.warning(
+                    "mqtt_s3: publish on %s failed — deleted orphaned store "
+                    "object %s", topic, key)
+            except Exception:
+                logging.exception(
+                    "mqtt_s3: failed to delete orphaned store object %s", key)
+            raise
 
     def send_message(self, msg: Message) -> None:
         telemetry.inject_trace(msg)
         t0 = time.perf_counter()
         topic = self._topic_for(msg)
+        receiver = msg.get_receiver_id()
         params = msg.get_params()
         model_params = params.get(Message.MSG_ARG_KEY_MODEL_PARAMS)
         if model_params is not None:
@@ -119,11 +147,15 @@ class MqttS3CommManager(BaseCommunicationManager):
             blob = pack_payload(model_params)
             if len(blob) > INLINE_PAYLOAD_MAX_BYTES:
                 self._offload_and_publish(
-                    topic, params, blob, Message.MSG_ARG_KEY_MODEL_PARAMS)
+                    topic, params, blob, Message.MSG_ARG_KEY_MODEL_PARAMS,
+                    receiver_id=receiver)
                 return
         data = msg.to_bytes()
         telemetry.record_send("mqtt_s3", len(data), time.perf_counter() - t0)
-        self.broker.publish(topic, data)
+        retry_send(
+            lambda: self.broker.publish(topic, data),
+            policy=self.retry_policy, backend="mqtt_s3",
+            receiver_id=receiver, describe=f"publish topic {topic}")
 
     # --- BaseCommunicationManager contract ----------------------------------
     def add_observer(self, observer: Observer) -> None:
@@ -191,7 +223,8 @@ class MqttS3MnnCommManager(MqttS3CommManager):
             self._offload_and_publish(
                 self._topic_for(msg), msg.get_params(), blob,
                 MSG_ARG_KEY_MODEL_FILE,
-                suffix=f"_{os.path.basename(str(path))}")
+                suffix=f"_{os.path.basename(str(path))}",
+                receiver_id=msg.get_receiver_id())
             return
         super().send_message(msg)
 

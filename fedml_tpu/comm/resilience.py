@@ -1,0 +1,465 @@
+"""Fault-tolerance plane: deterministic fault injection + retrying sends.
+
+The reference FedML has no failure handling at all (SURVEY.md §5.3: "one
+straggler/dead client stalls the round forever... no fault injection") — a
+single lost WAN message or restarted process kills a run. This module makes
+every failure path deliberate, injectable, and tested, in three pieces:
+
+- **Error taxonomy** — :func:`is_retryable` classifies transport exceptions
+  as transient (gRPC UNAVAILABLE/DEADLINE_EXCEEDED/..., socket-level
+  ``OSError``, MQTT publish / S3 offload hiccups) vs fatal (codec bugs,
+  misconfiguration). :class:`SendFailure` is the single terminal exception
+  every backend raises after exhausting its budget — it carries the
+  receiver, backend name, and dialed-target context so a dead-peer failure
+  is diagnosable from the log line alone.
+- **RetryPolicy / retry_send** — bounded retry with exponential backoff and
+  *deterministic* jitter (hash-derived, so chaos runs replay bit-identically
+  under a fixed seed). Every attempt/failure lands in the PR-2 registry
+  (``fedml_send_retries_total`` / ``fedml_send_failures_total``).
+- **FaultPlan / FaultyCommManager** — a seeded chaos plan (drop / delay /
+  duplicate messages by type+round, fail sends transiently, crash an actor
+  at round k) applied by a wrapper that composes with ANY backend
+  (loopback/grpc/mqtt_s3/trpc). Decisions derive from
+  ``sha256(seed, edge, msg_type, seq)`` — per-edge sequence counters, so
+  the same plan makes the same calls regardless of thread interleaving.
+  No ``fault_*`` config ⇒ no wrapper ⇒ byte-identical behavior to today.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import logging
+import threading
+import time
+from typing import Callable, FrozenSet, Optional, Sequence, Tuple
+
+from ..core import telemetry
+from .base import BaseCommunicationManager, Observer, dispatch_to_observers
+from .message import Message
+
+# Message param the round index rides on (cross_silo.message_define
+# MSG_ARG_KEY_ROUND_INDEX; the comm layer must not import the FL layer).
+ROUND_IDX_PARAM = "round_idx"
+
+# Upper bound on any injected delay: chaos must perturb ordering, not stall
+# test suites (the ISSUE's "no wall-clock sleeps beyond a small bound").
+MAX_INJECTED_DELAY_S = 2.0
+
+
+# --- error taxonomy ----------------------------------------------------------
+
+
+class TransientSendError(RuntimeError):
+    """A send failure expected to succeed on retry (injected by a
+    :class:`FaultPlan`, or used by backends to mark a transient condition)."""
+
+
+class SendFailure(RuntimeError):
+    """Terminal send failure: the retry budget is spent (or the error was
+    fatal). Carries receiver/backend context so the server FSM can mark the
+    peer dead for the round instead of dying on a raw transport exception."""
+
+    def __init__(self, text: str, receiver_id: Optional[int] = None,
+                 backend: str = "", attempts: int = 0):
+        super().__init__(text)
+        self.receiver_id = receiver_id
+        self.backend = backend
+        self.attempts = attempts
+
+
+# OSError kinds that indicate a *local* misconfiguration, not a flaky wire —
+# retrying a missing directory or a permission wall is pure delay.
+_FATAL_OS_ERRORS = (FileNotFoundError, PermissionError, IsADirectoryError,
+                    NotADirectoryError)
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """Transient (worth retrying) vs fatal transport errors, across every
+    backend's native exception family."""
+    if isinstance(exc, TransientSendError):
+        return True
+    if isinstance(exc, SendFailure):
+        return False  # already a spent retry budget — never re-wrap
+    try:
+        import grpc
+    except ImportError:
+        pass
+    else:
+        if isinstance(exc, grpc.RpcError):
+            code = exc.code() if callable(getattr(exc, "code", None)) else None
+            return code in (
+                grpc.StatusCode.UNAVAILABLE,
+                grpc.StatusCode.DEADLINE_EXCEEDED,
+                grpc.StatusCode.RESOURCE_EXHAUSTED,
+                grpc.StatusCode.ABORTED,
+            )
+    if isinstance(exc, _FATAL_OS_ERRORS):
+        return False
+    # socket-level trouble: peer restarting, broker reconnecting, kernel
+    # buffers full — the canonical transient family
+    return isinstance(exc, (ConnectionError, TimeoutError, OSError))
+
+
+def _hash_fraction(*parts) -> float:
+    """Deterministic uniform-[0,1) draw from a tuple of hashable parts."""
+    h = hashlib.sha256(":".join(str(p) for p in parts).encode()).digest()
+    return int.from_bytes(h[:8], "big") / 2.0 ** 64
+
+
+# --- retry engine ------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded exponential backoff with deterministic jitter.
+
+    ``delay(attempt)`` = ``min(base * backoff**attempt, max)`` scaled by a
+    hash-derived factor in ``[1-jitter, 1+jitter]`` — decorrelates peers
+    hammering one endpoint without introducing wall-clock randomness that
+    would break seeded chaos replay.
+    """
+
+    max_retries: int = 3
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    backoff: float = 2.0
+    jitter: float = 0.5
+
+    def delay(self, attempt: int, key: str = "") -> float:
+        d = min(self.base_delay_s * self.backoff ** attempt, self.max_delay_s)
+        frac = _hash_fraction("retry-jitter", key, attempt)
+        return d * (1.0 + self.jitter * (2.0 * frac - 1.0))
+
+    @classmethod
+    def from_args(cls, args) -> "RetryPolicy":
+        if args is None:
+            return DEFAULT_RETRY_POLICY
+        return cls(
+            max_retries=int(getattr(args, "send_retries", 3)),
+            base_delay_s=float(getattr(args, "send_retry_base_s", 0.05)),
+            max_delay_s=float(getattr(args, "send_retry_max_s", 2.0)),
+            backoff=float(getattr(args, "send_retry_backoff", 2.0)),
+            jitter=float(getattr(args, "send_retry_jitter", 0.5)),
+        )
+
+
+DEFAULT_RETRY_POLICY = RetryPolicy()
+
+
+def retry_send(
+    send_once: Callable[[], object],
+    *,
+    policy: Optional[RetryPolicy],
+    backend: str,
+    receiver_id: Optional[int] = None,
+    describe: str = "",
+    classify: Callable[[BaseException], bool] = is_retryable,
+    attempt_hook: Optional[Callable[[int], None]] = None,
+):
+    """Run ``send_once`` under the retry policy, returning its result.
+    Transient errors back off and retry; fatal errors and exhausted budgets
+    raise :class:`SendFailure` with full context. ``attempt_hook(attempt)``
+    runs before each attempt — the seam :class:`FaultyCommManager` uses to
+    inject transient failures *under* the retry loop, so injected faults
+    exercise the same code path real outages do."""
+    policy = policy or DEFAULT_RETRY_POLICY
+    attempt = 0
+    while True:
+        try:
+            if attempt_hook is not None:
+                attempt_hook(attempt)
+            return send_once()
+        except Exception as exc:
+            fatal = not classify(exc)
+            if fatal or attempt >= policy.max_retries:
+                telemetry.record_send_failure(backend)
+                why = ("fatal error" if fatal
+                       else f"retry budget spent ({attempt + 1} attempts)")
+                raise SendFailure(
+                    f"{backend} send to rank {receiver_id} failed ({why})"
+                    f"{' — ' + describe if describe else ''}: {exc!r}",
+                    receiver_id=receiver_id, backend=backend,
+                    attempts=attempt + 1,
+                ) from exc
+            telemetry.record_send_retry(backend)
+            logging.warning(
+                "%s send to rank %s attempt %d failed (%r) — backing off",
+                backend, receiver_id, attempt + 1, exc)
+            time.sleep(policy.delay(attempt, key=f"{backend}:{receiver_id}"))
+            attempt += 1
+
+
+# --- fault plan --------------------------------------------------------------
+
+FAULT_ACTIONS = ("drop", "delay", "duplicate", "fail_send")
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultRule:
+    """One chaos behavior, scoped by message type and round window."""
+
+    action: str                                  # one of FAULT_ACTIONS
+    rate: float                                  # per-message probability
+    msg_types: Optional[FrozenSet] = None        # None = every type
+    rounds: Optional[Tuple[int, int]] = None     # [start, stop) window
+    delay_s: float = 0.1                         # for action == "delay"
+
+    def __post_init__(self):
+        if self.action not in FAULT_ACTIONS:
+            raise ValueError(f"unknown fault action {self.action!r}; "
+                             f"expected one of {FAULT_ACTIONS}")
+
+    def matches(self, msg_type, round_idx: Optional[int]) -> bool:
+        if self.msg_types is not None and msg_type not in self.msg_types:
+            return False
+        if self.rounds is not None:
+            if round_idx is None:
+                return False  # round-scoped rules skip round-less traffic
+            start, stop = self.rounds
+            if not (start <= round_idx < stop):
+                return False
+        return True
+
+
+@dataclasses.dataclass
+class FaultDecision:
+    """Resolved plan outcome for one concrete message send."""
+
+    drop: bool = False
+    delay_s: float = 0.0
+    duplicate: bool = False
+    seq: int = 0  # the per-edge sequence number this decision was drawn at
+
+
+def message_round(msg: Message) -> Optional[int]:
+    """Round index a message belongs to, when it carries one (the FL-layer
+    ``round_idx`` param, else the telemetry round stamp)."""
+    rnd = msg.get(ROUND_IDX_PARAM)
+    if rnd is None:
+        rnd = msg.get(telemetry.ROUND_IDX_KEY)
+    return int(rnd) if rnd is not None else None
+
+
+class FaultPlan:
+    """Seeded, deterministic chaos plan.
+
+    Every decision is a pure function of ``(seed, rule, edge, msg_type,
+    seq)`` where ``seq`` counts messages per (sender → receiver, type) edge —
+    so two runs with the same seed inject the same faults at the same
+    messages, regardless of thread scheduling, and changing the seed
+    reshuffles the whole plan.
+    """
+
+    def __init__(self, seed: int = 0, rules: Sequence[FaultRule] = (),
+                 crash_rank: Optional[int] = None,
+                 crash_at_round: Optional[int] = None):
+        self.seed = int(seed)
+        self.rules = tuple(rules)
+        self.crash_rank = crash_rank if crash_rank is None else int(crash_rank)
+        self.crash_at_round = (crash_at_round if crash_at_round is None
+                               else int(crash_at_round))
+        self._seq = {}
+        self._lock = threading.Lock()
+
+    @property
+    def active(self) -> bool:
+        return bool(self.rules) or self.crash_rank is not None
+
+    def _next_seq(self, edge: str) -> int:
+        with self._lock:
+            n = self._seq.get(edge, 0)
+            self._seq[edge] = n + 1
+            return n
+
+    def decide(self, msg: Message) -> FaultDecision:
+        """Draw this message's fate (drop/delay/duplicate). Consumes one
+        sequence tick on the message's edge; ``fail_send`` draws are made
+        separately per retry attempt via :meth:`should_fail_send`."""
+        msg_type = msg.get_type()
+        edge = f"{msg.get_sender_id()}->{msg.get_receiver_id()}:{msg_type}"
+        seq = self._next_seq(edge)
+        rnd = message_round(msg)
+        out = FaultDecision(seq=seq)
+        for i, rule in enumerate(self.rules):
+            if rule.action == "fail_send" or not rule.matches(msg_type, rnd):
+                continue
+            if _hash_fraction(self.seed, i, rule.action, edge, seq) < rule.rate:
+                if rule.action == "drop":
+                    out.drop = True
+                elif rule.action == "delay":
+                    out.delay_s = max(out.delay_s,
+                                      min(rule.delay_s, MAX_INJECTED_DELAY_S))
+                elif rule.action == "duplicate":
+                    out.duplicate = True
+        return out
+
+    def should_fail_send(self, msg: Message, seq: int, attempt: int,
+                         copy: int = 0) -> bool:
+        """Deterministic transient-failure draw for one (message, retry
+        attempt, duplicate copy) — injected beneath the retry loop."""
+        msg_type = msg.get_type()
+        edge = f"{msg.get_sender_id()}->{msg.get_receiver_id()}:{msg_type}"
+        rnd = message_round(msg)
+        for i, rule in enumerate(self.rules):
+            if rule.action != "fail_send" or not rule.matches(msg_type, rnd):
+                continue
+            if _hash_fraction(self.seed, i, "fail_send", edge, seq, attempt,
+                              copy) < rule.rate:
+                return True
+        return False
+
+    def should_crash(self, rank: int, round_idx: Optional[int]) -> bool:
+        return (self.crash_rank is not None
+                and rank == self.crash_rank
+                and round_idx is not None
+                and self.crash_at_round is not None
+                and round_idx >= self.crash_at_round)
+
+    # --- config surface -----------------------------------------------------
+
+    @classmethod
+    def from_args(cls, args) -> Optional["FaultPlan"]:
+        """Build the plan from flat ``fault_*`` config keys; ``None`` (no
+        wrapper installed, byte-identical behavior) unless at least one
+        fault is actually configured."""
+        if args is None:
+            return None
+        msg_types = getattr(args, "fault_msg_types", None)
+        if msg_types is not None:
+            msg_types = frozenset(msg_types)
+        rounds = getattr(args, "fault_rounds", None)
+        if rounds is not None:
+            rounds = (int(rounds[0]), int(rounds[1]))
+        rules = []
+        for action, rate_key in (("drop", "fault_drop_rate"),
+                                 ("delay", "fault_delay_rate"),
+                                 ("duplicate", "fault_duplicate_rate"),
+                                 ("fail_send", "fault_fail_send_rate")):
+            rate = float(getattr(args, rate_key, 0.0) or 0.0)
+            if rate > 0.0:
+                rules.append(FaultRule(
+                    action=action, rate=rate, msg_types=msg_types,
+                    rounds=rounds,
+                    delay_s=float(getattr(args, "fault_delay_s", 0.1)),
+                ))
+        crash_rank = getattr(args, "fault_crash_rank", None)
+        crash_at = getattr(args, "fault_crash_at_round", None)
+        if crash_rank is not None and crash_at is None:
+            crash_at = 1
+        plan = cls(
+            seed=int(getattr(args, "fault_seed", 0)),
+            rules=rules,
+            crash_rank=crash_rank,
+            crash_at_round=crash_at,
+        )
+        return plan if plan.active else None
+
+
+# --- chaos wrapper -----------------------------------------------------------
+
+
+class FaultyCommManager(BaseCommunicationManager, Observer):
+    """Chaos wrapper composing with any backend.
+
+    Sits between the FL actor and the transport: outbound messages pass
+    through the plan (drop / bounded delay / duplicate, plus transient
+    failures injected beneath the same retry loop real outages hit);
+    inbound messages trigger the crash check before reaching the actor. A
+    "crashed" actor black-holes both directions and stops its receive loop —
+    the in-process equivalent of process death.
+    """
+
+    def __init__(self, inner: BaseCommunicationManager, plan: FaultPlan,
+                 rank: Optional[int] = None,
+                 retry_policy: Optional[RetryPolicy] = None):
+        self.inner = inner
+        self.plan = plan
+        self.rank = int(rank if rank is not None
+                        else getattr(inner, "rank", 0))
+        self.retry_policy = (retry_policy
+                             or getattr(inner, "retry_policy", None)
+                             or DEFAULT_RETRY_POLICY)
+        self._backend_label = getattr(inner, "_metrics_name",
+                                      type(inner).__name__)
+        self._observers = []
+        self._dead = threading.Event()
+        inner.add_observer(self)
+
+    @property
+    def crashed(self) -> bool:
+        return self._dead.is_set()
+
+    def _die(self, where: str) -> None:
+        if self._dead.is_set():
+            return
+        self._dead.set()
+        telemetry.record_fault("crash")
+        logging.warning("fault: rank %d crashing at %s (plan: crash rank %s "
+                        "at round %s)", self.rank, where,
+                        self.plan.crash_rank, self.plan.crash_at_round)
+        self.inner.stop_receive_message()
+
+    # --- send path ----------------------------------------------------------
+
+    def send_message(self, msg: Message) -> None:
+        if self._dead.is_set():
+            return  # a dead process sends nothing
+        if self.plan.should_crash(self.rank, message_round(msg)):
+            self._die("send")
+            return
+        d = self.plan.decide(msg)
+        if d.drop:
+            telemetry.record_fault("drop")
+            logging.info("fault: dropping msg type=%r %d->%d (seq %d)",
+                         msg.get_type(), msg.get_sender_id(),
+                         msg.get_receiver_id(), d.seq)
+            return
+        if d.delay_s > 0.0:
+            telemetry.record_fault("delay")
+            time.sleep(d.delay_s)
+        copies = 2 if d.duplicate else 1
+        for copy in range(copies):
+            if copy:
+                telemetry.record_fault("duplicate")
+
+            def _inject(attempt: int, _copy=copy) -> None:
+                if self.plan.should_fail_send(msg, d.seq, attempt, _copy):
+                    telemetry.record_fault("fail_send")
+                    raise TransientSendError(
+                        f"injected transient failure (seq {d.seq}, "
+                        f"attempt {attempt})")
+
+            retry_send(
+                lambda: self.inner.send_message(msg),
+                policy=self.retry_policy,
+                backend=self._backend_label,
+                receiver_id=msg.get_receiver_id(),
+                describe=f"under fault plan seed={self.plan.seed}",
+                attempt_hook=_inject,
+            )
+
+    # --- receive path (wrapper observes the inner backend) ------------------
+
+    def receive_message(self, msg_type, msg: Message) -> None:
+        if self._dead.is_set():
+            return
+        if self.plan.should_crash(self.rank, message_round(msg)):
+            self._die("receive")
+            return
+        dispatch_to_observers(msg, self._observers)
+
+    # --- BaseCommunicationManager contract ----------------------------------
+
+    def add_observer(self, observer: Observer) -> None:
+        self._observers.append(observer)
+
+    def remove_observer(self, observer: Observer) -> None:
+        if observer in self._observers:
+            self._observers.remove(observer)
+
+    def handle_receive_message(self) -> None:
+        self.inner.handle_receive_message()
+
+    def stop_receive_message(self) -> None:
+        self.inner.stop_receive_message()

@@ -42,6 +42,7 @@ from ..core import telemetry
 from .base import BaseCommunicationManager, Observer, dispatch_to_observers
 from .grpc_backend import build_ip_table
 from .message import Message, _dtype_token, _resolve_dtype
+from .resilience import retry_send
 
 _MAGIC = b"FTRP\x01"
 _HDR = struct.Struct(">Q")  # header length
@@ -226,6 +227,8 @@ def read_frame(sock: socket.socket) -> Optional[Dict[str, Any]]:
 class TRPCCommManager(BaseCommunicationManager):
     """Reference ``TRPCCommManager:26`` surface over the tensor-socket pipe."""
 
+    _metrics_name = "trpc"
+
     def __init__(
         self,
         rank: int = 0,
@@ -234,8 +237,10 @@ class TRPCCommManager(BaseCommunicationManager):
         base_port: int = 9890,
         host: str = "0.0.0.0",
         port: Optional[int] = None,
+        retry_policy=None,
     ):
         self.rank = int(rank)
+        self.retry_policy = retry_policy
         self.size = int(size)
         self.base_port = int(base_port)
         self.port = int(port) if port is not None else self.base_port + self.rank
@@ -303,24 +308,43 @@ class TRPCCommManager(BaseCommunicationManager):
             return sock
 
     # --- BaseCommunicationManager -------------------------------------------
+    def _drop_pipe(self, receiver: int) -> None:
+        with self._dial_lock:
+            sock = self._pipes.pop(receiver, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
     def send_message(self, msg: Message) -> None:
         telemetry.inject_trace(msg)
         receiver = msg.get_receiver_id()
-        sock = self._pipe(receiver)
         t0 = time.perf_counter()
         chunks = encode_frames(msg.get_params())
         telemetry.record_send("trpc", sum(len(c) for c in chunks),
                               time.perf_counter() - t0)
-        with self._send_locks[receiver]:
-            # scatter-gather send: tensor buffers go to the kernel as-is
-            try:
-                sendmsg_all(sock, chunks)
-            except OSError:
-                # one reconnect: the peer may have restarted between rounds
-                with self._dial_lock:
-                    self._pipes.pop(receiver, None)
-                sock = self._pipe(receiver)
-                sendmsg_all(sock, chunks)
+
+        def _once() -> None:
+            # (re)dial lazily per attempt: the peer may have restarted
+            # between rounds, or mid-backoff
+            sock = self._pipe(receiver)
+            with self._send_locks[receiver]:
+                # scatter-gather send: tensor buffers go to the kernel as-is
+                try:
+                    sendmsg_all(sock, chunks)
+                except OSError:
+                    # a partially-written frame poisons the pipe — drop it so
+                    # the retry dials fresh and never interleaves frames
+                    self._drop_pipe(receiver)
+                    raise
+
+        retry_send(
+            _once, policy=self.retry_policy, backend="trpc",
+            receiver_id=receiver,
+            describe=f"rank {self.rank} -> "
+                     f"{self.ip_table.get(receiver, '<no ip-table entry>')}",
+        )
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)

@@ -543,6 +543,33 @@ def record_receive(backend: str, nbytes: Optional[int] = None) -> None:
                       backend=backend, direction="recv").observe(nbytes)
 
 
+# --- resilience hooks (comm retry loop + fault injector + dispatch guard) ----
+
+
+def record_send_retry(backend: str) -> None:
+    if _state.enabled:
+        _state.registry.counter("fedml_send_retries_total",
+                                backend=backend).inc()
+
+
+def record_send_failure(backend: str) -> None:
+    if _state.enabled:
+        _state.registry.counter("fedml_send_failures_total",
+                                backend=backend).inc()
+
+
+def record_fault(action: str) -> None:
+    if _state.enabled:
+        _state.registry.counter("fedml_faults_injected_total",
+                                action=action).inc()
+
+
+def record_observer_error(msg_type) -> None:
+    if _state.enabled:
+        _state.registry.counter("fedml_observer_errors_total",
+                                msg_type=str(msg_type)).inc()
+
+
 # --- exporters --------------------------------------------------------------
 
 

@@ -107,6 +107,12 @@ class FedMLAggregator:
     def received_count(self) -> int:
         return len(self.model_dict)
 
+    def has_upload_from(self, index: int) -> bool:
+        """Whether the given cohort slot already uploaded this round (the
+        server's rejoin path uses this to avoid re-training a client whose
+        result is already in)."""
+        return index in self.model_dict
+
     def _aggregate_stacked(self, stacked: PyTree, weights: jax.Array) -> PyTree:
         if self._robust is not None:
             return self._robust.aggregate(stacked, weights)

@@ -1,0 +1,156 @@
+"""Chaos drill: a full cross-silo FL run under a seeded fault plan.
+
+One entry point — :func:`run_chaos_drill` — stands up a complete loopback
+deployment (server + N silo clients, real message codec, real round FSM),
+switches on the requested ``fault_*`` plan, runs it to completion, and
+reports whether every round closed plus what the resilience plane did along
+the way (faults injected, sends retried, sends declared dead).
+
+Shared by the ``fedml-tpu chaos-drill`` CLI command, ``bench.py --chaos``,
+and the ``tests/test_chaos.py`` suite — one implementation, three front
+doors, so the drill the CI gate runs is exactly the drill an operator can
+run by hand against a proposed config change.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+PHASE_DEFAULTS = dict(
+    dataset="mnist",
+    model="lr",
+    debug_small_data=True,
+    client_num_in_total=3,
+    client_num_per_round=3,
+    comm_round=3,
+    learning_rate=0.1,
+    epochs=1,
+    batch_size=8,
+    frequency_of_the_test=1,
+    random_seed=0,
+    # recovery knobs: a drill must terminate even when messages vanish, so
+    # rounds close on a short straggler timeout with whatever arrived
+    round_timeout=2.0,
+    min_clients_per_round=1,
+    handshake_timeout=2.0,
+    # the default plan: WAN-grade packet loss on every message type
+    fault_seed=7,
+    fault_drop_rate=0.2,
+)
+
+
+@dataclasses.dataclass
+class ChaosDrillResult:
+    rounds_completed: int
+    rounds_expected: int
+    elapsed_s: float
+    faults_injected: Dict[str, float]
+    send_retries: float
+    send_failures: float
+    history: List[dict]
+
+    @property
+    def ok(self) -> bool:
+        return self.rounds_completed >= self.rounds_expected
+
+    def summary(self) -> str:
+        faults = ", ".join(f"{k}={int(v)}"
+                           for k, v in sorted(self.faults_injected.items()))
+        return (
+            f"chaos drill: {'PASS' if self.ok else 'FAIL'} — "
+            f"{self.rounds_completed}/{self.rounds_expected} rounds in "
+            f"{self.elapsed_s:.1f}s | faults injected: {faults or 'none'} | "
+            f"sends retried={int(self.send_retries)} "
+            f"declared-dead={int(self.send_failures)}"
+        )
+
+
+def _label_totals(counters: Dict[str, float], name: str,
+                  label: Optional[str] = None) -> Dict[str, float]:
+    """Collect ``name{...}`` counters from a registry snapshot; with
+    ``label``, key the result by that label's value."""
+    out: Dict[str, float] = {}
+    for key, value in counters.items():
+        if not (key == name or key.startswith(name + "{")):
+            continue
+        if label is None:
+            out["total"] = out.get("total", 0.0) + value
+            continue
+        inner = key[len(name):].strip("{}")
+        labels = dict(kv.split("=", 1) for kv in inner.split(",") if "=" in kv)
+        k = labels.get(label, "?")
+        out[k] = out.get(k, 0.0) + value
+    return out
+
+
+def run_chaos_drill(args=None, n_clients: Optional[int] = None,
+                    join_timeout_s: float = 120.0, **overrides
+                    ) -> ChaosDrillResult:
+    """Run one seeded chaos deployment over loopback and report the outcome.
+
+    ``overrides`` lands on top of :data:`PHASE_DEFAULTS` (so e.g.
+    ``fault_crash_rank=1`` or ``fault_drop_rate=0.4`` tweak the plan);
+    passing a pre-built ``args`` skips the defaults entirely.
+    """
+    import fedml_tpu
+    from ..comm import LoopbackHub
+    from ..core import telemetry
+    from .horizontal_api import FedML_Horizontal
+
+    if args is None:
+        cfg = dict(PHASE_DEFAULTS)
+        cfg.update(overrides)
+        args = fedml_tpu.init(config=cfg)
+    n = int(n_clients if n_clients is not None
+            else getattr(args, "client_num_in_total", 2))
+    rounds = int(getattr(args, "comm_round", 1))
+
+    registry = telemetry.get_registry()
+    before = registry.snapshot()["counters"] if telemetry.enabled() else {}
+
+    hub = LoopbackHub()
+    server = FedML_Horizontal(args, 0, n, backend="LOOPBACK", hub=hub)
+    clients = [FedML_Horizontal(args, rank, n, backend="LOOPBACK", hub=hub)
+               for rank in range(1, n + 1)]
+    threads = [threading.Thread(target=c.run, daemon=True, name=f"chaos-c{i+1}")
+               for i, c in enumerate(clients)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    server.start()
+    server_thread = threading.Thread(target=server.run, daemon=True,
+                                     name="chaos-server")
+    server_thread.start()
+    server_thread.join(timeout=join_timeout_s)
+    hung = server_thread.is_alive()
+    if hung:
+        logging.error("chaos drill: server did not finish within %.0fs — "
+                      "forcing shutdown", join_timeout_s)
+        server.finish()
+    for c in clients:
+        c.com_manager.stop_receive_message()
+    for t in threads:
+        t.join(timeout=10.0)
+    elapsed = time.perf_counter() - t0
+
+    after = registry.snapshot()["counters"] if telemetry.enabled() else {}
+
+    def delta(name, label=None):
+        a = _label_totals(after, name, label)
+        b = _label_totals(before, name, label)
+        return {k: v - b.get(k, 0.0) for k, v in a.items()}
+
+    return ChaosDrillResult(
+        rounds_completed=len(server.history) if not hung else
+        min(len(server.history), rounds - 1),  # a hung run never passes
+        rounds_expected=rounds,
+        elapsed_s=elapsed,
+        faults_injected=delta("fedml_faults_injected_total", "action"),
+        send_retries=sum(delta("fedml_send_retries_total").values()),
+        send_failures=sum(delta("fedml_send_failures_total").values()),
+        history=list(server.history),
+    )

@@ -14,6 +14,7 @@ from typing import Dict
 
 
 from ..comm import Message, ClientManager
+from ..comm.resilience import SendFailure
 from ..comm.utils import log_communication_tick, log_communication_tock
 from ..core import telemetry
 from .message_define import MyMessage
@@ -49,12 +50,23 @@ class FedMLClientManager(ClientManager):
         reply.add_params(MyMessage.MSG_ARG_KEY_CLIENT_STATUS, MyMessage.MSG_CLIENT_STATUS_IDLE)
         self.send_message(reply)
 
+    def announce(self) -> None:
+        """Spontaneous ONLINE report (no probe preceded it): a client that
+        (re)started mid-run calls this after ``run()`` is entered — the
+        server's rejoin path answers with the current round's model so this
+        client re-enters the round instead of idling until FINISH."""
+        reply = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, 0)
+        reply.add_params(MyMessage.MSG_ARG_KEY_CLIENT_STATUS, MyMessage.MSG_CLIENT_STATUS_IDLE)
+        self.send_message(reply)
+
     def _on_init(self, msg: Message) -> None:
         global_model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         client_index = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX)
         self.trainer.update_model(global_model_params)
         self.trainer.update_dataset(int(client_index))
-        self.round_idx = 0
+        # a resumed server's INIT names the round it restarts from; a fresh
+        # run's INIT carries no round param and starts at 0 as before
+        self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_INDEX, 0))
         self._train()
 
     def _on_sync(self, msg: Message) -> None:
@@ -88,5 +100,13 @@ class FedMLClientManager(ClientManager):
         # greppable comm benchmark markers around the model upload
         # (reference communication/utils.py tick/tock role)
         log_communication_tick(self.rank, 0)
-        self.send_message(msg)
+        try:
+            self.send_message(msg)
+        except SendFailure as exc:
+            # server unreachable after the retry budget: the round's work is
+            # lost but the client survives — the server's straggler timeout
+            # closes the round without us, and the next sync (or a rejoin
+            # probe) pulls this client back in
+            logging.error("client %d: round %d upload failed terminally (%s)",
+                          self.rank, self.round_idx, exc)
         log_communication_tock(self.rank, 0)

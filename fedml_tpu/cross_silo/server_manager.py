@@ -17,11 +17,13 @@ import contextlib
 import logging
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Set
 
 from ..comm import Message, ServerManager
+from ..comm.resilience import SendFailure
 from ..comm.utils import log_round_end, log_round_start
 from ..core import telemetry
+from ..utils.checkpoint import RoundStateStore
 from .message_define import MyMessage
 
 
@@ -59,9 +61,38 @@ class FedMLServerManager(ServerManager):
             float(getattr(args, "round_timeout", 0)) or None
         )
         self.min_clients = int(getattr(args, "min_clients_per_round", 1))
+        # handshake deadline (ours; the reference all-online barrier waits
+        # forever): after this many seconds the cohort is re-selected without
+        # the clients that never reported ONLINE. 0/unset = wait forever.
+        self.handshake_timeout: Optional[float] = (
+            float(getattr(args, "handshake_timeout", 0)) or None
+        )
+        # on a round-timeout *extension* (uploads < min_clients), re-send the
+        # current round's model to clients that have neither uploaded nor
+        # been marked dead — a client that restarted mid-round re-enters the
+        # round instead of idling until FINISH
+        self.round_retry_resend = bool(
+            getattr(args, "round_retry_resend", True))
+        # clients whose send terminally failed this round: out of the upload
+        # barrier until they re-announce ONLINE (rejoin path)
+        self._dead_clients: Set[int] = set()
         self._round_lock = threading.Lock()
         self._round_gen = 0  # increments at each round completion
         self._timer: Optional[threading.Timer] = None
+        self._handshake_timer: Optional[threading.Timer] = None
+        # round-state checkpointing: global params + next round + np RNG,
+        # saved every ckpt_every_rounds completions; a restarted server
+        # process resumes mid-run instead of starting from round 0
+        self.ckpt_every_rounds = int(getattr(args, "ckpt_every_rounds", 1))
+        ckpt_path = getattr(args, "round_ckpt_path", None)
+        self.round_store = RoundStateStore(ckpt_path) if ckpt_path else None
+        if self.round_store is not None and self.round_store.exists():
+            state = self.round_store.load()
+            self.round_idx = int(state["round_idx"])
+            self.aggregator.set_global_model_params(state["params"])
+            logging.warning(
+                "server: resumed round state from %s — continuing at round "
+                "%d/%d", ckpt_path, self.round_idx, self.round_num)
         # telemetry: one root trace context per round (init/sync messages are
         # stamped with it, clients inherit it on receive and their replies
         # carry it back) + per-client round-trip timing from broadcast to
@@ -88,24 +119,31 @@ class FedMLServerManager(ServerManager):
     def send_init_msg(self) -> None:
         log_round_start(self.rank, self.round_idx)
         self.start_running_time = time.time()
-        self.aggregator.set_expected_this_round(len(self.client_id_list_in_this_round))
+        with self._round_lock:
+            self._dead_clients.clear()  # fresh round, fresh barrier
+            self.aggregator.set_expected_this_round(
+                len(self.client_id_list_in_this_round))
+            round_gen = self._round_gen
         global_model_params = self.aggregator.get_global_model_params()
-        round_gen = self._round_gen
         self._round_ctx = telemetry.new_round_context(self.round_idx)
         if self._round_ctx is not None:
             self.round_trace_ids[self.round_idx] = self._round_ctx.trace_id
-        with self._in_round_ctx():
-            for idx, client_id in enumerate(self.client_id_list_in_this_round):
-                msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank, client_id)
-                msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model_params)
-                msg.add_params(
-                    MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(self.data_silo_index_list[idx])
-                )
-                self._client_send_ts[client_id] = time.perf_counter()
-                self.send_message(msg)
-        # arm at round start: a round where every client dies before its first
-        # upload must still time out
-        self._arm_round_timer(round_gen)
+        msgs = []
+        for idx, client_id in enumerate(self.client_id_list_in_this_round):
+            msg = Message(MyMessage.MSG_TYPE_S2C_INIT_CONFIG, self.rank, client_id)
+            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model_params)
+            msg.add_params(
+                MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(self.data_silo_index_list[idx])
+            )
+            if self.round_idx > 0:
+                # resume-from-checkpoint: tell clients which round this is.
+                # A fresh run's INIT stays byte-identical to before.
+                msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
+            msgs.append(msg)
+        # the dispatch loop sends (marking terminally-unreachable clients
+        # dead) then arms the straggler timer — a round where every client
+        # dies before its first upload must still time out
+        self._dispatch_round_end((msgs, False, round_gen, self._round_ctx))
 
     def _in_round_ctx(self, ctx: Optional[telemetry.TraceContext] = None):
         ctx = ctx or self._round_ctx
@@ -154,20 +192,134 @@ class FedMLServerManager(ServerManager):
             len(self.client_id_list_in_this_round),
         )
         for client_id in self.client_id_list_in_this_round:
-            msg = Message(MyMessage.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, self.rank, client_id)
+            self._send_probe(client_id)
+        self._arm_handshake_timer()
+
+    def _send_probe(self, client_id: int) -> None:
+        msg = Message(MyMessage.MSG_TYPE_S2C_CHECK_CLIENT_STATUS, self.rank, client_id)
+        try:
             self.send_message(msg)
+        except SendFailure as exc:
+            # an unreachable client simply never reports ONLINE; the
+            # handshake deadline (if armed) drops it from the cohort
+            logging.warning("server: status probe to client %d failed (%s)",
+                            client_id, exc)
+
+    def _arm_handshake_timer(self) -> None:
+        if not self.handshake_timeout:
+            return
+        with self._round_lock:
+            if self.is_initialized:
+                return
+            if self._handshake_timer is not None:
+                self._handshake_timer.cancel()
+            self._handshake_timer = threading.Timer(
+                self.handshake_timeout, self._on_handshake_timeout)
+            self._handshake_timer.daemon = True
+            self._handshake_timer.start()
+
+    def _on_handshake_timeout(self) -> None:
+        """All-online barrier deadline: proceed with the online subset
+        (keeping each survivor's silo-index pairing) if it meets
+        ``min_clients``, else re-probe the missing clients and re-arm."""
+        start_init = False
+        probes: List[int] = []
+        with self._round_lock:
+            self._handshake_timer = None
+            if self.is_initialized:
+                return
+            cohort = self.client_id_list_in_this_round
+            online = [c for c in cohort
+                      if self.client_online_mapping.get(c, False)]
+            if len(online) >= max(self.min_clients, 1):
+                pairing = dict(zip(cohort, self.data_silo_index_list))
+                dropped = [c for c in cohort if c not in online]
+                self.client_id_list_in_this_round = online
+                self.data_silo_index_list = [pairing[c] for c in online]
+                logging.warning(
+                    "server: handshake deadline (%.1fs) — starting with %d/%d"
+                    " clients online (dropped: %s)", self.handshake_timeout,
+                    len(online), len(cohort), dropped)
+                self.is_initialized = True
+                start_init = True
+            else:
+                probes = [c for c in cohort
+                          if not self.client_online_mapping.get(c, False)]
+                logging.error(
+                    "server: handshake deadline with %d/%d online (< min %d)"
+                    " — re-probing %s", len(online), len(cohort),
+                    self.min_clients, probes)
+        if start_init:
+            self.send_init_msg()
+            return
+        for client_id in probes:
+            self._send_probe(client_id)
+        self._arm_handshake_timer()
 
     def _on_client_status(self, msg: Message) -> None:
+        sender = msg.get_sender_id()
         if msg.get(MyMessage.MSG_ARG_KEY_CLIENT_STATUS) == MyMessage.MSG_CLIENT_STATUS_IDLE:
-            self.client_online_mapping[msg.get_sender_id()] = True
-        all_online = all(
-            self.client_online_mapping.get(cid, False)
-            for cid in self.client_id_list_in_this_round
-        )
-        logging.info("server: client %d online; all_online=%s", msg.get_sender_id(), all_online)
-        if all_online and not self.is_initialized:
-            self.is_initialized = True
-            self.send_init_msg()
+            self.client_online_mapping[sender] = True
+        start_init = False
+        rejoin: Optional[Message] = None
+        with self._round_lock:
+            if not self.is_initialized:
+                all_online = all(
+                    self.client_online_mapping.get(cid, False)
+                    for cid in self.client_id_list_in_this_round
+                )
+                logging.info("server: client %d online; all_online=%s",
+                             sender, all_online)
+                if all_online:
+                    self.is_initialized = True
+                    if self._handshake_timer is not None:
+                        self._handshake_timer.cancel()
+                        self._handshake_timer = None
+                    start_init = True
+            else:
+                rejoin = self._rejoin_locked(sender)
+                rejoin_gen = self._round_gen
+        if start_init:
+            if self.round_idx >= self.round_num:
+                # resumed from a checkpoint written after the final round:
+                # nothing left to train — just release the clients
+                msgs = [
+                    Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank, cid)
+                    for cid in self.client_real_ids
+                ]
+                self._dispatch_round_end((msgs, True, self._round_gen, None))
+            else:
+                self.send_init_msg()
+        elif rejoin is not None:
+            self._dispatch_round_end(
+                ([rejoin], False, rejoin_gen, self._round_ctx))
+
+    def _rejoin_locked(self, sender: int) -> Optional[Message]:
+        """Mid-run ONLINE report = a client that restarted and lost its
+        round state. If it belongs to the current cohort and hasn't uploaded
+        yet, un-mark it dead and hand back the current round's model so it
+        re-enters the round. Caller holds the round lock."""
+        if sender not in self.client_id_list_in_this_round:
+            return None
+        slot = self.client_id_list_in_this_round.index(sender)
+        if self.aggregator.has_upload_from(slot):
+            return None  # its result is already in — nothing to redo
+        if sender in self._dead_clients:
+            self._dead_clients.discard(sender)
+            alive = [c for c in self.client_id_list_in_this_round
+                     if c not in self._dead_clients]
+            self.aggregator.set_expected_this_round(len(alive))
+        logging.warning(
+            "server: client %d rejoined mid-round %d — resending sync",
+            sender, self.round_idx)
+        sync = Message(
+            MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, sender)
+        sync.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                        self.aggregator.get_global_model_params())
+        sync.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
+                        int(self.data_silo_index_list[slot]))
+        sync.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
+        return sync
 
     def _on_model_from_client(self, msg: Message) -> None:
         model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
@@ -190,6 +342,13 @@ class FedMLServerManager(ServerManager):
                     msg.get_sender_id(), msg_round, self.round_idx,
                 )
                 return
+            if msg.get_sender_id() in self._dead_clients:
+                # presumed dead but its upload made it through — implicit
+                # rejoin; fold it back into the barrier
+                self._dead_clients.discard(msg.get_sender_id())
+                self.aggregator.set_expected_this_round(len(
+                    [c for c in self.client_id_list_in_this_round
+                     if c not in self._dead_clients]))
             # map real edge id -> dense slot index for the barrier bookkeeping
             slot = self.client_id_list_in_this_round.index(msg.get_sender_id())
             self.aggregator.add_local_trained_result(slot, model_params, local_sample_num)
@@ -199,6 +358,7 @@ class FedMLServerManager(ServerManager):
 
     def _on_round_timeout(self, gen: int) -> None:
         outcome = None
+        resend: List[Message] = []
         with self._round_lock:
             if gen != self._round_gen:
                 return  # round already completed normally
@@ -214,19 +374,80 @@ class FedMLServerManager(ServerManager):
                 )
                 self._timer.daemon = True
                 self._timer.start()
-                return
-            missing = [
-                cid for i, cid in enumerate(self.client_id_list_in_this_round)
-                if i not in self.aggregator.model_dict
-            ]
-            logging.warning(
-                "server: round %d closing on timeout with %d/%d uploads "
-                "(stragglers: %s)", self.round_idx, n,
-                len(self.client_id_list_in_this_round), missing,
-            )
-            self.aggregator.reset_flags()
-            outcome = self._complete_round_locked()
-        self._dispatch_round_end(outcome)
+                if self.round_retry_resend:
+                    resend = self._missing_sync_msgs_locked()
+            else:
+                missing = [
+                    cid for i, cid in enumerate(self.client_id_list_in_this_round)
+                    if i not in self.aggregator.model_dict
+                ]
+                logging.warning(
+                    "server: round %d closing on timeout with %d/%d uploads "
+                    "(stragglers: %s)", self.round_idx, n,
+                    len(self.client_id_list_in_this_round), missing,
+                )
+                self.aggregator.reset_flags()
+                outcome = self._complete_round_locked()
+        if outcome is not None:
+            self._dispatch_round_end(outcome)
+            return
+        # extend path: re-offer the current round's model to clients that
+        # have neither uploaded nor died — one that restarted and missed the
+        # broadcast re-enters the round (duplicate uploads are slot-keyed,
+        # so a merely-slow client re-training is wasteful but harmless)
+        for m in resend:
+            try:
+                with self._in_round_ctx():
+                    self.send_message(m)
+            except SendFailure as exc:
+                nxt = self._mark_client_dead(m.get_receiver_id(), gen, exc)
+                if nxt is not None:
+                    self._dispatch_round_end(nxt)
+                    return
+
+    def _missing_sync_msgs_locked(self) -> List[Message]:
+        """SYNC re-sends for cohort members with no upload and no death mark
+        this round. Caller holds the round lock."""
+        global_model_params = self.aggregator.get_global_model_params()
+        msgs = []
+        for idx, cid in enumerate(self.client_id_list_in_this_round):
+            if self.aggregator.has_upload_from(idx) or cid in self._dead_clients:
+                continue
+            sync = Message(
+                MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, cid)
+            sync.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, global_model_params)
+            sync.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
+                            int(self.data_silo_index_list[idx]))
+            sync.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
+            msgs.append(sync)
+        if msgs:
+            logging.warning("server: round %d extend — re-sending model to %s",
+                            self.round_idx, [m.get_receiver_id() for m in msgs])
+        return msgs
+
+    def _mark_client_dead(self, client_id: int, gen: int, exc: SendFailure):
+        """A send to ``client_id`` exhausted its retry budget: drop it from
+        this round's upload barrier (it rejoins by re-announcing ONLINE, or
+        implicitly if an upload still arrives). Returns a round-end outcome
+        when removing it completes the round, else None."""
+        with self._round_lock:
+            if gen != self._round_gen or client_id in self._dead_clients:
+                return None
+            self._dead_clients.add(client_id)
+            # it must re-announce before a future handshake counts it online
+            self.client_online_mapping.pop(client_id, None)
+            logging.error(
+                "server: client %d unreachable after %d attempts — marked "
+                "dead for round %d (%s)", client_id, exc.attempts,
+                self.round_idx, exc)
+            if client_id not in self.client_id_list_in_this_round:
+                return None
+            alive = [c for c in self.client_id_list_in_this_round
+                     if c not in self._dead_clients]
+            self.aggregator.set_expected_this_round(len(alive))
+            if self.aggregator.check_whether_all_receive():
+                return self._complete_round_locked()
+        return None
 
     def _complete_round_locked(self):
         """Aggregate the round's uploads and prepare the next round's
@@ -255,13 +476,23 @@ class FedMLServerManager(ServerManager):
         log_round_end(self.rank, self.round_idx)
 
         self.round_idx += 1
+        if self.round_store is not None and self.ckpt_every_rounds > 0 and (
+                self.round_idx % self.ckpt_every_rounds == 0
+                or self.round_idx >= self.round_num):
+            # crash-safe resume point: aggregated params + the round a
+            # restarted server should broadcast next
+            self.round_store.save(
+                self.round_idx, self.aggregator.get_global_model_params())
         if self.round_idx >= self.round_num:
             msgs = [
                 Message(MyMessage.MSG_TYPE_S2C_FINISH, self.rank, client_id)
                 for client_id in self.client_real_ids
             ]
             return msgs, True, self._round_gen, self._round_ctx
-        # next cohort
+        # next cohort — dead marks do not carry over: a client that was
+        # unreachable last round gets fresh sends (and a fresh chance to
+        # fail) this round
+        self._dead_clients.clear()
         self.client_id_list_in_this_round = self.aggregator.client_selection(
             self.round_idx, self.client_real_ids,
             int(getattr(self.args, "client_num_per_round", self.client_num)),
@@ -290,20 +521,41 @@ class FedMLServerManager(ServerManager):
         return msgs, False, self._round_gen, self._round_ctx
 
     def _dispatch_round_end(self, outcome) -> None:
-        """Send the round-end messages prepared under the lock, then either
-        finish or arm the next round's straggler timer."""
-        if outcome is None:
-            return
-        msgs, finished, gen, ctx = outcome
-        with self._in_round_ctx(ctx):
-            for m in msgs:
-                self._client_send_ts[m.get_receiver_id()] = time.perf_counter()
-                self.send_message(m)
-        if finished:
-            logging.info(
-                "server: training finished in %.1fs",
-                time.time() - self.start_running_time,
-            )
-            self.finish()
-        else:
-            self._arm_round_timer(gen)
+        """Send the round-start/round-end messages prepared under the lock,
+        then either finish or arm the round's straggler timer. A send that
+        exhausts its retry budget marks that client dead instead of letting
+        the transport exception escape the FSM thread; if dead-marking
+        completes the round (every still-alive client had already uploaded),
+        the loop rolls straight into dispatching the NEXT round — iterative,
+        so cascading failures walk through rounds without recursion."""
+        while outcome is not None:
+            msgs, finished, gen, ctx = outcome
+            outcome = None
+            if finished:
+                for m in msgs:
+                    try:
+                        self.send_message(m)
+                    except SendFailure as exc:
+                        # undeliverable FINISH changes nothing — the run is
+                        # over; that client dies with its transport
+                        logging.warning(
+                            "server: FINISH to client %d undeliverable (%s)",
+                            m.get_receiver_id(), exc)
+                logging.info(
+                    "server: training finished in %.1fs",
+                    time.time() - self.start_running_time,
+                )
+                self.finish()
+                return
+            with self._in_round_ctx(ctx):
+                for m in msgs:
+                    self._client_send_ts[m.get_receiver_id()] = time.perf_counter()
+                    try:
+                        self.send_message(m)
+                    except SendFailure as exc:
+                        outcome = self._mark_client_dead(
+                            m.get_receiver_id(), gen, exc)
+                        if outcome is not None:
+                            break  # round rolled over; the rest are stale
+            if outcome is None:
+                self._arm_round_timer(gen)

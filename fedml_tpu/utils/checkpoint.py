@@ -59,6 +59,60 @@ class CheckpointManager:
         self.manager.close()
 
 
+class RoundStateStore:
+    """Crash-safe cross-silo *server* round state (orbax-free: the comm
+    plane's msgpack codec, one file, atomic replace).
+
+    The orbax :class:`CheckpointManager` above serves the simulation engine;
+    the distributed server needs something much smaller — the global model,
+    the next round index, and the numpy RNG state (cohort selection is
+    ``np.random``-seeded, so a resumed server must draw the same cohorts a
+    never-crashed one would). ``save`` goes through a temp file +
+    ``os.replace`` so a crash mid-write leaves the previous state intact.
+    """
+
+    def __init__(self, path: str):
+        self.path = str(path)
+
+    def exists(self) -> bool:
+        return os.path.exists(self.path)
+
+    def save(self, round_idx: int, global_params: PyTree) -> None:
+        import numpy as np
+
+        from ..comm.message import pack_payload
+
+        s = np.random.get_state()
+        blob = pack_payload({
+            "round_idx": int(round_idx),
+            "params": global_params,
+            # MT19937 state tuple, msgpack-friendly (the keys ndarray rides
+            # the codec's ndarray ext type)
+            "rng_state": [s[0], s[1], int(s[2]), int(s[3]), float(s[4])],
+        })
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    def load(self, restore_rng: bool = True) -> dict:
+        """Returns ``{"round_idx", "params", "rng_state"}``; by default also
+        re-seats ``np.random`` so post-resume cohort draws match."""
+        from ..comm.message import unpack_payload
+
+        with open(self.path, "rb") as f:
+            state = unpack_payload(f.read())
+        if restore_rng and state.get("rng_state") is not None:
+            import numpy as np
+
+            np.random.set_state(tuple(state["rng_state"]))
+        return state
+
+
 def save_simulator_state(manager: CheckpointManager, sim, round_idx: int) -> None:
     """Persist a FedSimulator's resumable state."""
     state = {

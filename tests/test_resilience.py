@@ -1,0 +1,400 @@
+"""Resilience plane: retry engine, error taxonomy, seeded fault plans, and
+the per-backend failure-context satellites (grpc context, mqtt_s3 orphan
+blob, observer isolation, round-state store)."""
+
+import threading
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from fedml_tpu.comm import LoopbackHub, Message
+from fedml_tpu.comm.loopback import LoopbackCommManager
+from fedml_tpu.comm.resilience import (
+    DEFAULT_RETRY_POLICY,
+    FaultPlan,
+    FaultRule,
+    FaultyCommManager,
+    RetryPolicy,
+    SendFailure,
+    TransientSendError,
+    is_retryable,
+    retry_send,
+)
+from fedml_tpu.core import telemetry
+
+FAST = RetryPolicy(max_retries=2, base_delay_s=0.001, max_delay_s=0.002)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.configure(enabled=True, reset=True)
+    yield
+    telemetry.configure(enabled=True, reset=True)
+
+
+def _counters():
+    return telemetry.get_registry().snapshot()["counters"]
+
+
+def _msg(mtype=3, sender=1, receiver=0, round_idx=None):
+    m = Message(mtype, sender, receiver)
+    if round_idx is not None:
+        m.add_params("round_idx", round_idx)
+    return m
+
+
+# --- retry engine ------------------------------------------------------------
+
+
+def test_retry_policy_delay_deterministic_and_bounded():
+    p = RetryPolicy(base_delay_s=0.1, max_delay_s=1.0, backoff=2.0, jitter=0.5)
+    for attempt in range(6):
+        d1 = p.delay(attempt, key="a:1")
+        d2 = p.delay(attempt, key="a:1")
+        assert d1 == d2  # hash-derived jitter, not wall-clock randomness
+        nominal = min(0.1 * 2.0 ** attempt, 1.0)
+        assert 0.5 * nominal <= d1 <= 1.5 * nominal
+    # different keys decorrelate
+    assert p.delay(0, key="a:1") != p.delay(0, key="b:2")
+
+
+def test_retry_policy_from_args():
+    args = SimpleNamespace(send_retries=5, send_retry_base_s=0.01,
+                           send_retry_max_s=0.5, send_retry_backoff=3.0,
+                           send_retry_jitter=0.0)
+    p = RetryPolicy.from_args(args)
+    assert (p.max_retries, p.base_delay_s, p.max_delay_s, p.backoff,
+            p.jitter) == (5, 0.01, 0.5, 3.0, 0.0)
+    assert RetryPolicy.from_args(None) is DEFAULT_RETRY_POLICY
+
+
+def test_retry_send_transient_then_success_returns_value():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TransientSendError("blip")
+        return "mem://the-url"
+
+    out = retry_send(flaky, policy=FAST, backend="testbk", receiver_id=4)
+    assert out == "mem://the-url"
+    assert len(calls) == 3
+    assert _counters().get("fedml_send_retries_total{backend=testbk}") == 2
+    assert "fedml_send_failures_total{backend=testbk}" not in _counters()
+
+
+def test_retry_send_fatal_error_does_not_retry():
+    calls = []
+
+    def doomed():
+        calls.append(1)
+        raise FileNotFoundError("/nonexistent/model")
+
+    with pytest.raises(SendFailure) as ei:
+        retry_send(doomed, policy=FAST, backend="testbk", receiver_id=2)
+    assert len(calls) == 1  # fatal: no second attempt
+    assert ei.value.attempts == 1
+    assert "fatal error" in str(ei.value)
+    assert _counters().get("fedml_send_failures_total{backend=testbk}") == 1
+
+
+def test_retry_send_budget_exhausted_raises_with_context():
+    def always_down():
+        raise ConnectionError("peer rebooting")
+
+    with pytest.raises(SendFailure) as ei:
+        retry_send(always_down, policy=FAST, backend="testbk",
+                   receiver_id=7, describe="rank 0 -> 10.0.0.7:9897")
+    exc = ei.value
+    assert exc.attempts == FAST.max_retries + 1
+    assert exc.receiver_id == 7
+    assert exc.backend == "testbk"
+    assert "rank 7" in str(exc)
+    assert "10.0.0.7:9897" in str(exc)
+    assert (_counters().get("fedml_send_retries_total{backend=testbk}")
+            == FAST.max_retries)
+
+
+def test_is_retryable_taxonomy():
+    assert is_retryable(TransientSendError("x"))
+    assert is_retryable(ConnectionError("reset"))
+    assert is_retryable(TimeoutError("slow"))
+    assert is_retryable(OSError("socket"))
+    # a spent budget never re-wraps; local misconfiguration never retries
+    assert not is_retryable(SendFailure("done"))
+    assert not is_retryable(FileNotFoundError("gone"))
+    assert not is_retryable(PermissionError("wall"))
+    assert not is_retryable(ValueError("codec bug"))
+
+
+def test_is_retryable_grpc_codes():
+    grpc = pytest.importorskip("grpc")
+
+    class _Rpc(grpc.RpcError):
+        def __init__(self, code):
+            self._code = code
+
+        def code(self):
+            return self._code
+
+    assert is_retryable(_Rpc(grpc.StatusCode.UNAVAILABLE))
+    assert is_retryable(_Rpc(grpc.StatusCode.DEADLINE_EXCEEDED))
+    assert not is_retryable(_Rpc(grpc.StatusCode.INVALID_ARGUMENT))
+    assert not is_retryable(_Rpc(grpc.StatusCode.UNIMPLEMENTED))
+
+
+# --- fault plan --------------------------------------------------------------
+
+
+def test_fault_plan_deterministic_across_interleavings():
+    """Same seed must make the same calls per edge regardless of how sends
+    from different edges interleave globally."""
+    rules = (FaultRule("drop", 0.5), FaultRule("duplicate", 0.3))
+
+    def decide_all(order):
+        plan = FaultPlan(seed=3, rules=rules)
+        out = {"e1": [], "e2": []}
+        for edge in order:
+            sender = 1 if edge == "e1" else 2
+            d = plan.decide(_msg(3, sender, 0))
+            out[edge].append((d.drop, d.duplicate))
+        return out
+
+    a = decide_all(["e1", "e1", "e2", "e1", "e2"] * 20)
+    b = decide_all(["e2", "e1", "e2", "e1", "e1"] * 20)  # different global order
+    assert a == b
+    # at 50% drop over 60 draws, both outcomes must appear
+    assert any(drop for drop, _ in a["e1"]) and not all(drop for drop, _ in a["e1"])
+    # a different seed reshuffles the plan
+    plan2 = FaultPlan(seed=4, rules=rules)
+    c = [plan2.decide(_msg(3, 1, 0)).drop for _ in range(60)]
+    assert c != [drop for drop, _ in a["e1"]]
+
+
+def test_fault_rule_scoping_by_type_and_round():
+    rule = FaultRule("drop", 1.0, msg_types=frozenset({3}), rounds=(1, 3))
+    assert rule.matches(3, 1)
+    assert rule.matches(3, 2)
+    assert not rule.matches(3, 0)
+    assert not rule.matches(3, 3)  # [start, stop)
+    assert not rule.matches(2, 1)  # wrong type
+    assert not rule.matches(3, None)  # round-scoped rules skip round-less traffic
+    plan = FaultPlan(seed=0, rules=(rule,))
+    assert not plan.decide(_msg(3, 1, 0)).drop  # no round param
+    assert plan.decide(_msg(3, 1, 0, round_idx=1)).drop
+
+
+def test_fault_plan_from_args_disabled_means_none():
+    assert FaultPlan.from_args(None) is None
+    assert FaultPlan.from_args(SimpleNamespace()) is None
+    # a seed alone configures nothing
+    assert FaultPlan.from_args(SimpleNamespace(fault_seed=9)) is None
+    # zero rates configure nothing (the byte-parity contract)
+    assert FaultPlan.from_args(SimpleNamespace(
+        fault_seed=9, fault_drop_rate=0.0, fault_duplicate_rate=0.0)) is None
+    plan = FaultPlan.from_args(SimpleNamespace(fault_seed=9, fault_drop_rate=0.2))
+    assert plan is not None and plan.active and plan.seed == 9
+    assert [r.action for r in plan.rules] == ["drop"]
+    # crash config alone activates; crash round defaults to 1
+    plan = FaultPlan.from_args(SimpleNamespace(fault_crash_rank=2))
+    assert plan is not None and plan.crash_rank == 2 and plan.crash_at_round == 1
+    assert plan.should_crash(2, 1) and not plan.should_crash(2, 0)
+    assert not plan.should_crash(1, 5)
+
+
+# --- chaos wrapper over a real backend ---------------------------------------
+
+
+def _wrapped_sender(plan, rank=1, size=2):
+    hub = LoopbackHub()
+    inner = LoopbackCommManager(rank=rank, size=size, hub=hub,
+                                retry_policy=FAST)
+    return hub, FaultyCommManager(inner, plan, rank=rank, retry_policy=FAST)
+
+
+def test_faulty_wrapper_drops_matching_messages():
+    plan = FaultPlan(seed=0, rules=(FaultRule("drop", 1.0, msg_types=frozenset({3})),))
+    hub, mgr = _wrapped_sender(plan)
+    mgr.send_message(_msg(3, 1, 0))
+    assert hub.register(0).qsize() == 0  # dropped on the floor
+    mgr.send_message(_msg(5, 1, 0))  # other types pass through
+    assert hub.register(0).qsize() == 1
+    assert _counters().get("fedml_faults_injected_total{action=drop}") == 1
+
+
+def test_faulty_wrapper_duplicates_messages():
+    plan = FaultPlan(seed=0, rules=(FaultRule("duplicate", 1.0),))
+    hub, mgr = _wrapped_sender(plan)
+    mgr.send_message(_msg(3, 1, 0))
+    assert hub.register(0).qsize() == 2
+    assert _counters().get("fedml_faults_injected_total{action=duplicate}") == 1
+
+
+def test_faulty_wrapper_injected_failures_exhaust_retry_budget():
+    plan = FaultPlan(seed=0, rules=(FaultRule("fail_send", 1.0),))
+    hub, mgr = _wrapped_sender(plan)
+    with pytest.raises(SendFailure) as ei:
+        mgr.send_message(_msg(3, 1, 0))
+    assert ei.value.attempts == FAST.max_retries + 1
+    assert hub.register(0).qsize() == 0  # every attempt failed before the wire
+    assert (_counters().get("fedml_faults_injected_total{action=fail_send}")
+            == FAST.max_retries + 1)
+
+
+def test_faulty_wrapper_crash_blackholes_both_directions():
+    plan = FaultPlan(seed=0, crash_rank=1, crash_at_round=1)
+    hub, mgr = _wrapped_sender(plan)
+    seen = []
+    mgr.add_observer(SimpleNamespace(
+        receive_message=lambda t, m: seen.append(m.get_type())))
+
+    mgr.send_message(_msg(3, 1, 0, round_idx=0))  # before the crash round
+    assert hub.register(0).qsize() == 1
+    mgr.receive_message(2, _msg(2, 0, 1, round_idx=0))
+    assert seen == [2]
+
+    mgr.receive_message(2, _msg(2, 0, 1, round_idx=1))  # crash trigger
+    assert mgr.crashed
+    assert seen == [2]  # the crashing message never reaches the actor
+    # process death stopped the inner receive loop (poison pill posted)
+    assert hub.register(1).get_nowait() is None
+    mgr.send_message(_msg(3, 1, 0, round_idx=1))  # a dead process sends nothing
+    assert hub.register(0).qsize() == 1
+    assert _counters().get("fedml_faults_injected_total{action=crash}") == 1
+
+
+# --- observer isolation (satellite) ------------------------------------------
+
+
+def test_observer_exception_does_not_kill_receive_loop():
+    hub = LoopbackHub()
+    mgr = LoopbackCommManager(rank=0, size=2, hub=hub)
+
+    class Bad:
+        def receive_message(self, t, m):
+            raise RuntimeError("handler bug")
+
+    good = []
+    mgr.add_observer(Bad())
+    mgr.add_observer(SimpleNamespace(
+        receive_message=lambda t, m: good.append(m.get_type())))
+
+    for mtype in (3, 5):
+        m = _msg(mtype, 1, 0)
+        hub.post(0, m.to_bytes())
+    hub.post(0, None)
+
+    rx = threading.Thread(target=mgr.handle_receive_message, daemon=True)
+    rx.start()
+    rx.join(timeout=10)
+    assert not rx.is_alive()
+    # the bad observer raised on both messages; the loop kept draining and
+    # the good observer saw everything
+    assert good == [3, 5]
+    errs = [v for k, v in _counters().items()
+            if k.startswith("fedml_observer_errors_total")]
+    assert sum(errs) == 2
+
+
+# --- mqtt_s3 orphan blob (satellite) -----------------------------------------
+
+
+def test_mqtt_s3_deletes_orphaned_blob_when_publish_fails():
+    from fedml_tpu.comm.mqtt_s3 import MqttS3CommManager
+    from fedml_tpu.comm.pubsub import InProcessBroker
+    from fedml_tpu.comm.store import InMemoryBlobStore
+
+    class DeadBroker(InProcessBroker):
+        def publish(self, topic, payload):
+            raise ConnectionError("broker unreachable")
+
+    store = InMemoryBlobStore()
+    mgr = MqttS3CommManager(DeadBroker(), store, rank=0, size=2,
+                            retry_policy=FAST)
+    msg = _msg(2, 0, 1)
+    # big enough to force the store-offload path (> INLINE_PAYLOAD_MAX_BYTES)
+    msg.add_params(Message.MSG_ARG_KEY_MODEL_PARAMS,
+                   {"w": np.zeros(4096, dtype=np.float64)})
+    with pytest.raises(SendFailure):
+        mgr.send_message(msg)
+    # the blob was uploaded before the publish failed; nobody will ever learn
+    # its key, so it must have been deleted again
+    assert store.list_keys() == []
+
+
+def test_mqtt_s3_inline_send_survives_transient_broker():
+    from fedml_tpu.comm.mqtt_s3 import MqttS3CommManager
+    from fedml_tpu.comm.pubsub import InProcessBroker
+    from fedml_tpu.comm.store import InMemoryBlobStore
+
+    class FlakyBroker(InProcessBroker):
+        def __init__(self):
+            super().__init__()
+            self.fails = 2
+
+        def publish(self, topic, payload):
+            if self.fails > 0:
+                self.fails -= 1
+                raise ConnectionError("blip")
+            super().publish(topic, payload)
+
+    got = []
+    broker = FlakyBroker()
+    server = MqttS3CommManager(broker, InMemoryBlobStore(), rank=0, size=2,
+                               retry_policy=FAST)
+    server.add_observer(SimpleNamespace(
+        receive_message=lambda t, m: got.append(t)))
+    client = MqttS3CommManager(broker, InMemoryBlobStore(), rank=1, size=2,
+                               retry_policy=FAST)
+    client.send_message(_msg(5, 1, 0))
+    server._inbox.put(None)
+    server.handle_receive_message()
+    assert got == [5]
+    assert _counters().get("fedml_send_retries_total{backend=mqtt_s3}") == 2
+
+
+# --- grpc failure context (satellite) ----------------------------------------
+
+
+def test_grpc_send_failure_names_rank_and_dialed_target():
+    pytest.importorskip("grpc")
+    from fedml_tpu.comm.grpc_backend import GRPCCommManager
+
+    mgr = GRPCCommManager(rank=0, size=2, ip_config={0: "127.0.0.1"},
+                          base_port=19340, retry_policy=FAST)
+    try:
+        with pytest.raises(SendFailure) as ei:
+            mgr.send_message(_msg(2, 0, 1))
+        text = str(ei.value)
+        assert "rank 0 ->" in text  # the sending rank
+        assert "no ip-table entry for rank 1" in text  # the dial target
+        assert ei.value.backend == "grpc"
+        assert ei.value.receiver_id == 1
+    finally:
+        mgr.stop_receive_message()
+
+
+# --- round-state store -------------------------------------------------------
+
+
+def test_round_state_store_roundtrip_restores_params_and_rng(tmp_path):
+    from fedml_tpu.utils.checkpoint import RoundStateStore
+
+    store = RoundStateStore(str(tmp_path / "round_state.msgpack"))
+    assert not store.exists()
+    params = {"w": np.arange(12, dtype=np.float32).reshape(3, 4),
+              "b": np.float64(0.5)}
+    np.random.seed(123)
+    store.save(7, params)
+    expected_draw = np.random.rand(4)  # what a never-crashed server draws next
+    np.random.seed(999)  # the "restarted process" has unrelated RNG state
+
+    state = RoundStateStore(store.path).load()
+    assert store.exists()
+    assert state["round_idx"] == 7
+    np.testing.assert_array_equal(state["params"]["w"], params["w"])
+    assert float(state["params"]["b"]) == 0.5
+    # RNG was re-seated: post-resume draws match the uninterrupted run
+    np.testing.assert_array_equal(np.random.rand(4), expected_draw)
