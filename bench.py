@@ -412,7 +412,36 @@ def chaos_bench(seed: int = 7) -> int:
     }
     print(json.dumps(line), flush=True)
     print(result.summary(), file=sys.stderr, flush=True)
-    return 0 if result.ok else 1
+    if not result.ok:
+        return 1
+
+    # second scenario: byzantine NaN uploads against the self-healing plane —
+    # the sanitizer must quarantine the corrupted silo every round and the
+    # run must stay finite and close every round
+    byz = run_chaos_drill(
+        fault_seed=seed, fault_byzantine_kind="nan",
+        fault_byzantine_ranks=[2], sanitize_updates=True,
+        local_test_on_all_clients=True, fault_drop_rate=0.0,
+    )
+    last_loss = (byz.history[-1].get("local_train_loss")
+                 if byz.history else None)
+    finite = last_loss is not None and last_loss == last_loss  # not NaN
+    byz_ok = byz.ok and byz.quarantined > 0 and finite
+    line = {
+        "metric": "chaos_byzantine_quarantined",
+        "unit": (f"sanitizer quarantine hits under NaN uploads from rank 2 "
+                 f"(seed={seed}); run must close finite"),
+        "value": int(byz.quarantined),
+        "rounds_completed": byz.rounds_completed,
+        "expected": byz.rounds_expected,
+        "elapsed_s": round(byz.elapsed_s, 3),
+        "final_local_train_loss": (round(last_loss, 4)
+                                   if finite else "non-finite"),
+        "rollbacks": int(byz.rollbacks),
+    }
+    print(json.dumps(line), flush=True)
+    print(byz.summary(), file=sys.stderr, flush=True)
+    return 0 if byz_ok else 1
 
 
 if __name__ == "__main__":

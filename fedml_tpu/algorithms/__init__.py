@@ -64,6 +64,8 @@ def get_algorithm(
     norm_bound: float = 5.0,
     stddev: float = 0.0,
     trim_ratio: float = 0.1,
+    byzantine_n: int = 0,
+    multi_krum_m: Optional[int] = None,
     dp_seed: int = 0,
 ) -> FedAlgorithm:
     """Build the named optimizer's FedAlgorithm bundle.
@@ -97,6 +99,8 @@ def get_algorithm(
             norm_bound=norm_bound,
             stddev=stddev,
             trim_ratio=trim_ratio,
+            byzantine_n=byzantine_n,
+            multi_krum_m=multi_krum_m,
         )
         local_update = make_local_update(apply_fn, cfg, needs_dropout, has_batch_stats)
         noisy = ra.defense_type == "weak_dp"

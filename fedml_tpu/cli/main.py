@@ -243,10 +243,21 @@ def run(config_file, backend):
 @click.option("--crash-rank", default=None, type=int,
               help="Rank to crash (black-hole) mid-run.")
 @click.option("--crash-at-round", default=1, type=int)
+@click.option("--byzantine-kind", default=None,
+              type=click.Choice(["scale", "sign_flip", "gauss", "nan"]),
+              help="Corrupt client uploads with this byzantine fault kind.")
+@click.option("--byzantine-rate", default=0.3, type=float,
+              help="Per-upload corruption probability (byzantine scenario).")
+@click.option("--byzantine-scale", default=10.0, type=float,
+              help="Boost factor for --byzantine-kind=scale.")
+@click.option("--defend/--no-defend", default=True,
+              help="Byzantine scenario: run with sanitizer + multi-Krum "
+                   "(default) or undefended (shows the damage).")
 @click.option("--timeout", default=120.0, type=float,
               help="Hang bound: the drill fails if the run outlives this.")
 def chaos_drill(seed, rounds, clients, drop_rate, duplicate_rate,
-                fail_send_rate, crash_rank, crash_at_round, timeout):
+                fail_send_rate, crash_rank, crash_at_round, byzantine_kind,
+                byzantine_rate, byzantine_scale, defend, timeout):
     """Stand up a full cross-silo deployment (server + clients, real codec,
     real round FSM) under the given fault plan and verify every round still
     closes. Exits 1 if the run hangs or loses rounds — the same check
@@ -262,6 +273,14 @@ def chaos_drill(seed, rounds, clients, drop_rate, duplicate_rate,
     if crash_rank is not None:
         kw.update(fault_crash_rank=crash_rank,
                   fault_crash_at_round=crash_at_round)
+    if byzantine_kind is not None:
+        kw.update(fault_byzantine_kind=byzantine_kind,
+                  fault_byzantine_rate=byzantine_rate,
+                  fault_byzantine_scale=byzantine_scale,
+                  local_test_on_all_clients=True)
+        if defend:
+            kw.update(defense_type="multi_krum", sanitize_updates=True,
+                      watchdog_factor=2.0)
     result = run_chaos_drill(join_timeout_s=timeout, **kw)
     click.echo(result.summary())
     if not result.ok:

@@ -42,6 +42,11 @@ from .message import Message
 # MSG_ARG_KEY_ROUND_INDEX; the comm layer must not import the FL layer).
 ROUND_IDX_PARAM = "round_idx"
 
+# Message param the model payload rides on (cross_silo.message_define
+# MSG_ARG_KEY_MODEL_PARAMS) — the byzantine fault kind corrupts this key on
+# client->server uploads only.
+MODEL_PARAMS_KEY = "model_params"
+
 # Upper bound on any injected delay: chaos must perturb ordering, not stall
 # test suites (the ISSUE's "no wall-clock sleeps beyond a small bound").
 MAX_INJECTED_DELAY_S = 2.0
@@ -194,6 +199,42 @@ def retry_send(
 
 FAULT_ACTIONS = ("drop", "delay", "duplicate", "fail_send")
 
+# Byzantine upload corruptions (the client-compromise analogue of the wire
+# faults above): applied to the model payload of client->server uploads.
+BYZANTINE_KINDS = ("scale", "sign_flip", "gauss", "nan")
+
+
+def corrupt_update_tree(tree, kind: str, *, scale: float = 10.0,
+                        std: float = 1.0, seed: int = 0, token: str = ""):
+    """Deterministically corrupt a model-update pytree the way a compromised
+    or broken client would: ``scale`` (model-replacement boost),
+    ``sign_flip`` (gradient ascent), ``gauss`` (noise replacement, drawn from
+    a sha256-derived generator so replays are bit-identical), ``nan`` (the
+    crashed-client availability attack). Integer leaves pass through ``nan``
+    unchanged (they cannot hold one); every other kind preserves dtype."""
+    import jax
+    import numpy as np
+
+    if kind not in BYZANTINE_KINDS:
+        raise ValueError(f"unknown byzantine kind {kind!r}; "
+                         f"expected one of {BYZANTINE_KINDS}")
+    gauss_seed = int.from_bytes(
+        hashlib.sha256(f"byz-gauss:{seed}:{token}".encode()).digest()[:8],
+        "big")
+    rng = np.random.default_rng(gauss_seed)
+
+    def _c(x):
+        a = np.asarray(x)
+        if kind == "scale":
+            return (a * scale).astype(a.dtype)
+        if kind == "sign_flip":
+            return -a
+        if kind == "nan":
+            return np.full_like(a, np.nan) if a.dtype.kind == "f" else a
+        return (std * rng.standard_normal(a.shape)).astype(a.dtype)
+
+    return jax.tree_util.tree_map(_c, tree)
+
 
 @dataclasses.dataclass(frozen=True)
 class FaultRule:
@@ -253,18 +294,38 @@ class FaultPlan:
 
     def __init__(self, seed: int = 0, rules: Sequence[FaultRule] = (),
                  crash_rank: Optional[int] = None,
-                 crash_at_round: Optional[int] = None):
+                 crash_at_round: Optional[int] = None,
+                 byzantine_kind: Optional[str] = None,
+                 byzantine_rate: float = 0.0,
+                 byzantine_ranks: Optional[FrozenSet[int]] = None,
+                 byzantine_scale: float = 10.0,
+                 byzantine_std: float = 1.0,
+                 byzantine_rounds: Optional[Tuple[int, int]] = None):
         self.seed = int(seed)
         self.rules = tuple(rules)
         self.crash_rank = crash_rank if crash_rank is None else int(crash_rank)
         self.crash_at_round = (crash_at_round if crash_at_round is None
                                else int(crash_at_round))
+        if byzantine_kind is not None and byzantine_kind not in BYZANTINE_KINDS:
+            raise ValueError(
+                f"unknown fault_byzantine_kind {byzantine_kind!r}; "
+                f"expected one of {BYZANTINE_KINDS}")
+        self.byzantine_kind = byzantine_kind
+        self.byzantine_rate = float(byzantine_rate)
+        self.byzantine_ranks = (None if byzantine_ranks is None
+                                else frozenset(int(r) for r in byzantine_ranks))
+        self.byzantine_scale = float(byzantine_scale)
+        self.byzantine_std = float(byzantine_std)
+        self.byzantine_rounds = (
+            None if byzantine_rounds is None
+            else (int(byzantine_rounds[0]), int(byzantine_rounds[1])))
         self._seq = {}
         self._lock = threading.Lock()
 
     @property
     def active(self) -> bool:
-        return bool(self.rules) or self.crash_rank is not None
+        return (bool(self.rules) or self.crash_rank is not None
+                or self.byzantine_kind is not None)
 
     def _next_seq(self, edge: str) -> int:
         with self._lock:
@@ -309,6 +370,26 @@ class FaultPlan:
                 return True
         return False
 
+    def should_corrupt(self, msg: Message) -> bool:
+        """Whether this upload's model payload gets the byzantine treatment.
+        Explicit ``byzantine_ranks`` pins the compromised clients; otherwise
+        a per-upload seeded draw at ``byzantine_rate`` (its own sequence
+        space, so adding wire-fault rules does not reshuffle who is
+        byzantine)."""
+        if self.byzantine_kind is None:
+            return False
+        if self.byzantine_rounds is not None:
+            rnd = message_round(msg)
+            start, stop = self.byzantine_rounds
+            if rnd is None or not (start <= rnd < stop):
+                return False
+        sender = int(msg.get_sender_id())
+        if self.byzantine_ranks is not None:
+            return sender in self.byzantine_ranks
+        seq = self._next_seq(f"byz:{sender}")
+        return _hash_fraction(
+            self.seed, "byzantine", sender, seq) < self.byzantine_rate
+
     def should_crash(self, rank: int, round_idx: Optional[int]) -> bool:
         return (self.crash_rank is not None
                 and rank == self.crash_rank
@@ -347,11 +428,24 @@ class FaultPlan:
         crash_at = getattr(args, "fault_crash_at_round", None)
         if crash_rank is not None and crash_at is None:
             crash_at = 1
+        byz_ranks = getattr(args, "fault_byzantine_ranks", None)
+        if byz_ranks is not None:
+            byz_ranks = frozenset(int(r) for r in byz_ranks)
+        byz_rounds = getattr(args, "fault_byzantine_rounds", None)
+        if byz_rounds is not None:
+            byz_rounds = (int(byz_rounds[0]), int(byz_rounds[1]))
         plan = cls(
             seed=int(getattr(args, "fault_seed", 0)),
             rules=rules,
             crash_rank=crash_rank,
             crash_at_round=crash_at,
+            byzantine_kind=getattr(args, "fault_byzantine_kind", None),
+            byzantine_rate=float(
+                getattr(args, "fault_byzantine_rate", 0.0) or 0.0),
+            byzantine_ranks=byz_ranks,
+            byzantine_scale=float(getattr(args, "fault_byzantine_scale", 10.0)),
+            byzantine_std=float(getattr(args, "fault_byzantine_std", 1.0)),
+            byzantine_rounds=byz_rounds,
         )
         return plan if plan.active else None
 
@@ -408,6 +502,7 @@ class FaultyCommManager(BaseCommunicationManager, Observer):
         if self.plan.should_crash(self.rank, message_round(msg)):
             self._die("send")
             return
+        self._maybe_corrupt_upload(msg)
         d = self.plan.decide(msg)
         if d.drop:
             telemetry.record_fault("drop")
@@ -438,6 +533,37 @@ class FaultyCommManager(BaseCommunicationManager, Observer):
                 describe=f"under fault plan seed={self.plan.seed}",
                 attempt_hook=_inject,
             )
+
+    def _maybe_corrupt_upload(self, msg: Message) -> None:
+        """Byzantine client simulation: corrupt the model payload of a
+        client->server upload ONCE, before the duplicate draw — a compromised
+        client computes its bad update once, so every copy carries the same
+        corruption. Server broadcasts carry the same param key but are never
+        touched (sender 0)."""
+        if self.plan.byzantine_kind is None:
+            return
+        payload = msg.get(MODEL_PARAMS_KEY)
+        if payload is None or int(msg.get_sender_id()) == 0:
+            return
+        if not self.plan.should_corrupt(msg):
+            return
+        from .message import decompress_tree, is_compressed
+
+        if is_compressed(payload):
+            # corrupt real tensors, not codec blobs — the server decompresses
+            # to the same values it would have gotten from a live attacker
+            payload = decompress_tree(payload)
+        corrupted = corrupt_update_tree(
+            payload, self.plan.byzantine_kind,
+            scale=self.plan.byzantine_scale, std=self.plan.byzantine_std,
+            seed=self.plan.seed,
+            token=f"{msg.get_sender_id()}:{message_round(msg)}")
+        msg.add_params(MODEL_PARAMS_KEY, corrupted)
+        telemetry.record_fault("byzantine")
+        logging.info(
+            "fault: byzantine(%s) corrupting upload %d->%d (round %s)",
+            self.plan.byzantine_kind, msg.get_sender_id(),
+            msg.get_receiver_id(), message_round(msg))
 
     # --- receive path (wrapper observes the inner backend) ------------------
 

@@ -1,4 +1,4 @@
-"""Robust aggregation defenses: norm clipping, weak DP, coordinate median.
+"""Robust aggregation defenses: clipping, weak DP, median, Krum, sanitizer.
 
 Parity: reference ``core/robustness/robust_aggregation.py:41``
 (``norm_diff_clipping:46``, ``add_noise:61``, ``coordinate_median_agg:66``).
@@ -8,6 +8,12 @@ fused XLA program instead of a per-client Python loop, and they slot directly
 into ``FedAlgorithm.aggregate``. BatchNorm running stats are excluded from
 clipping by name, matching the reference's ``is_weight_param`` filter
 (robust_aggregation.py:34-39).
+
+Beyond the reference: the **update sanitizer** (:func:`sanitize_stacked` —
+non-finite leaves and robust-z norm outliers get their aggregation weight
+zeroed and land in a per-round quarantine set) and the **Krum family**
+(:func:`krum_aggregate` — Blanchard et al. 2017 selection over pairwise
+squared distances, all inside XLA), which the reference only documents.
 """
 
 from __future__ import annotations
@@ -75,17 +81,135 @@ def coordinate_median(stacked_updates: PyTree) -> PyTree:
     return jax.tree_util.tree_map(lambda x: jnp.median(x, axis=0), stacked_updates)
 
 
-def trimmed_mean(stacked_updates: PyTree, trim_ratio: float = 0.1) -> PyTree:
+def trimmed_mean(stacked_updates: PyTree, trim_ratio: float = 0.1,
+                 weights: Optional[jax.Array] = None) -> PyTree:
     """Coordinate-wise β-trimmed mean (same paper as coordinate median; the
-    reference doesn't ship it but lists it in its robustness docs)."""
+    reference doesn't ship it but lists it in its robustness docs).
+
+    Weight-aware: with ``weights`` the surviving (untrimmed) coordinates are
+    combined by their owners' weights instead of a plain mean, so e.g. a
+    zero-weight (quarantined) client's coordinates can survive the trim
+    without contributing. ``k = min(int(n*trim_ratio), (n-1)//2)`` guarantees
+    a non-empty slice for any cohort size (k <= (n-1)//2 implies n-k > k)."""
 
     def _tm(x):
         n = x.shape[0]
-        k = int(n * trim_ratio)
-        s = jnp.sort(x, axis=0)
-        return jnp.mean(s[k: n - k if n - k > k else k + 1], axis=0)
+        k = min(int(n * trim_ratio), (n - 1) // 2)
+        if weights is None:
+            s = jnp.sort(x, axis=0)
+            return jnp.mean(s[k: n - k], axis=0)
+        order = jnp.argsort(x.astype(jnp.float32), axis=0)
+        xs = jnp.take_along_axis(x.astype(jnp.float32), order, axis=0)
+        # fancy-index the (n,) weight vector by the per-coordinate order so
+        # each sorted coordinate carries its owner's weight
+        ws = weights.astype(jnp.float32)[order]
+        num = jnp.sum(xs[k: n - k] * ws[k: n - k], axis=0)
+        den = jnp.maximum(jnp.sum(ws[k: n - k], axis=0), 1e-12)
+        return (num / den).astype(x.dtype)
 
     return jax.tree_util.tree_map(_tm, stacked_updates)
+
+
+def sanitize_stacked(stacked_updates: PyTree, weights: jax.Array,
+                     z_thresh: float = 6.0):
+    """Quarantine poisoned rows of a stacked cohort before any aggregation.
+
+    Two detectors, both jit-able over the whole cohort at once:
+
+    - **non-finite**: any NaN/Inf leaf entry quarantines the client — one
+      non-finite upload would otherwise poison the global params forever
+      (``0 * nan == nan``, so even zero-weighting is not enough);
+    - **norm outlier**: robust z-score of each client's update L2 norm,
+      ``z = (norm - median) / max(1.4826 * MAD, floor)``, upper side only —
+      scaled-boost (model replacement) uploads sit far above the honest
+      norm band. The MAD floor is relative (5% of the median) so a cohort
+      of near-identical norms doesn't turn fp jitter into outliers.
+
+    Returns ``(clean_updates, clean_weights, quarantine, z)``: quarantined
+    rows are **zeroed** (not just zero-weighted) and their weight is 0;
+    ``quarantine`` is a (C,) bool mask and ``z`` the (C,) robust z-scores
+    (``+inf`` for non-finite rows).
+    """
+    leaves = jax.tree_util.tree_leaves(stacked_updates)
+    C = leaves[0].shape[0]
+    bad = jnp.zeros((C,), bool)
+    sq = jnp.zeros((C,), jnp.float32)
+    for x in leaves:
+        xf = x.astype(jnp.float32).reshape(C, -1)
+        bad = bad | ~jnp.isfinite(xf).all(axis=1)
+        sq = sq + jnp.sum(jnp.square(jnp.nan_to_num(xf)), axis=1)
+    norm = jnp.sqrt(sq)
+    med = jnp.median(norm)
+    mad = jnp.median(jnp.abs(norm - med))
+    scale = jnp.maximum(1.4826 * mad, 1e-6 + 0.05 * med)
+    z = jnp.where(bad, jnp.inf, (norm - med) / scale)
+    quarantine = bad | (z > z_thresh)
+    keep = 1.0 - quarantine.astype(jnp.float32)
+    clean = jax.tree_util.tree_map(
+        lambda x: jnp.where(
+            quarantine.reshape((C,) + (1,) * (x.ndim - 1)),
+            jnp.zeros_like(x), x),
+        stacked_updates,
+    )
+    return clean, weights * keep, quarantine, z
+
+
+def pairwise_sq_dists(stacked_updates: PyTree) -> jax.Array:
+    """(C, C) squared L2 distances between clients' updates, computed as one
+    vmap-ed reduction over the flattened cohort matrix — XLA lowers the
+    ``vmap(row . matrix)`` to a single (C, D) x (D, C) matmul (MXU-friendly)
+    instead of C² per-pair subtractions. Non-finite entries are zeroed first
+    so a NaN upload cannot poison every distance (its row is caught by
+    :func:`sanitize_stacked` / the Krum score penalty instead)."""
+    leaves = jax.tree_util.tree_leaves(stacked_updates)
+    C = leaves[0].shape[0]
+    flat = jnp.concatenate(
+        [jnp.nan_to_num(x.astype(jnp.float32)).reshape(C, -1) for x in leaves],
+        axis=1,
+    )
+    sqn = jnp.sum(flat * flat, axis=1)
+    gram = jax.vmap(lambda r: flat @ r)(flat)
+    return jnp.maximum(sqn[:, None] + sqn[None, :] - 2.0 * gram, 0.0)
+
+
+def krum_scores(dists: jax.Array, n_byz: int) -> jax.Array:
+    """Krum score per client (Blanchard et al. 2017): the sum of its
+    ``C - f - 2`` smallest squared distances to OTHER clients (the self
+    distance — the zero first column of the row-sorted matrix — is dropped).
+    Lower = better surrounded by honest peers."""
+    C = dists.shape[0]
+    k = max(1, min(C - n_byz - 2, C - 1))
+    s = jnp.sort(dists, axis=1)
+    return s[:, 1:k + 1].sum(axis=1)
+
+
+def krum_aggregate(stacked_updates: PyTree, weights: jax.Array,
+                   n_byz: int = 0, m: int = 1,
+                   sample_weighted: bool = False):
+    """Krum-family aggregation, selection fully inside XLA.
+
+    ``m=1`` is classic Krum (the single best-surrounded update), ``m>1`` is
+    multi-Krum over the ``m`` lowest-scoring clients — averaged uniformly
+    (the paper's form) or by sample weight (``sample_weighted=True``,
+    FedAvg-over-Krum-survivors). Zero-weight clients (dropped or already
+    quarantined) get an infinite score so they can never be selected.
+    Returns ``(aggregate, selected)`` with ``selected`` a (C,) float mask.
+    """
+    scores = krum_scores(pairwise_sq_dists(stacked_updates), n_byz)
+    scores = jnp.where(weights > 0, scores, jnp.inf)
+    C = scores.shape[0]
+    m = max(1, min(int(m), C))
+    _, idx = jax.lax.top_k(-scores, m)
+    selected = jnp.zeros((C,), jnp.float32).at[idx].set(1.0)
+    # a selected-but-zero-weight client (cohort smaller than m) still must
+    # not contribute
+    selected = selected * (weights > 0)
+    w = selected * weights.astype(jnp.float32) if sample_weighted else selected
+    w = w / jnp.maximum(jnp.sum(w), 1e-12)
+    agg = jax.tree_util.tree_map(
+        lambda x: jnp.tensordot(w.astype(x.dtype), x, axes=1), stacked_updates
+    )
+    return agg, selected
 
 
 @dataclasses.dataclass(frozen=True)
@@ -93,17 +217,62 @@ class RobustAggregator:
     """Config-driven defense bundle (reference ``RobustAggregator:41``).
 
     defense_type: 'norm_diff_clipping' | 'weak_dp' | 'coordinate_median' |
-    'trimmed_mean' | None. Call :meth:`aggregate` with stacked updates and
-    normalized weights; returns the defended aggregate.
+    'trimmed_mean' | 'krum' | 'multi_krum' | 'krum_fedavg' | None. Call
+    :meth:`aggregate` with stacked updates and weights; returns the defended
+    aggregate. :meth:`aggregate_with_info` additionally reports the per-round
+    quarantine/selection masks for telemetry and rollback decisions.
+
+    ``sanitize=True`` runs :func:`sanitize_stacked` before the defense:
+    non-finite and norm-outlier rows are zeroed and zero-weighted (for the
+    weight-blind median/trimmed defenses a zeroed row is a conservative
+    "no-op update" vote — still within those estimators' breakdown point).
+
+    ``byzantine_n`` is Krum's f (0 = auto ``(C-3)//2``, the paper's maximum
+    admissible); ``multi_krum_m`` the survivor count (None = ``C - f``).
     """
 
     defense_type: Optional[str] = None
     norm_bound: float = 1.0
     stddev: float = 0.0
     trim_ratio: float = 0.1
+    byzantine_n: int = 0
+    multi_krum_m: Optional[int] = None
+    sanitize: bool = False
+    z_thresh: float = 6.0
+
+    KRUM_FAMILY = ("krum", "multi_krum", "krum_fedavg")
+
+    def _krum_fm(self, cohort_size: int) -> tuple:
+        f = self.byzantine_n if self.byzantine_n > 0 else max(
+            0, (cohort_size - 3) // 2)
+        if self.defense_type == "krum":
+            return f, 1
+        m = (int(self.multi_krum_m) if self.multi_krum_m
+             else max(1, cohort_size - f))
+        return f, m
 
     def aggregate(self, stacked_updates: PyTree, weights: jax.Array, rng=None) -> PyTree:
-        w = weights / jnp.sum(weights)
+        agg, _ = self.aggregate_with_info(stacked_updates, weights, rng)
+        return agg
+
+    def aggregate_with_info(self, stacked_updates: PyTree, weights: jax.Array,
+                            rng=None) -> tuple:
+        """Defended aggregate plus a jit-compatible info dict:
+        ``quarantine`` (C,) bool, ``z`` (C,) robust z-scores, ``selected``
+        (C,) float — the clients that actually contributed."""
+        C = jax.tree_util.tree_leaves(stacked_updates)[0].shape[0]
+        if self.sanitize:
+            stacked_updates, weights, quarantine, z = sanitize_stacked(
+                stacked_updates, weights, self.z_thresh)
+        else:
+            quarantine = jnp.zeros((C,), bool)
+            z = jnp.zeros((C,), jnp.float32)
+        # all-quarantined cohort: the eps floor turns the round into a no-op
+        # (zero aggregate) instead of a NaN division
+        w = weights / jnp.maximum(jnp.sum(weights), 1e-12)
+        selected = (weights > 0).astype(jnp.float32)
+        info = lambda: {"quarantine": quarantine, "z": z,  # noqa: E731
+                        "selected": selected}
 
         def weighted_mean(tree):
             return jax.tree_util.tree_map(
@@ -111,9 +280,10 @@ class RobustAggregator:
             )
 
         if self.defense_type in (None, "none"):
-            return weighted_mean(stacked_updates)
+            return weighted_mean(stacked_updates), info()
         if self.defense_type == "norm_diff_clipping":
-            return weighted_mean(norm_clip_stacked(stacked_updates, self.norm_bound))
+            return weighted_mean(
+                norm_clip_stacked(stacked_updates, self.norm_bound)), info()
         if self.defense_type == "weak_dp":
             if rng is None:
                 raise ValueError(
@@ -121,9 +291,16 @@ class RobustAggregator:
                     "key would add the same noise every round (no privacy)"
                 )
             clipped = weighted_mean(norm_clip_stacked(stacked_updates, self.norm_bound))
-            return add_gaussian_noise(clipped, self.stddev, rng)
+            return add_gaussian_noise(clipped, self.stddev, rng), info()
         if self.defense_type == "coordinate_median":
-            return coordinate_median(stacked_updates)
+            return coordinate_median(stacked_updates), info()
         if self.defense_type == "trimmed_mean":
-            return trimmed_mean(stacked_updates, self.trim_ratio)
+            return trimmed_mean(
+                stacked_updates, self.trim_ratio, weights=weights), info()
+        if self.defense_type in self.KRUM_FAMILY:
+            f, m = self._krum_fm(C)
+            agg, selected = krum_aggregate(
+                stacked_updates, weights, n_byz=f, m=m,
+                sample_weighted=self.defense_type == "krum_fedavg")
+            return agg, info()
         raise ValueError(f"unknown defense_type '{self.defense_type}'")

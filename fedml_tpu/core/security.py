@@ -49,6 +49,17 @@ def sign_flip_attack(updates: PyTree, attacker_mask: jax.Array,
     )
 
 
+def nan_attack(updates: PyTree, attacker_mask: jax.Array) -> PyTree:
+    """Attackers upload all-NaN deltas — the availability attack a single
+    crashed/overflowed client mounts by accident: without sanitization one
+    such row makes the aggregate (and every later round) NaN."""
+    return jax.tree.map(
+        lambda u: jnp.where(_mask_bcast(attacker_mask, u) > 0,
+                            jnp.full_like(u, jnp.nan), u),
+        updates,
+    )
+
+
 def gaussian_attack(updates: PyTree, attacker_mask: jax.Array, rng,
                     std: float = 1.0) -> PyTree:
     """Attackers replace their update with pure Gaussian noise."""
@@ -71,7 +82,7 @@ class FedMLAttacker:
     """Reference API shell (``fedml_attacker.py``) made functional: holds an
     attacker mask and applies the configured attack to stacked updates."""
 
-    ATTACK_TYPES = ("scale", "sign_flip", "gaussian")
+    ATTACK_TYPES = ("scale", "sign_flip", "gaussian", "nan")
 
     def __init__(self, attack_type: str = "scale", attacker_ratio: float = 0.2,
                  boost: float = 10.0, std: float = 1.0, *,
@@ -110,6 +121,8 @@ class FedMLAttacker:
             return scale_attack(updates, mask, self.boost)
         if self.attack_type == "sign_flip":
             return sign_flip_attack(updates, mask, self.strength)
+        if self.attack_type == "nan":
+            return nan_attack(updates, mask)
         # gaussian: fresh noise per call — the key advances with a counter so
         # multi-round attacks are not a fixed-direction bias
         rng = jax.random.fold_in(jax.random.PRNGKey(self.seed), self._calls)

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..core import telemetry
 from ..core.robust import RobustAggregator
 from ..simulation.fed_sim import reference_client_sampling
 
@@ -60,11 +61,32 @@ class FedMLAggregator:
         # compares against this, not the full flag dict
         self.expected_this_round = client_num
         defense = getattr(args, "defense_type", None)
+        # the divergence watchdog (server_manager) needs per-slot z-scores to
+        # decide who to exclude on rollback, so it forces the sanitizer on
+        self.detect = bool(getattr(args, "sanitize_updates", False)) or (
+            float(getattr(args, "watchdog_factor", 0) or 0) > 0)
         self._robust = RobustAggregator(
             defense_type=defense,
             norm_bound=float(getattr(args, "norm_bound", 5.0)),
             stddev=float(getattr(args, "stddev", 0.0)),
-        ) if defense else None
+            trim_ratio=float(getattr(args, "trim_ratio", 0.1)),
+            byzantine_n=int(getattr(args, "byzantine_n", 0)),
+            multi_krum_m=(
+                None if getattr(args, "multi_krum_m", None) is None
+                else int(args.multi_krum_m)
+            ),
+            sanitize=self.detect,
+            z_thresh=float(getattr(args, "sanitize_z_thresh", 6.0)),
+        ) if (defense or self.detect) else None
+        # weak_dp noise key: fresh per aggregation via fold_in(seed key, call
+        # counter) — the old code passed no rng at all, so enabling weak_dp
+        # cross-silo raised ValueError on the first round
+        self._dp_key = jax.random.PRNGKey(int(getattr(args, "random_seed", 0)))
+        self._agg_calls = 0
+        # per-aggregation detection report (slot-indexed; the server manager
+        # maps slots back to real edge ids)
+        self.last_quarantined_slots: List[int] = []
+        self.last_z: Dict[int, float] = {}
         self._agg_fn = jax.jit(self._aggregate_stacked)
 
     # --- reference API ------------------------------------------------------
@@ -113,14 +135,16 @@ class FedMLAggregator:
         result is already in)."""
         return index in self.model_dict
 
-    def _aggregate_stacked(self, stacked: PyTree, weights: jax.Array) -> PyTree:
+    def _aggregate_stacked(self, stacked: PyTree, weights: jax.Array, rng):
         if self._robust is not None:
-            return self._robust.aggregate(stacked, weights)
+            agg, info = self._robust.aggregate_with_info(stacked, weights, rng)
+            return agg, info["quarantine"], info["z"]
         w = weights / jnp.maximum(weights.sum(), 1.0)
-        return jax.tree.map(
+        agg = jax.tree.map(
             lambda x: jnp.tensordot(w.astype(jnp.float32), x.astype(jnp.float32), axes=(0, 0)).astype(x.dtype),
             stacked,
         )
+        return agg, None, None
 
     def aggregate(self) -> PyTree:
         """Clients upload *deltas* (local - global); the new global model is
@@ -128,6 +152,8 @@ class FedMLAggregator:
         param mean, with defenses applied to the deltas (where clipping is
         actually meaningful)."""
         idx = sorted(self.model_dict)
+        self.last_quarantined_slots = []
+        self.last_z = {}
         if not idx:
             # zero uploads (a fully-dead round closed by the straggler
             # timeout with min_clients=0): keep the global model unchanged
@@ -137,7 +163,20 @@ class FedMLAggregator:
             *[self.model_dict[i] for i in idx],
         )
         weights = jnp.asarray([self.sample_num_dict[i] for i in idx], jnp.float32)
-        agg_delta = self._agg_fn(stacked, weights)
+        self._agg_calls += 1
+        rng = (jax.random.fold_in(self._dp_key, self._agg_calls)
+               if self._robust is not None else None)
+        agg_delta, quarantine, z = self._agg_fn(stacked, weights, rng)
+        if quarantine is not None:
+            qn = np.asarray(quarantine)
+            zn = np.asarray(z)
+            self.last_quarantined_slots = [idx[i] for i in np.nonzero(qn)[0]]
+            self.last_z = {idx[i]: float(zn[i]) for i in range(len(idx))}
+            if self.last_quarantined_slots:
+                reg = telemetry.get_registry()
+                if reg.enabled:
+                    reg.counter("fedml_quarantined_total").inc(
+                        len(self.last_quarantined_slots))
         self.model_params = jax.tree.map(
             lambda p, d: (jnp.asarray(p) + d.astype(p.dtype)), self.model_params, agg_delta
         )

@@ -52,6 +52,10 @@ class ChaosDrillResult:
     send_retries: float
     send_failures: float
     history: List[dict]
+    # self-healing plane (PR 4): sanitizer quarantine hits and watchdog
+    # rollbacks observed during the drill (0 unless defenses are on)
+    quarantined: float = 0.0
+    rollbacks: float = 0.0
 
     @property
     def ok(self) -> bool:
@@ -60,12 +64,16 @@ class ChaosDrillResult:
     def summary(self) -> str:
         faults = ", ".join(f"{k}={int(v)}"
                            for k, v in sorted(self.faults_injected.items()))
+        healing = ""
+        if self.quarantined or self.rollbacks:
+            healing = (f" | quarantined={int(self.quarantined)} "
+                       f"rollbacks={int(self.rollbacks)}")
         return (
             f"chaos drill: {'PASS' if self.ok else 'FAIL'} — "
             f"{self.rounds_completed}/{self.rounds_expected} rounds in "
             f"{self.elapsed_s:.1f}s | faults injected: {faults or 'none'} | "
             f"sends retried={int(self.send_retries)} "
-            f"declared-dead={int(self.send_failures)}"
+            f"declared-dead={int(self.send_failures)}" + healing
         )
 
 
@@ -153,4 +161,6 @@ def run_chaos_drill(args=None, n_clients: Optional[int] = None,
         send_retries=sum(delta("fedml_send_retries_total").values()),
         send_failures=sum(delta("fedml_send_failures_total").values()),
         history=list(server.history),
+        quarantined=sum(delta("fedml_quarantined_total").values()),
+        rollbacks=sum(delta("fedml_rollbacks_total").values()),
     )

@@ -19,6 +19,8 @@ import threading
 import time
 from typing import Dict, List, Optional, Set
 
+import numpy as np
+
 from ..comm import Message, ServerManager
 from ..comm.resilience import SendFailure
 from ..comm.utils import log_round_end, log_round_start
@@ -93,6 +95,20 @@ class FedMLServerManager(ServerManager):
             logging.warning(
                 "server: resumed round state from %s — continuing at round "
                 "%d/%d", ckpt_path, self.round_idx, self.round_num)
+        # divergence watchdog (self-healing rounds): after each aggregation,
+        # compare the round's eval loss against a windowed baseline and check
+        # the global params for non-finite leaves; a bad round is rolled back
+        # to its pre-aggregate params and re-run (same round_idx) without the
+        # clients the sanitizer's z-scores implicate, at most max_rollbacks
+        # times per round. 0 disables.
+        self.watchdog_factor = float(getattr(args, "watchdog_factor", 0) or 0)
+        self.watchdog_window = int(getattr(args, "watchdog_window", 5))
+        self.max_rollbacks = int(getattr(args, "max_rollbacks", 2))
+        self.rollback_z_thresh = float(getattr(args, "rollback_z_thresh", 3.0))
+        self._loss_window: List[float] = []
+        self._rollbacks_this_round = 0
+        self._excluded_this_round: Set[int] = set()  # real edge ids
+        self._finite_fn = None
         # telemetry: one root trace context per round (init/sync messages are
         # stamped with it, clients inherit it on receive and their replies
         # carry it back) + per-client round-trip timing from broadcast to
@@ -461,6 +477,10 @@ class FedMLServerManager(ServerManager):
         if self.mlops_event:
             self.mlops_event.log_event_started("server.agg_and_eval",
                                                event_value=str(self.round_idx))
+        # the round's pre-aggregate params double as the rollback restore
+        # point: cross-silo eval runs on the post-aggregate params, so any
+        # state that survived last round's watchdog check is validated-good
+        pre_params = self.aggregator.get_global_model_params()
         # span under the completed round's trace context (the timeout path
         # arrives on a timer thread with no inherited context)
         with self._in_round_ctx():
@@ -472,7 +492,22 @@ class FedMLServerManager(ServerManager):
         if self.mlops_event:
             self.mlops_event.log_event_ended("server.agg_and_eval",
                                              event_value=str(self.round_idx))
-        self.history.append({"round": self.round_idx, **metrics})
+        if self.watchdog_factor > 0:
+            retry = self._watchdog_verdict_locked(pre_params, metrics)
+            if retry is not None:
+                return retry
+        record = {"round": self.round_idx, **metrics}
+        if self.watchdog_factor > 0 or getattr(self.aggregator, "detect", False):
+            cohort = self.client_id_list_in_this_round
+            record["quarantined"] = sorted(
+                {cohort[s] for s in
+                 getattr(self.aggregator, "last_quarantined_slots", [])
+                 if s < len(cohort)}
+                | self._excluded_this_round)
+            record["rollbacks"] = self._rollbacks_this_round
+        self._rollbacks_this_round = 0
+        self._excluded_this_round = set()
+        self.history.append(record)
         log_round_end(self.rank, self.round_idx)
 
         self.round_idx += 1
@@ -516,6 +551,83 @@ class FedMLServerManager(ServerManager):
             sync.add_params(
                 MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(self.data_silo_index_list[idx])
             )
+            sync.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
+            msgs.append(sync)
+        return msgs, False, self._round_gen, self._round_ctx
+
+    def _watchdog_verdict_locked(self, pre_params, metrics):
+        """Judge the just-aggregated round. Healthy → fold its loss into the
+        baseline window and return None (accept). Bad (non-finite loss or
+        global params, or loss > watchdog_factor × windowed median) → restore
+        ``pre_params`` and return a re-SYNC outcome for the SAME round_idx
+        minus the clients the sanitizer's z-scores implicate; once
+        ``max_rollbacks`` is spent (or nobody is excludable) the round is
+        accepted degraded. Caller holds the round lock.
+
+        No RoundStateStore rewrite is needed on restore: checkpoints are
+        written only when a round is *accepted*, so the store never holds a
+        rolled-back aggregate."""
+        loss = metrics.get("local_train_loss", metrics.get("local_test_loss"))
+        if self._finite_fn is None:
+            import jax
+            import jax.numpy as jnp
+
+            self._finite_fn = jax.jit(lambda p: jax.tree_util.tree_reduce(
+                lambda a, x: jnp.logical_and(a, jnp.all(jnp.isfinite(x))),
+                p, jnp.bool_(True)))
+        spike = bool(
+            loss is not None and np.isfinite(loss) and self._loss_window
+            and loss > self.watchdog_factor * float(np.median(self._loss_window)))
+        bad = ((loss is not None and not np.isfinite(loss)) or spike
+               or not bool(self._finite_fn(
+                   self.aggregator.get_global_model_params())))
+        if not bad:
+            if loss is not None:
+                self._loss_window.append(float(loss))
+                del self._loss_window[:-max(1, self.watchdog_window)]
+            return None
+        if self._rollbacks_this_round >= self.max_rollbacks:
+            logging.error(
+                "watchdog: round %d still bad after %d rollbacks — accepting "
+                "degraded state", self.round_idx, self._rollbacks_this_round)
+            return None
+        cohort = self.client_id_list_in_this_round
+        zmap = dict(getattr(self.aggregator, "last_z", {}) or {})
+        cand = {cohort[s] for s, zv in zmap.items()
+                if zv >= self.rollback_z_thresh and s < len(cohort)}
+        if not cand and zmap:
+            # nobody crossed the threshold: exclude the single worst z so a
+            # just-under-threshold attacker cannot stall every retry
+            worst = max(zmap, key=zmap.get)
+            if worst < len(cohort):
+                cand = {cohort[worst]}
+        survivors = [c for c in cohort if c not in cand]
+        if not cand or not survivors:
+            logging.error(
+                "watchdog: round %d bad but no excludable client — accepting "
+                "degraded state", self.round_idx)
+            return None
+        self.aggregator.set_global_model_params(pre_params)
+        self._rollbacks_this_round += 1
+        self._excluded_this_round |= cand
+        reg = telemetry.get_registry()
+        if reg.enabled:
+            reg.counter("fedml_rollbacks_total").inc()
+        pairing = dict(zip(cohort, self.data_silo_index_list))
+        self.client_id_list_in_this_round = survivors
+        self.data_silo_index_list = [pairing[c] for c in survivors]
+        self.aggregator.set_expected_this_round(len(survivors))
+        logging.warning(
+            "watchdog: round %d rollback #%d (%s) — re-running without "
+            "clients %s", self.round_idx, self._rollbacks_this_round,
+            "loss spike" if spike else "non-finite state", sorted(cand))
+        msgs = []
+        for idx, cid in enumerate(self.client_id_list_in_this_round):
+            sync = Message(
+                MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, cid)
+            sync.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, pre_params)
+            sync.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
+                            int(self.data_silo_index_list[idx]))
             sync.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
             msgs.append(sync)
         return msgs, False, self._round_gen, self._round_ctx

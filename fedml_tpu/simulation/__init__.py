@@ -100,6 +100,12 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
             getattr(args, "local_test_on_all_clients", False)),
         prefetch=bool(getattr(args, "prefetch", True)),
         prefetch_depth=int(getattr(args, "prefetch_depth", 2)),
+        sanitize_updates=bool(getattr(args, "sanitize_updates", False)),
+        sanitize_z_thresh=float(getattr(args, "sanitize_z_thresh", 6.0)),
+        watchdog_factor=float(getattr(args, "watchdog_factor", 0.0) or 0.0),
+        watchdog_window=int(getattr(args, "watchdog_window", 5)),
+        max_rollbacks=int(getattr(args, "max_rollbacks", 2)),
+        rollback_z_thresh=float(getattr(args, "rollback_z_thresh", 3.0)),
     )
 
     attack_type = getattr(args, "attack_type", None)
@@ -158,10 +164,14 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
         norm_bound=float(getattr(args, "norm_bound", 5.0)),
         stddev=float(getattr(args, "stddev", 0.0)),
         trim_ratio=float(getattr(args, "trim_ratio", 0.1)),
+        byzantine_n=int(getattr(args, "byzantine_n", 0)),
+        multi_krum_m=(
+            None if getattr(args, "multi_krum_m", None) is None
+            else int(args.multi_krum_m)
+        ),
         dp_seed=int(getattr(args, "random_seed", 0)),
     )
-    if attack_type:
-        alg = _inject_attacker(alg, args)
+    update_transform = _make_attack_transform(alg, args) if attack_type else None
     sim = FedSimulator(
         fed_data, alg, variables, sim_cfg, mesh=mesh,
         # raw pieces for the packed cohort schedule's in-scan batch step
@@ -172,26 +182,25 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
         hook_args=args,
         # MLOpsProfilerEvent (or None): emits host_pack/round_dispatch spans
         profiler=getattr(args, "profiler", None),
+        update_transform=update_transform,
     )
     return sim, apply_fn
 
 
-def _inject_attacker(alg, args):
-    """Adversarial-client simulation: wrap aggregation so the configured
-    attack (core/security) corrupts the stacked updates BEFORE any defense
-    runs. Deterministic attacks only (scale/sign_flip) — aggregation is
-    traced once, so a gaussian attacker would freeze to one noise draw;
-    use the library API outside jit for that threat model."""
-    import dataclasses as _dc
-
-    import jax.numpy as jnp
-
+def _make_attack_transform(alg, args):
+    """Adversarial-client simulation: build the ``update_transform`` hook the
+    simulator applies to the stacked client updates BEFORE the sanitizer and
+    any defense run (a real byzantine upload is corrupted at the client, not
+    inside the server's aggregation). Deterministic attacks only
+    (scale/sign_flip/nan) — the round step is traced once, so a gaussian
+    attacker would freeze to one noise draw; use the library API outside jit
+    for that threat model."""
     from ..core.security import FedMLAttacker
 
     attack_type = str(args.attack_type)
-    if attack_type not in ("scale", "sign_flip"):
+    if attack_type not in ("scale", "sign_flip", "nan"):
         raise ValueError(
-            f"simulator-injected attacks support scale/sign_flip, got "
+            f"simulator-injected attacks support scale/sign_flip/nan, got "
             f"'{attack_type}' (gaussian needs per-round rng; drive it via "
             f"core.security outside the compiled round)")
     if not getattr(alg, "update_is_params", True):
@@ -206,17 +215,11 @@ def _inject_attacker(alg, args):
         strength=float(getattr(args, "attack_strength", 1.0)),
         seed=int(getattr(args, "random_seed", 0)),
     )
-    base_agg = alg.aggregate
 
-    def attacked_aggregate(stacked_updates, weights):
-        attacked = atk.attack(stacked_updates, int(weights.shape[0]))
-        if base_agg is not None:
-            return base_agg(attacked, weights)
-        from ..core.algframe import weighted_mean
+    def attack_transform(stacked_updates, weights):
+        return atk.attack(stacked_updates, int(weights.shape[0]))
 
-        return weighted_mean(attacked, weights)
-
-    return _dc.replace(alg, aggregate=attacked_aggregate)
+    return attack_transform
 
 
 class SimulatorSingleProcess:

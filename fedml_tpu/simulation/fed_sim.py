@@ -137,6 +137,27 @@ class SimConfig:
     # stats scatter-added into per-client accumulators) — not a per-client
     # Python loop. Off by default: it roughly doubles eval cost.
     local_test_on_all_clients: bool = False
+    # --- self-healing round pipeline -----------------------------------
+    # update sanitizer (core/robust.sanitize_stacked): quarantine non-finite
+    # and norm-outlier client updates inside the compiled round step; the
+    # quarantine set lands in history[i]["quarantined"]. Forces the even
+    # cohort schedule (the defense needs the full stacked cohort).
+    sanitize_updates: bool = False
+    sanitize_z_thresh: float = 6.0
+    # divergence watchdog: > 0 arms it — a round whose train loss exceeds
+    # watchdog_factor x the median of the last watchdog_window accepted
+    # losses (or is non-finite, or produces non-finite params) is rolled
+    # back to the last-good state and re-run with the suspect clients
+    # excluded, at most max_rollbacks times per round. Watchdog mode
+    # implies the sanitizer (a re-run is only safe with poisoned rows
+    # zeroed) and runs rounds synchronously (no prefetch pipeline) — the
+    # verdict must land before the next round dispatches.
+    watchdog_factor: float = 0.0
+    watchdog_window: int = 5
+    max_rollbacks: int = 2
+    # exclusion threshold on the failed round's robust z-scores; clients at
+    # or above it are dropped from the re-run (fallback: the single worst)
+    rollback_z_thresh: float = 3.0
 
 
 @dataclasses.dataclass
@@ -196,6 +217,7 @@ class FedSimulator:
         server_tester=None,
         hook_args=None,
         profiler=None,
+        update_transform: Optional[Callable] = None,
     ):
         self.fed = fed_data
         self.alg = algorithm
@@ -233,6 +255,12 @@ class FedSimulator:
         # last round-completion stamp; drained into rec["phases"] by
         # _finalize_rec so the named phases + host_other sum to round_time
         self._phase_acc: List[Any] = []
+        # sanitizer readback: the last dispatched round's (2, C) device
+        # array of [quarantine flag, robust z] plus its cohort ids; drained
+        # into the round record by _defer_rec
+        self._last_qz = None
+        self._last_cohort_ids = None
+        self._finite_fn = None  # built lazily by the watchdog loop
 
         sizes = [len(v) for v in fed_data.train_data_local_dict.values()]
         if cfg.num_local_batches is None:
@@ -270,9 +298,16 @@ class FedSimulator:
         # per-client rectangle (SCAFFOLD state, DP-SGD per-example pass,
         # BatchNorm collection threading).
         self._packed_ctx = packed_ctx
+        # adversarial-update hook (simulation/__init__._make_attack_transform)
+        # plus the sanitizer both operate on the full stacked cohort, so they
+        # pin the even schedule (packed/bucketed never materialize the stack)
+        self._update_transform = update_transform
+        self._detect = bool(cfg.sanitize_updates or cfg.watchdog_factor > 0)
+        force_even = self._detect or update_transform is not None
         mean_agg = (
             algorithm.aggregate is None
             and getattr(algorithm, "update_is_params", True)
+            and not force_even
         )
         packed_ok = (
             packed_ctx is not None
@@ -285,6 +320,13 @@ class FedSimulator:
             and not packed_ctx[3]  # has_batch_stats
         )
         schedule = cfg.cohort_schedule
+        if force_even and schedule in ("packed", "bucketed"):
+            raise ValueError(
+                f"cohort_schedule='{schedule}' is incompatible with the "
+                "update sanitizer / watchdog / injected attacks — those "
+                "need the full stacked cohort (use 'even' or 'auto')")
+        if force_even:
+            schedule = "even"
         if schedule == "auto":
             counts = np.asarray(list(self._batch_counts.values()))
             skewed = counts.max() >= 2 * max(np.median(counts), 1)
@@ -310,16 +352,33 @@ class FedSimulator:
 
     def _build_round_step(self) -> Callable:
         alg = self.alg
+        transform = self._update_transform
+        detect = self._detect
+        z_thresh = float(self.cfg.sanitize_z_thresh)
 
         def round_body(params, server_state, cohort, client_states, rng):
             outs = _cohort_outputs(alg, params, cohort, client_states, rng)
+            update = outs.update
             w = outs.weight.astype(jnp.float32)
+            # adversarial corruption first, sanitizer second — the defense
+            # must see exactly what a byzantine client would upload
+            if transform is not None:
+                update = transform(update, w)
+            qz = None
+            if detect:
+                from ..core.robust import sanitize_stacked
+
+                update, w, quar, z = sanitize_stacked(update, w, z_thresh)
+                # one (2, C) row pair [quarantine flag, robust z] rides back
+                # with the metrics — a single extra host transfer per round
+                qz = jnp.stack([quar.astype(jnp.float32),
+                                jnp.nan_to_num(z, posinf=1e30)])
             if alg.aggregate is not None:
-                agg = alg.aggregate(outs.update, w)
+                agg = alg.aggregate(update, w)
             else:
                 from ..core.algframe import weighted_mean
 
-                agg = weighted_mean(outs.update, w)
+                agg = weighted_mean(update, w)
             new_params, new_server_state = alg.server_update(params, agg, server_state)
             # reduce metrics to ONE tiny vector inside the program: each
             # separate host read is a device round trip (expensive over a
@@ -331,6 +390,9 @@ class FedSimulator:
                 (m["train_correct"].sum()
                  / jnp.maximum(m["train_valid"].sum(), 1.0)).astype(jnp.float32),
             ])
+            if detect:
+                return (new_params, new_server_state, outs.state,
+                        metrics_vec, qz)
             return new_params, new_server_state, outs.state, metrics_vec
 
         if self._use_device_data:
@@ -350,10 +412,13 @@ class FedSimulator:
             mesh = self.mesh
             cohort_sh = shard_along(mesh, AXIS_CLIENT, 0)
             rep = replicated(mesh)
+            out_sh = (rep, rep, cohort_sh, rep)
+            if detect:
+                out_sh += (rep,)
             return jax.jit(
                 round_step,
                 in_shardings=(rep, rep, cohort_sh, cohort_sh, rep) + (rep,) * n_extra,
-                out_shardings=(rep, rep, cohort_sh, rep),
+                out_shardings=out_sh,
                 donate_argnums=(0, 1),
             )
         return jax.jit(round_step, donate_argnums=(0, 1))
@@ -614,6 +679,17 @@ class FedSimulator:
                 if log_fn:
                     log_fn(f"[resume] from round {start_round} @ {cfg.checkpoint_dir}")
         rounds = range(start_round, cfg.comm_round)
+        if cfg.watchdog_factor > 0:
+            # self-healing mode: every round is synchronous (its watchdog
+            # verdict gates the next dispatch), so no prefetch pipeline and
+            # no deferred readback
+            self._last_round_end = time.perf_counter()
+            self._run_selfheal(rounds, base_rng, apply_fn, ckpt, log_fn)
+            jax.block_until_ready(self.params)
+            if ckpt is not None:
+                ckpt.close()
+            telemetry.flush()
+            return self.history
         if cfg.prefetch and len(rounds) > 0:
             from .prefetch import RoundPrefetcher
 
@@ -672,6 +748,125 @@ class FedSimulator:
         telemetry.flush()
         return self.history
 
+    def _run_selfheal(self, rounds, base_rng, apply_fn, ckpt, log_fn) -> None:
+        """Divergence watchdog + rollback round loop.
+
+        Each round runs synchronously; its train loss (computed from the
+        params the round STARTED from) is checked against
+        ``watchdog_factor x median(last watchdog_window accepted losses)``,
+        and the round's OUTPUT params against non-finiteness. On a verdict
+        of bad, the state is restored — to the last-good snapshot when the
+        start params are suspect (loss spike / non-finite loss), or to this
+        round's own start state when only the output is damaged — and the
+        round re-runs with the suspect clients (robust z >=
+        ``rollback_z_thresh`` on the failed attempt, else the single worst)
+        excluded, at most ``max_rollbacks`` times. A round whose metrics
+        validate its start params promotes that start state to last-good.
+
+        Snapshots COPY every leaf: the round step donates its params/server
+        -state buffers, so a bare reference would die at the next dispatch.
+        Host RNG needs no snapshot — every stream is round-indexed
+        (``build_round_inputs`` is pure in (seed, round)), so a re-run draws
+        identical randomness by construction.
+        """
+        cfg = self.cfg
+        reg = telemetry.get_registry()
+
+        def snap():
+            return (jax.tree.map(jnp.copy, self.params),
+                    jax.tree.map(jnp.copy, self.server_state),
+                    dict(self.client_states))
+
+        def restore(state):
+            params, server_state, client_states = state
+            # re-copy: the restored arrays get donated by the next dispatch,
+            # and the same snapshot may need restoring again later
+            self.params = jax.tree.map(jnp.copy, params)
+            self.server_state = jax.tree.map(jnp.copy, server_state)
+            self.client_states = dict(client_states)
+
+        if self._finite_fn is None:
+            self._finite_fn = jax.jit(
+                lambda p: jax.tree_util.tree_reduce(
+                    lambda a, x: jnp.logical_and(a, jnp.all(jnp.isfinite(x))),
+                    p, jnp.bool_(True)))
+        last_good = snap()
+        window: List[float] = []
+        for round_idx in rounds:
+            excluded: set = set()  # cohort positions, grows across retries
+            attempts = 0
+            t0 = time.perf_counter()
+            while True:
+                t_pack = time.perf_counter()
+                inputs = self.build_round_inputs(round_idx, exclude=excluded)
+                self._phase_acc.append(
+                    ("pack_wait", time.perf_counter() - t_pack))
+                start_state = snap()
+                step_rng = jax.random.fold_in(base_rng, round_idx)
+                t_disp = time.perf_counter()
+                with self._span("round_dispatch", str(round_idx)):
+                    metrics_vec = self._dispatch_even(inputs, step_rng)
+                self._phase_acc.append(
+                    ("dispatch", time.perf_counter() - t_disp))
+                mvec = np.asarray(metrics_vec)  # sync: verdict gates round
+                qz = np.asarray(self._last_qz)
+                loss = float(mvec[0])
+                spike = (len(window) > 0 and np.isfinite(loss)
+                         and loss > cfg.watchdog_factor * float(
+                             np.median(window)))
+                start_suspect = not np.isfinite(loss) or spike
+                bad = start_suspect or not bool(self._finite_fn(self.params))
+                if not bad or attempts >= cfg.max_rollbacks:
+                    if bad and log_fn:
+                        log_fn(f"[watchdog] round {round_idx}: still "
+                               f"degraded after {attempts} rollbacks — "
+                               f"accepting (loss={loss:.4g})")
+                    break
+                new_excl = {int(i) for i in np.nonzero(
+                    qz[1] >= cfg.rollback_z_thresh)[0]} - excluded
+                if not new_excl:
+                    z = qz[1].copy()
+                    if excluded:
+                        z[list(excluded)] = -np.inf
+                    cand = int(np.argmax(z))
+                    if np.isfinite(z[cand]) and cand not in excluded:
+                        new_excl = {cand}
+                if (not new_excl
+                        or len(excluded | new_excl) >= len(inputs.client_ids)):
+                    if log_fn:
+                        log_fn(f"[watchdog] round {round_idx}: diverged but "
+                               f"no (further) suspects to exclude — "
+                               f"accepting (loss={loss:.4g})")
+                    break
+                excluded |= new_excl
+                attempts += 1
+                restore(last_good if start_suspect else start_state)
+                if reg.enabled:
+                    reg.counter("fedml_rollbacks_total").inc()
+                if log_fn:
+                    ids = sorted(int(inputs.client_ids[p]) for p in excluded)
+                    log_fn(f"[watchdog] round {round_idx}: rollback "
+                           f"#{attempts} (loss={loss:.4g}, "
+                           f"{'start' if start_suspect else 'output'} "
+                           f"suspect) — re-running without clients {ids}")
+            rec = {
+                "round": round_idx,
+                "dispatch_time": time.perf_counter() - t0,
+                "_mvec": metrics_vec,
+                "_qz": self._last_qz,
+                "_cohort_ids": inputs.client_ids,
+                "rollbacks": attempts,
+            }
+            self._last_qz = self._last_cohort_ids = None
+            if excluded:
+                rec["_extra_quarantined"] = [
+                    int(inputs.client_ids[p]) for p in excluded]
+            if not bad:
+                last_good = start_state
+                window.append(loss)
+                del window[:-max(1, cfg.watchdog_window)]
+            self._finalize_rec(rec, apply_fn, ckpt, log_fn)
+
     def _span(self, name: str, value: Optional[str] = None):
         if self._profiler is not None:
             return self._profiler.span(name, event_value=value)
@@ -701,6 +896,10 @@ class FedSimulator:
         }
         if timing:
             rec.update(timing)
+        if self._last_qz is not None:
+            rec["_qz"] = self._last_qz
+            rec["_cohort_ids"] = self._last_cohort_ids
+            self._last_qz = self._last_cohort_ids = None
         if pending is not None:
             self._finalize_rec(pending, apply_fn, ckpt, log_fn)
         if (apply_fn is not None and self._should_eval(round_idx)) or (
@@ -736,6 +935,18 @@ class FedSimulator:
         self._last_round_end = now
         rec["train_loss"] = float(mvec[0])
         rec["train_acc"] = float(mvec[1])
+        if "_qz" in rec:
+            qz = np.asarray(rec.pop("_qz"))
+            ids = rec.pop("_cohort_ids")
+            quarantined = sorted(
+                {int(ids[i]) for i in np.nonzero(qz[0] > 0)[0]}
+                | set(rec.pop("_extra_quarantined", ())))
+            rec["quarantined"] = quarantined
+            if quarantined:
+                reg0 = telemetry.get_registry()
+                if reg0.enabled:
+                    reg0.counter("fedml_quarantined_total").inc(
+                        len(quarantined))
         # drain the interval accumulator: everything the host did between the
         # previous completion stamp and this one, keyed by phase; the
         # remainder (logging, bookkeeping, deferred eval of earlier rounds'
@@ -819,13 +1030,19 @@ class FedSimulator:
 
     # --- pure round-input builders (prefetchable host side) -----------------
 
-    def build_round_inputs(self, round_idx: int) -> RoundInputs:
+    def build_round_inputs(self, round_idx: int,
+                           exclude=None) -> RoundInputs:
         """The whole host side of one round as a pure function of
         ``(seed, round_idx)``: client sampling, drop mask, per-client
         shuffles, and the schedule's cohort tensors — every RNG stream is
         round-indexed, so the prefetch worker may run this ahead of the
         round loop and the result is bit-identical to inline packing.
-        Reads no mutable simulator state (params, client_states, history)."""
+        Reads no mutable simulator state (params, client_states, history).
+
+        ``exclude`` (watchdog rollback re-runs only): cohort POSITIONS whose
+        clients sit out this build — they are folded into the drop mask
+        after sampling, so the cohort itself (and every other client's RNG
+        stream) is unchanged vs the original run of the round."""
         cfg = self.cfg
         t0 = time.perf_counter()
         with self._span("host_pack", str(round_idx)):
@@ -844,6 +1061,10 @@ class FedSimulator:
                 drop = pack_rng.random(len(client_ids)) < cfg.client_dropout_rate
                 if drop.all():
                     drop[0] = False  # a round needs at least one survivor
+            if exclude:
+                excl = np.zeros(len(client_ids), bool)
+                excl[list(exclude)] = True
+                drop = excl if drop is None else (drop | excl)
             if self._packed:
                 kind = "packed"
                 payload = self._build_packed_inputs(client_ids, round_idx, drop)
@@ -886,9 +1107,14 @@ class FedSimulator:
         step_args = (self.params, self.server_state, cohort, states, step_rng)
         if self._use_device_data:
             step_args += (self._x_dev, self._y_dev)
-        self.params, self.server_state, new_states, metrics_vec = (
-            self._round_step(*step_args)
-        )
+        if self._detect:
+            (self.params, self.server_state, new_states, metrics_vec,
+             self._last_qz) = self._round_step(*step_args)
+            self._last_cohort_ids = inputs.client_ids
+        else:
+            self.params, self.server_state, new_states, metrics_vec = (
+                self._round_step(*step_args)
+            )
         self._store_states(inputs.client_ids, new_states)
         return metrics_vec
 

@@ -8,7 +8,12 @@ from fedml_tpu.core.robust import (
     RobustAggregator,
     coordinate_median,
     global_norm,
+    krum_aggregate,
+    krum_scores,
     norm_clip_update,
+    pairwise_sq_dists,
+    sanitize_stacked,
+    trimmed_mean,
 )
 from fedml_tpu.core.scheduler import balanced_client_schedule, dp_schedule, even_client_schedule
 from fedml_tpu.core.secure_agg import (
@@ -57,6 +62,147 @@ def test_robust_aggregator_weak_dp_noise_scale():
     agg = ra.aggregate(stacked, jnp.ones(8), rng=jax.random.PRNGKey(0))
     noise = np.asarray(agg["w"]) - 1.0
     assert 0.05 < noise.std() < 0.2
+
+
+def test_krum_scores_match_numpy_oracle():
+    """XLA Krum scores against a direct NumPy transcription of Blanchard et
+    al. 2017: score(i) = sum of the C-f-2 smallest ||u_i - u_j||^2, j != i."""
+    rng = np.random.default_rng(0)
+    updates = rng.normal(size=(7, 13)).astype(np.float32)
+    stacked = {"w": jnp.asarray(updates)}
+    f = 2
+    got = np.asarray(krum_scores(pairwise_sq_dists(stacked), f))
+    want = np.empty(7)
+    for i in range(7):
+        d = np.sort([np.sum((updates[i] - updates[j]) ** 2)
+                     for j in range(7) if j != i])
+        want[i] = d[: 7 - f - 2].sum()
+    np.testing.assert_allclose(got, want, rtol=1e-4)
+
+
+def test_krum_selects_honest_cluster():
+    """Classic Krum picks an update from the tight honest cluster, never a
+    far-flung byzantine one; multi-Krum averages exactly the m survivors."""
+    honest = [{"w": jnp.ones(6) * (1.0 + 0.01 * i)} for i in range(7)]
+    byz = [{"w": jnp.ones(6) * 100.0}, {"w": jnp.ones(6) * -80.0}]
+    stacked = _stack(honest + byz)
+    w = jnp.ones(9)
+    agg, selected = krum_aggregate(stacked, w, n_byz=2, m=1)
+    sel = np.nonzero(np.asarray(selected))[0]
+    assert len(sel) == 1 and sel[0] < 7, sel
+    assert 0.9 < float(np.asarray(agg["w"])[0]) < 1.1
+    agg_m, selected_m = krum_aggregate(stacked, w, n_byz=2, m=7)
+    sel_m = set(np.nonzero(np.asarray(selected_m))[0].tolist())
+    assert sel_m == set(range(7)), sel_m
+    np.testing.assert_allclose(
+        np.asarray(agg_m["w"]),
+        np.mean([1.0 + 0.01 * i for i in range(7)]), rtol=1e-5)
+
+
+def test_robust_aggregator_krum_family_defends():
+    """The three Krum-family defense_types all reject a NaN + scaled pair
+    of attackers; krum_fedavg weights survivors by sample count."""
+    honest = [{"w": jnp.ones(4) * v} for v in (0.9, 1.0, 1.0, 1.1, 1.05)]
+    attackers = [{"w": jnp.full(4, jnp.nan)}, {"w": jnp.ones(4) * 500.0}]
+    stacked = _stack(honest + attackers)
+    w = jnp.asarray([1.0, 2.0, 2.0, 1.0, 1.0, 5.0, 5.0])
+    for defense in ("krum", "multi_krum", "krum_fedavg"):
+        ra = RobustAggregator(defense_type=defense, sanitize=True,
+                              byzantine_n=2)
+        agg, info = ra.aggregate_with_info(stacked, w)
+        a = np.asarray(agg["w"])
+        assert np.isfinite(a).all(), (defense, a)
+        assert 0.85 <= a[0] <= 1.15, (defense, a)
+        assert np.asarray(info["quarantine"])[5], defense  # the NaN row
+    # sample weighting: survivors 0..4 with weights 1,2,2,1,1
+    ra = RobustAggregator(defense_type="krum_fedavg", sanitize=True,
+                          byzantine_n=2, multi_krum_m=5)
+    agg, info = ra.aggregate_with_info(stacked, w)
+    sel = np.asarray(info["selected"])[:5]
+    vals = np.array([0.9, 1.0, 1.0, 1.1, 1.05])
+    ws = np.array([1.0, 2.0, 2.0, 1.0, 1.0]) * sel
+    np.testing.assert_allclose(
+        np.asarray(agg["w"])[0], (vals * ws).sum() / ws.sum(), rtol=1e-5)
+
+
+def test_sanitize_quarantines_nonfinite_and_outliers():
+    honest = [{"w": jnp.ones(8) * v} for v in (0.9, 1.0, 1.1, 1.0, 0.95)]
+    rows = honest + [{"w": jnp.full(8, jnp.nan)}, {"w": jnp.ones(8) * 1e4}]
+    stacked = _stack(rows)
+    weights = jnp.ones(7)
+    clean, w, quar, z = sanitize_stacked(stacked, weights, z_thresh=6.0)
+    q = np.asarray(quar)
+    assert q.tolist() == [False] * 5 + [True, True]
+    # quarantined rows are ZEROED, not just zero-weighted (0 * nan == nan)
+    cw = np.asarray(clean["w"])
+    assert np.isfinite(cw).all()
+    np.testing.assert_allclose(cw[5], 0.0)
+    np.testing.assert_allclose(cw[6], 0.0)
+    np.testing.assert_allclose(np.asarray(w), [1] * 5 + [0, 0])
+    assert np.isinf(np.asarray(z)[5])  # non-finite rows pin z to +inf
+
+
+def test_sanitize_uniform_cohort_no_false_positives():
+    """Near-identical norms (fp jitter only) must not be flagged — the MAD
+    floor is relative to the median."""
+    rows = [{"w": jnp.ones(16) * (1.0 + 1e-7 * i)} for i in range(8)]
+    _, w, quar, _ = sanitize_stacked(_stack(rows), jnp.ones(8))
+    assert not np.asarray(quar).any()
+    np.testing.assert_allclose(np.asarray(w), 1.0)
+
+
+def test_weighted_trimmed_mean_matches_oracle():
+    x = np.array([[-50.0], [1.0], [2.0], [3.0], [60.0]], np.float32)
+    w = np.array([9.0, 1.0, 2.0, 3.0, 9.0], np.float32)
+    got = trimmed_mean({"v": jnp.asarray(x)}, trim_ratio=0.2,
+                       weights=jnp.asarray(w))
+    # k=1: extremes (and their heavy weights) trimmed; weighted mean of rest
+    want = (1.0 * 1 + 2.0 * 2 + 3.0 * 3) / (1 + 2 + 3)
+    np.testing.assert_allclose(np.asarray(got["v"])[0], want, rtol=1e-6)
+    # unweighted path unchanged: plain mean of the surviving slice
+    got_u = trimmed_mean({"v": jnp.asarray(x)}, trim_ratio=0.2)
+    np.testing.assert_allclose(np.asarray(got_u["v"])[0], 2.0, rtol=1e-6)
+
+
+def test_trimmed_mean_tiny_cohort_guard():
+    """n=2 with trim_ratio=0.5 would trim everything without the
+    k <= (n-1)//2 guard; the slice must stay non-empty."""
+    x = jnp.asarray([[1.0], [3.0]])
+    got = trimmed_mean({"v": x}, trim_ratio=0.5)
+    assert np.isfinite(np.asarray(got["v"])).all()
+    np.testing.assert_allclose(np.asarray(got["v"])[0], 2.0)
+
+
+def test_cross_silo_weak_dp_rng_fresh_per_round():
+    """The cross-silo aggregator used to call the weak_dp defense without an
+    rng (ValueError on round 0); now it folds a per-aggregation key from the
+    run seed, so noise is fresh every round and seeded-reproducible."""
+    from types import SimpleNamespace
+
+    from fedml_tpu.cross_silo.aggregator import FedMLAggregator
+
+    def build():
+        args = SimpleNamespace(defense_type="weak_dp", norm_bound=100.0,
+                               stddev=0.1, random_seed=0)
+        return FedMLAggregator(
+            None, None, 16, 2, args, {"w": jnp.zeros(400, jnp.float32)})
+
+    agg = build()
+    delta = {"w": np.ones(400, np.float32)}
+    agg.add_local_trained_result(0, delta, 8)
+    agg.add_local_trained_result(1, delta, 8)
+    p1 = np.asarray(agg.aggregate()["w"])
+    agg.add_local_trained_result(0, delta, 8)
+    agg.add_local_trained_result(1, delta, 8)
+    p2 = np.asarray(agg.aggregate()["w"])
+    n1, n2 = p1 - 1.0, (p2 - p1) - 1.0
+    assert 0.05 < n1.std() < 0.2, n1.std()
+    assert not np.allclose(n1, n2)  # fresh key per round
+    # seeded determinism: a rebuilt aggregator replays the same noise
+    agg_b = build()
+    agg_b.add_local_trained_result(0, delta, 8)
+    agg_b.add_local_trained_result(1, delta, 8)
+    np.testing.assert_array_equal(p1, np.asarray(agg_b.aggregate()["w"]))
 
 
 def test_lagrange_interpolation_identity():

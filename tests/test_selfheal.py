@@ -1,0 +1,200 @@
+"""Self-healing rounds: seeded byzantine drills end to end.
+
+Acceptance drills for PR 4: a cohort with 30% NaN or 10×-scaled uploads must
+converge within 2% of the clean run's eval under multi-Krum + quarantine,
+while the undefended run visibly diverges; the divergence watchdog must
+detect a poisoned round, roll the global state back, and re-run without the
+implicated clients — in both the simulation engine and the cross-silo
+deployment (where the corruption enters through the comm-plane fault
+injector, not the aggregation path).
+"""
+
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.comm.resilience import FaultPlan, corrupt_update_tree
+from fedml_tpu.core import telemetry
+from fedml_tpu.simulation import build_simulator
+
+
+def _run(**kw):
+    cfg = dict(
+        dataset="digits", model="lr", partition_method="homo",
+        client_num_in_total=10, client_num_per_round=10, comm_round=12,
+        learning_rate=0.3, epochs=1, batch_size=32,
+        frequency_of_the_test=11, random_seed=0,
+    )
+    cfg.update(kw)
+    args = fedml_tpu.init(config=cfg)
+    sim, apply_fn = build_simulator(args)
+    return sim.run(apply_fn, log_fn=None)
+
+
+# --- simulator drills --------------------------------------------------------
+
+
+def test_nan_drill_defended_matches_clean_undefended_diverges():
+    """30% all-NaN uploads: multi-Krum + sanitizer stays within 2% of the
+    clean run and quarantines every attacker; undefended FedAvg goes
+    non-finite and collapses to chance accuracy."""
+    clean = _run()
+    defended = _run(
+        attack_type="nan", attacker_ratio=0.3,
+        federated_optimizer="FedAvg_robust", defense_type="multi_krum",
+        sanitize_updates=True)
+    undefended = _run(attack_type="nan", attacker_ratio=0.3)
+
+    assert defended[-1]["test_acc"] >= clean[-1]["test_acc"] - 0.02, (
+        clean[-1]["test_acc"], defended[-1]["test_acc"])
+    # the 3 seeded attackers are caught every round (same seed -> same mask)
+    assert all(len(h["quarantined"]) == 3 for h in defended)
+    assert np.isfinite(defended[-1]["train_loss"])
+    assert not np.isfinite(undefended[-1]["train_loss"])
+    assert undefended[-1]["test_acc"] < clean[-1]["test_acc"] - 0.1
+
+
+def test_scale_drill_defended_matches_clean():
+    """30% 10×-boosted uploads (model replacement): defended run within 2%
+    of clean; undefended run measurably degraded."""
+    clean = _run()
+    defended = _run(
+        attack_type="scale", attacker_ratio=0.3, attack_boost=10.0,
+        federated_optimizer="FedAvg_robust", defense_type="multi_krum",
+        sanitize_updates=True)
+    undefended = _run(attack_type="scale", attacker_ratio=0.3,
+                      attack_boost=10.0)
+
+    assert defended[-1]["test_acc"] >= clean[-1]["test_acc"] - 0.02, (
+        clean[-1]["test_acc"], defended[-1]["test_acc"])
+    assert defended[-1]["test_acc"] > undefended[-1]["test_acc"] + 0.1, (
+        undefended[-1]["test_acc"], defended[-1]["test_acc"])
+
+
+def test_watchdog_rollback_simulator():
+    """With the in-step sanitizer's threshold suppressed, only the loss
+    watchdog can catch a 50×-boosted cohort: it must roll back, re-run
+    without the implicated clients, and keep the run finite."""
+    hist = _run(
+        attack_type="scale", attacker_ratio=0.2, attack_boost=50.0,
+        comm_round=8, watchdog_factor=1.5, watchdog_window=3,
+        max_rollbacks=3, sanitize_z_thresh=1e6, rollback_z_thresh=3.0)
+
+    assert any(h["rollbacks"] > 0 for h in hist)
+    for h in hist:
+        if h["rollbacks"]:
+            # a rolled-back round re-ran with the excluded clients recorded
+            assert h["quarantined"], h
+        assert np.isfinite(h["train_loss"]), h
+    assert np.isfinite(hist[-1]["test_acc"])
+
+
+def test_defenses_disabled_history_unchanged():
+    """No defense knobs -> no self-healing keys in the round history (the
+    disabled path must stay byte-identical to a plain run)."""
+    hist = _run(comm_round=4)
+    for h in hist:
+        assert "quarantined" not in h and "rollbacks" not in h, h
+
+
+# --- deterministic corruption plumbing ---------------------------------------
+
+
+def test_corrupt_update_tree_kinds_and_determinism():
+    tree = {"w": np.ones((3, 4), np.float32), "n": np.arange(3)}
+    scaled = corrupt_update_tree(tree, "scale", scale=5.0)
+    np.testing.assert_allclose(scaled["w"], 5.0)
+    flipped = corrupt_update_tree(tree, "sign_flip")
+    np.testing.assert_allclose(flipped["w"], -1.0)
+    nanned = corrupt_update_tree(tree, "nan")
+    assert np.isnan(nanned["w"]).all()
+    # integer leaves cannot hold NaN — they pass through
+    np.testing.assert_array_equal(np.asarray(nanned["n"]), np.arange(3))
+    g1 = corrupt_update_tree(tree, "gauss", std=1.0, seed=3, token="2:5")
+    g2 = corrupt_update_tree(tree, "gauss", std=1.0, seed=3, token="2:5")
+    g3 = corrupt_update_tree(tree, "gauss", std=1.0, seed=3, token="2:6")
+    np.testing.assert_array_equal(np.asarray(g1["w"]), np.asarray(g2["w"]))
+    assert not np.allclose(np.asarray(g1["w"]), np.asarray(g3["w"]))
+    with pytest.raises(ValueError):
+        corrupt_update_tree(tree, "label_flip")
+
+
+def test_fault_plan_byzantine_config_and_scoping():
+    class A:
+        fault_seed = 11
+        fault_byzantine_kind = "scale"
+        fault_byzantine_ranks = [2, 3]
+        fault_byzantine_rounds = [1, 3]
+
+    plan = FaultPlan.from_args(A())
+    assert plan is not None and plan.active
+    assert plan.byzantine_ranks == frozenset({2, 3})
+
+    from fedml_tpu.comm import Message
+
+    def upload(sender, rnd):
+        m = Message(3, sender, 0)
+        m.add_params("round_idx", rnd)
+        return m
+
+    assert not plan.should_corrupt(upload(2, 0))   # before the window
+    assert plan.should_corrupt(upload(2, 1))
+    assert plan.should_corrupt(upload(3, 2))
+    assert not plan.should_corrupt(upload(2, 3))   # window is [start, stop)
+    assert not plan.should_corrupt(upload(1, 1))   # not a byzantine rank
+    with pytest.raises(ValueError):
+        FaultPlan(byzantine_kind="bogus")
+
+
+# --- cross-silo drills (comm-plane corruption, real round FSM) ---------------
+
+
+@pytest.fixture()
+def _telemetry_on():
+    telemetry.configure(enabled=True, reset=True)
+    yield
+    telemetry.configure(enabled=True, reset=True)
+
+
+@pytest.mark.chaos
+def test_cross_silo_byzantine_nan_drill(_telemetry_on):
+    """A silo uploading NaN deltas every round: the sanitizer quarantines it
+    in-step, the run closes every round, and the global model stays finite."""
+    from fedml_tpu.cross_silo.chaos import run_chaos_drill
+
+    r = run_chaos_drill(
+        fault_byzantine_kind="nan", fault_byzantine_ranks=[2],
+        sanitize_updates=True, fault_drop_rate=0.0,
+        local_test_on_all_clients=True, comm_round=3,
+        client_num_in_total=4, client_num_per_round=4)
+    assert r.ok, r.summary()
+    assert r.quarantined >= 3, r.summary()
+    assert r.rollbacks == 0, r.summary()
+    for h in r.history:
+        assert h["quarantined"] == [2], h
+        assert np.isfinite(h["local_train_loss"]), h
+
+
+@pytest.mark.chaos
+def test_cross_silo_watchdog_rollback(_telemetry_on):
+    """Clean rounds build the loss baseline; a 1000×-scaled silo appears at
+    round 3 with the in-step sanitizer threshold suppressed — the watchdog
+    must spike-detect, restore the pre-aggregate params, and re-run the round
+    without that silo."""
+    from fedml_tpu.cross_silo.chaos import run_chaos_drill
+
+    r = run_chaos_drill(
+        fault_byzantine_kind="scale", fault_byzantine_scale=1000.0,
+        fault_byzantine_ranks=[2], fault_byzantine_rounds=[3, 5],
+        watchdog_factor=1.5, sanitize_z_thresh=1e6, rollback_z_thresh=3.0,
+        max_rollbacks=2, fault_drop_rate=0.0, comm_round=5,
+        client_num_in_total=4, client_num_per_round=4,
+        local_test_on_all_clients=True, round_timeout=5.0)
+    assert r.ok, r.summary()
+    assert r.rollbacks >= 1, r.summary()
+    by_round = {h["round"]: h for h in r.history}
+    assert by_round[3]["rollbacks"] >= 1 and by_round[3]["quarantined"] == [2]
+    for h in r.history:
+        assert np.isfinite(h["local_train_loss"]), h
+    # the healed rounds keep converging instead of absorbing the 1000x update
+    assert by_round[4]["local_train_loss"] < by_round[0]["local_train_loss"]
