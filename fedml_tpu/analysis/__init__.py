@@ -1,0 +1,26 @@
+"""graftcheck: fedml_tpu's first-party static-analysis suite.
+
+Five AST checkers over one shared parse of the package, with per-line
+suppressions and a committed baseline (see docs/static_analysis.md):
+
+- ``jit-purity`` — impure calls reachable from jit/pjit/shard_map/lax bodies
+- ``determinism`` — unseeded RNGs, time-derived seeds, set-order leaks
+- ``lock-order`` — lock acquisition cycles + blocking work under locks
+- ``config-drift`` — conflicting config defaults + doc/code drift
+- ``no-print`` — bare print() in library code
+
+Entry points: ``python -m fedml_tpu.cli analyze`` and ``scripts/graftcheck.py``.
+"""
+
+from .core import (  # noqa: F401
+    Checker,
+    Context,
+    Finding,
+    Module,
+    apply_baseline,
+    checker_registry,
+    load_baseline,
+    main,
+    run_checkers,
+    write_baseline,
+)

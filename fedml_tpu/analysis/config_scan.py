@@ -1,0 +1,121 @@
+"""AST config-key scanner: the one source of truth for which config keys
+the code reads, with what defaults, where.
+
+Replaces the regex scan that used to live in ``scripts/gen_config_reference.py``
+(which missed multi-line ``getattr`` calls and matched keys inside strings
+and comments). Both the generated ``docs/config_reference.md`` and the
+``config-drift`` checker consume this module, so the doc and the drift
+findings can never disagree about what "the code reads" means.
+
+Recognised read sites, mirroring the old regex surface:
+
+- ``getattr(args, "key"[, default])`` / ``getattr(self.args, "key"[, default])``
+- bare ``args.key`` / ``self.args.key`` attribute reads (lowercase keys only;
+  ``to_dict``/``get``/``set_attr_from_config`` are Arguments API, not keys)
+
+Defaults are recorded as normalised source text (``ast.unparse``). A default
+that is itself a ``getattr(args, ...)`` fallback chain credits the inner key
+too (``ast.walk`` visits nested calls on its own).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+# Arguments-internal surface, not config keys
+SKIP_ATTRS = {"to_dict", "set_attr_from_config", "get"}
+_KEY_RE = re.compile(r"^[a-z_][a-z0-9_]*$")
+
+
+@dataclass
+class KeyRead:
+    key: str
+    relpath: str
+    line: int
+    default: Optional[str] = None  # normalised source text, None = bare read
+    # True when this getattr sits in the DEFAULT position of another
+    # getattr (a fallback chain): its default belongs to the chain, and
+    # must not be treated as this key's own default
+    chained: bool = False
+
+
+@dataclass
+class KeyRecord:
+    defaults: Set[str] = field(default_factory=set)
+    sites: Set[str] = field(default_factory=set)
+    reads: List[KeyRead] = field(default_factory=list)
+
+
+def _is_args_expr(node: ast.AST) -> bool:
+    """True for the expressions that denote the flat Arguments bag:
+    ``args`` and ``self.args`` (matching the old regex's reach)."""
+    if isinstance(node, ast.Name) and node.id == "args":
+        return True
+    return (isinstance(node, ast.Attribute) and node.attr == "args"
+            and isinstance(node.value, ast.Name) and node.value.id == "self")
+
+
+def _is_key_getattr(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id == "getattr" and len(node.args) >= 2
+            and _is_args_expr(node.args[0])
+            and isinstance(node.args[1], ast.Constant)
+            and isinstance(node.args[1].value, str))
+
+
+def scan_tree(tree: ast.AST, relpath: str) -> List[KeyRead]:
+    # nodes living inside the default position of some getattr(args, ...)
+    in_default: set = set()
+    for node in ast.walk(tree):
+        if _is_key_getattr(node) and len(node.args) >= 3:
+            for sub in ast.walk(node.args[2]):
+                in_default.add(id(sub))
+    reads: List[KeyRead] = []
+    for node in ast.walk(tree):
+        if _is_key_getattr(node):
+            key = node.args[1].value
+            default = None
+            if len(node.args) >= 3:
+                default = " ".join(ast.unparse(node.args[2]).split())
+            reads.append(KeyRead(key=key, relpath=relpath,
+                                 line=node.lineno, default=default,
+                                 chained=id(node) in in_default))
+        elif isinstance(node, ast.Attribute) and _is_args_expr(node.value):
+            key = node.attr
+            if key in SKIP_ATTRS or not _KEY_RE.match(key):
+                continue
+            reads.append(KeyRead(key=key, relpath=relpath, line=node.lineno))
+    return reads
+
+
+def scan_file(path: str, relpath: str) -> List[KeyRead]:
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    return scan_tree(tree, relpath)
+
+
+def scan_package(package_dir: str, repo_root: str) -> Dict[str, KeyRecord]:
+    """key -> KeyRecord over every .py file under ``package_dir``."""
+    records: Dict[str, KeyRecord] = {}
+    for dirpath, dirnames, filenames in os.walk(package_dir):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            relpath = os.path.relpath(path, repo_root).replace(os.sep, "/")
+            for read in scan_file(path, relpath):
+                merge_read(records, read)
+    return records
+
+
+def merge_read(records: Dict[str, KeyRecord], read: KeyRead) -> None:
+    rec = records.setdefault(read.key, KeyRecord())
+    rec.sites.add(read.relpath)
+    rec.reads.append(read)
+    if read.default is not None:
+        rec.defaults.add(read.default)

@@ -1,0 +1,351 @@
+"""graftcheck core: shared driver for fedml_tpu's first-party static checkers.
+
+The repo's correctness story (bit-exact replay, deterministic ``FaultPlan``
+drills, byte-identical disabled paths) depends on invariants no runtime test
+can see locally: purity of jit-traced code, explicit RNG seeding, a consistent
+lock-nesting order, and one source of truth for config keys. This module is
+the machinery the individual checkers (``jit_purity``, ``determinism``,
+``lock_order``, ``config_drift``, ``no_print``) plug into:
+
+- each ``.py`` file is parsed ONCE into a :class:`Module` (source, AST,
+  per-line suppressions) and handed to every registered checker;
+- checkers yield :class:`Finding` objects (checker id, file:line, severity,
+  message, and a line-independent ``key`` used for baselining);
+- ``# graftcheck: disable=<id>[,<id>...]`` on the flagged line suppresses a
+  finding; ``disable=all`` suppresses every checker for that line;
+- a committed baseline file (JSON list of fingerprints, one per line —
+  ``scripts/graftcheck_baseline.json``) grandfathers known findings so the
+  suite can be adopted incrementally while new violations still fail.
+
+Entry points: ``python -m fedml_tpu.cli analyze`` and ``scripts/graftcheck.py``
+both call :func:`main`; ``tests/test_static_analysis.py`` enforces a clean
+run as a tier-1 check. See docs/static_analysis.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import io
+import json
+import os
+import re
+import sys
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+
+# matches both a dedicated comment and a trailing clause inside a longer
+# one ("# client role; graftcheck: disable=config-drift")
+_SUPPRESS_RE = re.compile(r"graftcheck:\s*disable=([a-z\-*]+(?:\s*,\s*[a-z\-*]+)*)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One checker hit. ``key`` is the line-number-free identity used for
+    baselining, so unrelated edits above a grandfathered site don't churn
+    the baseline file."""
+
+    checker: str
+    path: str  # repo-relative, '/'-separated
+    line: int
+    message: str
+    key: str
+    severity: str = SEVERITY_ERROR
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.checker}:{self.path}:{self.key}"
+
+    def to_dict(self) -> dict:
+        return {
+            "checker": self.checker,
+            "path": self.path,
+            "line": self.line,
+            "severity": self.severity,
+            "message": self.message,
+            "fingerprint": self.fingerprint,
+        }
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: [{self.checker}] "
+                f"{self.severity}: {self.message}")
+
+
+@dataclass
+class Module:
+    """One parsed source file, shared by all checkers."""
+
+    path: str          # absolute
+    relpath: str       # repo-relative, '/'-separated
+    source: str
+    tree: ast.AST
+    lines: List[str] = field(default_factory=list)
+    # line -> ids disabled on that line ('*' disables all)
+    suppressions: Dict[int, Set[str]] = field(default_factory=dict)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+def _parse_suppressions(source: str) -> Dict[int, Set[str]]:
+    """A trailing comment suppresses its own line; a standalone comment line
+    (nothing but the comment) suppresses the line that follows it — for
+    sites too long to carry the directive inline."""
+    out: Dict[int, Set[str]] = {}
+    lines = source.splitlines()
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if not m:
+                continue
+            ids = {s.strip() for s in m.group(1).split(",") if s.strip()}
+            if "all" in ids:
+                ids = {"*"}
+            lineno, col = tok.start
+            standalone = lineno <= len(lines) and not lines[lineno - 1][:col].strip()
+            out.setdefault(lineno + 1 if standalone else lineno, set()).update(ids)
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+def load_module(path: str, repo_root: str) -> Module:
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    relpath = os.path.relpath(path, repo_root).replace(os.sep, "/")
+    tree = ast.parse(source, filename=path)
+    return Module(
+        path=path, relpath=relpath, source=source, tree=tree,
+        lines=source.splitlines(),
+        suppressions=_parse_suppressions(source),
+    )
+
+
+def iter_source_files(root: str) -> List[str]:
+    """All .py files under ``root`` (or ``root`` itself), deterministically
+    ordered so finding output and fingerprint collisions are stable."""
+    if os.path.isfile(root):
+        return [root]
+    paths = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                paths.append(os.path.join(dirpath, fn))
+    return paths
+
+
+@dataclass
+class Context:
+    """Paths a checker may need beyond the per-file AST (e.g. config-drift
+    cross-references docs/config_reference.md)."""
+
+    repo_root: str
+    package_dir: str
+
+
+class Checker:
+    """Base class. Subclasses set ``id``/``description``, implement
+    ``visit_module`` (per-file findings) and optionally ``finalize``
+    (cross-file findings, run after every module was visited)."""
+
+    id: str = ""
+    description: str = ""
+
+    def __init__(self, ctx: Context):
+        self.ctx = ctx
+
+    def interested(self, relpath: str) -> bool:
+        return True
+
+    def visit_module(self, module: Module) -> Iterable[Finding]:
+        return ()
+
+    def finalize(self) -> Iterable[Finding]:
+        return ()
+
+
+def _suppressed(finding: Finding, modules: Dict[str, Module]) -> bool:
+    mod = modules.get(finding.path)
+    if mod is None:
+        return False
+    ids = mod.suppressions.get(finding.line, ())
+    return bool(ids) and ("*" in ids or finding.checker in ids)
+
+
+def run_checkers(
+    checker_classes: Sequence[type],
+    package_dir: str,
+    repo_root: str,
+) -> List[Finding]:
+    """Parse every file once, feed all checkers, drop suppressed findings.
+
+    Returns findings sorted by (path, line, checker) — baseline filtering is
+    the caller's concern (see :func:`apply_baseline`)."""
+    ctx = Context(repo_root=repo_root, package_dir=package_dir)
+    modules = [load_module(p, repo_root) for p in iter_source_files(package_dir)]
+    by_rel = {m.relpath: m for m in modules}
+    findings: List[Finding] = []
+    for cls in checker_classes:
+        checker = cls(ctx)
+        for mod in modules:
+            if not checker.interested(mod.relpath):
+                continue
+            findings.extend(checker.visit_module(mod))
+        findings.extend(checker.finalize())
+    findings = [f for f in findings if not _suppressed(f, by_rel)]
+    return sorted(findings, key=lambda f: (f.path, f.line, f.checker, f.key))
+
+
+# ---------------------------------------------------------------- baseline
+
+def load_baseline(path: str) -> List[str]:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, list):
+        raise ValueError(f"baseline {path} must be a JSON list of fingerprints")
+    return [str(x) for x in data]
+
+
+def write_baseline(findings: Sequence[Finding], path: str) -> None:
+    """One fingerprint per line so review diffs (and deliberate deletions)
+    stay line-oriented."""
+    fps = sorted({f.fingerprint for f in findings})
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("[\n")
+        f.write(",\n".join(json.dumps(fp) for fp in fps))
+        f.write("\n]\n" if fps else "]\n")
+
+
+def apply_baseline(
+    findings: Sequence[Finding], baseline: Sequence[str],
+) -> Tuple[List[Finding], List[Finding], List[str]]:
+    """Split findings into (new, grandfathered); also return baseline
+    entries that no longer match anything (stale — safe to delete)."""
+    base = set(baseline)
+    new = [f for f in findings if f.fingerprint not in base]
+    old = [f for f in findings if f.fingerprint in base]
+    live = {f.fingerprint for f in findings}
+    stale = sorted(fp for fp in base if fp not in live)
+    return new, old, stale
+
+
+# ---------------------------------------------------------------- frontend
+
+def default_repo_root() -> str:
+    # fedml_tpu/analysis/core.py -> repo root is three levels up
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def default_baseline_path(repo_root: str) -> str:
+    return os.path.join(repo_root, "scripts", "graftcheck_baseline.json")
+
+
+def checker_registry() -> Dict[str, type]:
+    """Imported lazily so ``core`` stays importable from the checkers."""
+    from . import config_drift, determinism, jit_purity, lock_order, no_print
+
+    checkers = (
+        jit_purity.JitPurityChecker,
+        determinism.DeterminismChecker,
+        lock_order.LockOrderChecker,
+        config_drift.ConfigDriftChecker,
+        no_print.NoPrintChecker,
+    )
+    return {c.id: c for c in checkers}
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    registry = checker_registry()
+    parser = argparse.ArgumentParser(
+        prog="graftcheck",
+        description="fedml_tpu static-analysis suite (see docs/static_analysis.md)",
+    )
+    parser.add_argument(
+        "--checker", action="append", default=None, choices=sorted(registry),
+        help="run only this checker (repeatable; default: all)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit findings as JSON on stdout")
+    parser.add_argument("--baseline", default=None, metavar="PATH",
+                        help="baseline file (default: scripts/graftcheck_baseline.json)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="ignore the baseline: report every finding as new")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="rewrite the baseline from the current findings and exit 0")
+    parser.add_argument("--root", default=None, metavar="DIR",
+                        help="scan this directory/file instead of fedml_tpu/")
+    ns = parser.parse_args(argv)
+
+    repo_root = default_repo_root()
+    package_dir = ns.root or os.path.join(repo_root, "fedml_tpu")
+    baseline_path = ns.baseline or default_baseline_path(repo_root)
+    ids = ns.checker or sorted(registry)
+    findings = run_checkers([registry[i] for i in ids], package_dir, repo_root)
+
+    if ns.write_baseline:
+        write_baseline(findings, baseline_path)
+        sys.stderr.write(
+            f"graftcheck: wrote {len({f.fingerprint for f in findings})} "
+            f"fingerprint(s) to {baseline_path}\n")
+        return 0
+
+    baseline = [] if ns.no_baseline else load_baseline(baseline_path)
+    new, grandfathered, stale = apply_baseline(findings, baseline)
+
+    if ns.as_json:
+        json.dump({
+            "checkers": ids,
+            "new": [f.to_dict() for f in new],
+            "grandfathered": [f.to_dict() for f in grandfathered],
+            "stale_baseline_entries": stale,
+        }, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+        return 1 if new else 0
+
+    for f in new:
+        sys.stdout.write(f.render() + "\n")
+    summary = (f"graftcheck: {len(new)} new finding(s), "
+               f"{len(grandfathered)} baselined, {len(stale)} stale baseline entr(y/ies) "
+               f"[checkers: {', '.join(ids)}]")
+    sys.stdout.write(summary + "\n")
+    if stale:
+        for fp in stale:
+            sys.stdout.write(f"  stale baseline entry (delete it): {fp}\n")
+    return 1 if new else 0
+
+
+# ------------------------------------------------------------- AST helpers
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def qualname_of(stack: Sequence[ast.AST]) -> str:
+    """Dotted qualname from an enclosing-scope stack of ClassDef/FunctionDef."""
+    parts = []
+    for node in stack:
+        name = getattr(node, "name", None)
+        if name:
+            parts.append(name)
+        elif isinstance(node, ast.Lambda):
+            parts.append("<lambda>")
+    return ".".join(parts) or "<module>"

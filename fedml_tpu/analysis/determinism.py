@@ -1,0 +1,140 @@
+"""determinism checker: unseeded RNGs, time-derived seeds, set-order leaks.
+
+The framework's replay guarantees (client sampling parity with the
+reference, ``FaultPlan`` drills keyed by sha256(seed, edge, seq),
+prefetch bit-exactness, checkpoint-resume equality) all assume every
+random draw is explicitly seeded and every ordering that feeds hashing,
+packing, or cohort selection is stable. Three leak classes are flagged
+anywhere in ``fedml_tpu/``:
+
+- **unseeded construction** — ``np.random.default_rng()`` /
+  ``np.random.RandomState()`` / ``random.Random()`` with no seed argument
+  draws OS entropy: two replays of the same config diverge silently;
+- **time-derived seeds** — a seed expression containing ``time.*``,
+  ``datetime.*``, ``os.urandom`` or ``uuid.*`` defeats the point of
+  seeding while still looking seeded in review;
+- **set-order dependence** — iterating a ``set``/``frozenset``
+  expression (or materialising one via ``list()``/``tuple()``/
+  ``enumerate()``/``.join()``) leaks Python's per-process hash ordering
+  into downstream packing/hashing; wrap in ``sorted(...)``.
+
+Only syntactic set expressions are flagged (``set(...)`` calls, set
+literals/comprehensions) — attribute lookups of unknown type are left
+alone to keep the signal high.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from .core import SEVERITY_WARNING, Checker, Finding, Module, dotted_name
+
+# constructors whose first positional / ``seed=`` argument seeds the stream
+RNG_CONSTRUCTORS = {
+    "default_rng", "RandomState", "Random", "SeedSequence", "PRNGKey", "key",
+}
+TIME_SOURCES = ("time.", "datetime.", "os.urandom", "uuid.")
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id in ("set", "frozenset"):
+        return True
+    if isinstance(node, ast.BinOp) and isinstance(
+            node.op, (ast.BitOr, ast.BitAnd, ast.Sub, ast.BitXor)):
+        # set algebra stays a set: a | b is only flagged when an operand is
+        # itself syntactically a set
+        return _is_set_expr(node.left) or _is_set_expr(node.right)
+    return False
+
+
+def _seed_args(call: ast.Call) -> List[ast.AST]:
+    seeds = list(call.args)
+    seeds.extend(kw.value for kw in call.keywords if kw.arg == "seed")
+    return seeds
+
+
+def _contains_time_source(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            name = dotted_name(sub.func) or ""
+            if name.startswith(TIME_SOURCES) or name in (
+                    "urandom", "uuid4", "uuid1", "getrandbits"):
+                return True
+    return False
+
+
+class DeterminismChecker(Checker):
+    id = "determinism"
+    description = ("unseeded RNG construction, time-derived seeds, and "
+                   "set-iteration order leaks")
+
+    def visit_module(self, module: Module) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        counters: Set[str] = set()
+        qualnames = _qualname_index(module.tree)
+
+        def add(node: ast.AST, kind: str, message: str, severity: str = "error"):
+            qual = qualnames.get(id(node), "<module>")
+            key = f"{qual}:{kind}"
+            if key in counters:
+                return
+            counters.add(key)
+            findings.append(Finding(
+                checker=self.id, path=module.relpath,
+                line=getattr(node, "lineno", 1),
+                message=message, key=key, severity=severity))
+
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call):
+                fname = dotted_name(node.func) or ""
+                simple = fname.split(".")[-1]
+                if simple in RNG_CONSTRUCTORS:
+                    seeds = _seed_args(node)
+                    if not seeds and simple in ("default_rng", "RandomState", "Random"):
+                        add(node, f"unseeded:{simple}",
+                            f"unseeded RNG construction {fname}() — pass an "
+                            "explicit seed so replays are bit-identical")
+                    for s in seeds:
+                        if _contains_time_source(s):
+                            add(node, f"time-seed:{simple}",
+                                f"time/entropy-derived seed in {fname}(...) "
+                                "defeats replay determinism")
+            iter_expr = None
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                iter_expr = node.iter
+            elif isinstance(node, ast.comprehension):
+                iter_expr = node.iter
+            elif isinstance(node, ast.Call):
+                fname = dotted_name(node.func) or ""
+                if isinstance(node.func, ast.Name) and \
+                        node.func.id in ("list", "tuple", "enumerate") and node.args:
+                    iter_expr = node.args[0] if _is_set_expr(node.args[0]) else None
+                elif isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == "join" and node.args:
+                    iter_expr = node.args[0] if _is_set_expr(node.args[0]) else None
+            if iter_expr is not None and _is_set_expr(iter_expr):
+                add(node, "set-order",
+                    "iteration over an unordered set feeds downstream "
+                    "ordering — wrap in sorted(...)",
+                    severity=SEVERITY_WARNING)
+        return findings
+
+
+def _qualname_index(tree: ast.AST) -> dict:
+    """id(node) -> enclosing function/class qualname, for stable finding keys."""
+    index: dict = {}
+
+    def walk(node: ast.AST, qual: str):
+        for child in ast.iter_child_nodes(node):
+            child_qual = qual
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+                child_qual = f"{qual}.{child.name}" if qual else child.name
+            index[id(child)] = child_qual or "<module>"
+            walk(child, child_qual)
+
+    walk(tree, "")
+    return index

@@ -1,0 +1,271 @@
+"""jit-purity checker: impure operations reachable from jit-traced code.
+
+A jitted function's Python body runs once at trace time; side effects and
+host reads (`time.time()`, stdlib/`np.random` draws, `print`, `.item()`
+host syncs, global mutation) either bake a trace-time constant into the
+compiled program or silently force a device sync — both break the
+framework's bit-exactness and replay guarantees without failing any test.
+
+Roots are found per module: functions decorated with ``jax.jit``/``pjit``/
+``shard_map``/``pmap`` (directly or via ``partial(jax.jit, ...)``),
+functions passed as arguments to those wrappers (``self._step =
+jax.jit(self._step_impl)``), and bodies handed to ``lax.scan``/
+``while_loop``/``fori_loop``/``cond``/``switch``. Reachability is a
+same-module call-graph walk: plain-name calls and ``self.method()`` calls
+resolve to same-scope/same-class function defs (conservatively by simple
+name). Nested defs inside a reachable function are scanned as part of it —
+inner helpers of a jit body are traced with it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import (
+    SEVERITY_WARNING,
+    Checker,
+    Finding,
+    Module,
+    dotted_name,
+)
+
+# wrapper callables whose function argument (or decorated function) is traced
+JIT_WRAPPERS = {"jit", "pjit", "pmap", "shard_map", "xmap"}
+# lax control-flow primitives whose callable arguments are traced
+TRACED_HOF = {"scan", "while_loop", "fori_loop", "cond", "switch", "associated_scan",
+              "associative_scan", "map", "checkpoint", "remat", "custom_vjp",
+              "custom_jvp", "vmap", "grad", "value_and_grad"}
+# lax.map/checkpoint etc. included: their callables are traced too. ``map``
+# only counts when called via an attribute chain (lax.map), never bare map().
+
+IMPURE_TIME = {"time.time", "time.time_ns", "time.perf_counter",
+               "time.perf_counter_ns", "time.monotonic", "time.sleep",
+               "datetime.now", "datetime.utcnow", "datetime.today"}
+
+
+def _is_ancestor(outer: ast.AST, inner: ast.AST) -> bool:
+    return any(n is inner for n in ast.walk(outer)) and outer is not inner
+
+
+def _walk_own_body(func_node: ast.AST):
+    """Walk a function body without descending into nested def/class scopes
+    (those are separate _FuncInfo entries, scanned on their own when
+    reachable). Lambdas stay in: they have no _FuncInfo of their own."""
+    stack = list(ast.iter_child_nodes(func_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class _FuncInfo:
+    __slots__ = ("node", "qualname", "simple", "cls", "is_root", "root_why")
+
+    def __init__(self, node: ast.AST, qualname: str, simple: str, cls: Optional[str]):
+        self.node = node
+        self.qualname = qualname
+        self.simple = simple
+        self.cls = cls
+        self.is_root = False
+        self.root_why = ""
+
+
+def _is_jit_wrapper(node: ast.AST) -> bool:
+    """True for expressions like ``jax.jit``, ``jit``, ``pjit``,
+    ``shard_map`` — or ``partial(jax.jit, ...)`` / a call of those."""
+    name = dotted_name(node)
+    if name is not None and name.split(".")[-1] in JIT_WRAPPERS:
+        return True
+    if isinstance(node, ast.Call):
+        fname = dotted_name(node.func)
+        if fname is not None:
+            last = fname.split(".")[-1]
+            if last in JIT_WRAPPERS:
+                return True  # jax.jit(donate_argnums=...) decorator factory
+            if last == "partial":
+                return any(_is_jit_wrapper(a) for a in node.args)
+    return False
+
+
+def _collect_functions(tree: ast.AST) -> List[_FuncInfo]:
+    funcs: List[_FuncInfo] = []
+
+    def walk(node: ast.AST, stack: List[str], cls: Optional[str]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = ".".join(stack + [child.name])
+                funcs.append(_FuncInfo(child, qual, child.name, cls))
+                walk(child, stack + [child.name], cls)
+            elif isinstance(child, ast.ClassDef):
+                walk(child, stack + [child.name], child.name)
+            else:
+                walk(child, stack, cls)
+
+    walk(tree, [], None)
+    return funcs
+
+
+class JitPurityChecker(Checker):
+    id = "jit-purity"
+    description = ("impure calls (time/random/print/host-sync/global mutation) "
+                   "reachable from jit/pjit/shard_map/lax-control-flow bodies")
+
+    def visit_module(self, module: Module) -> Iterable[Finding]:
+        funcs = _collect_functions(module.tree)
+        if not funcs:
+            return []
+        by_simple: Dict[str, List[_FuncInfo]] = {}
+        for f in funcs:
+            by_simple.setdefault(f.simple, []).append(f)
+
+        self._mark_roots(module.tree, funcs, by_simple)
+        reachable = self._reach(funcs, by_simple)
+        findings: List[Finding] = []
+        for info, why in reachable.items():
+            findings.extend(self._scan_body(module, info, why))
+        return findings
+
+    # ------------------------------------------------------------ roots
+
+    def _mark_roots(self, tree: ast.AST, funcs: List[_FuncInfo],
+                    by_simple: Dict[str, List[_FuncInfo]]) -> None:
+        def mark_target(expr: ast.AST, why: str, cls_hint: Optional[str] = None):
+            """Mark the function a wrapper argument refers to."""
+            if isinstance(expr, ast.Lambda):
+                return  # lambdas are scanned via enclosing function reachability
+            name = None
+            if isinstance(expr, ast.Name):
+                name = expr.id
+            elif isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+                    and expr.value.id == "self":
+                name = expr.attr
+            if name is None:
+                return
+            for cand in by_simple.get(name, ()):
+                if cls_hint is not None and cand.cls is not None and cand.cls != cls_hint:
+                    continue
+                if not cand.is_root:
+                    cand.is_root = True
+                    cand.root_why = why
+
+        # decorated defs
+        for f in funcs:
+            for deco in getattr(f.node, "decorator_list", ()):
+                if _is_jit_wrapper(deco):
+                    f.is_root = True
+                    f.root_why = f"decorated @{dotted_name(deco) or 'jit-wrapper'}"
+
+        # jit(f) / shard_map(f, ...) / lax.scan(body, ...) call sites
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func)
+            if fname is None:
+                continue
+            last = fname.split(".")[-1]
+            if last in JIT_WRAPPERS and node.args:
+                mark_target(node.args[0], f"wrapped by {fname}(...)")
+            elif last in TRACED_HOF and "." in fname and node.args:
+                # attribute-qualified only (lax.scan, jax.lax.cond, ...) so a
+                # user-defined bare scan()/map() never pulls its arg into scope
+                for arg in node.args:
+                    if isinstance(arg, (ast.Name, ast.Attribute)):
+                        mark_target(arg, f"traced body of {fname}(...)")
+                        break
+
+    # ------------------------------------------------------- reachability
+
+    def _reach(self, funcs: List[_FuncInfo],
+               by_simple: Dict[str, List[_FuncInfo]]) -> Dict[_FuncInfo, str]:
+        reachable: Dict[_FuncInfo, str] = {}
+        work = [f for f in funcs if f.is_root]
+        for f in work:
+            reachable[f] = f.root_why
+        nested_of: Dict[_FuncInfo, List[_FuncInfo]] = {}
+        for f in funcs:
+            for g in funcs:
+                if g is not f and _is_ancestor(f.node, g.node):
+                    nested_of.setdefault(f, []).append(g)
+        while work:
+            cur = work.pop()
+            why = reachable[cur]
+            # inner helpers defined inside a traced body are traced with it
+            for child in nested_of.get(cur, ()):
+                if child not in reachable:
+                    reachable[child] = f"defined inside {cur.qualname} ({why})"
+                    work.append(child)
+            for node in _walk_own_body(cur.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = None
+                if isinstance(node.func, ast.Name):
+                    name = node.func.id
+                elif isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id == "self":
+                    name = node.func.attr
+                if name is None:
+                    continue
+                for cand in by_simple.get(name, ()):
+                    if cand.cls is not None and cur.cls is not None and cand.cls != cur.cls:
+                        continue
+                    if cand not in reachable:
+                        reachable[cand] = f"called from {cur.qualname} ({why})"
+                        work.append(cand)
+        return reachable
+
+    # ---------------------------------------------------------- impurity
+
+    def _scan_body(self, module: Module, info: _FuncInfo, why: str) -> List[Finding]:
+        findings: List[Finding] = []
+        seen_keys: Set[str] = set()
+        global_names: Set[str] = set()
+        for node in _walk_own_body(info.node):
+            if isinstance(node, ast.Global):
+                global_names.update(node.names)
+
+        def add(node: ast.AST, op: str, detail: str, severity: str = "error"):
+            key = f"{info.qualname}:{op}"
+            if key in seen_keys:
+                # one finding per (function, op): repeated hits of the same
+                # impurity share a fingerprint, keeping the baseline compact
+                return
+            seen_keys.add(key)
+            findings.append(Finding(
+                checker=self.id, path=module.relpath,
+                line=getattr(node, "lineno", 1),
+                message=f"{detail} in jit-traced code ({why})",
+                key=key, severity=severity))
+
+        for node in _walk_own_body(info.node):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+                for t in targets:
+                    if isinstance(t, ast.Name) and t.id in global_names:
+                        add(node, f"global:{t.id}",
+                            f"mutation of global '{t.id}'")
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func)
+            if fname in IMPURE_TIME:
+                add(node, fname, f"host clock call {fname}()")
+            elif fname is not None and fname.split(".")[0] == "random":
+                add(node, fname, f"stdlib global-RNG call {fname}()")
+            elif fname is not None and (
+                    fname.startswith("np.random.") or fname.startswith("numpy.random.")):
+                add(node, fname, f"host numpy RNG call {fname}() (draws at trace "
+                                 "time, constant-folds into the compiled program)")
+            elif isinstance(node.func, ast.Name) and node.func.id == "print":
+                add(node, "print", "print() (trace-time only; use jax.debug.print)")
+            elif isinstance(node.func, ast.Attribute) and node.func.attr == "item" \
+                    and not node.args and not node.keywords:
+                add(node, f"{dotted_name(node.func) or '.item'}", ".item() host sync")
+            elif isinstance(node.func, ast.Name) and node.func.id in ("float", "int", "bool") \
+                    and node.args and not isinstance(node.args[0], ast.Constant):
+                add(node, f"{node.func.id}()",
+                    f"{node.func.id}() on a traced value forces a host sync",
+                    severity=SEVERITY_WARNING)
+        return findings

@@ -1,0 +1,318 @@
+"""lock-order checker: acquisition cycles and blocking work under locks.
+
+The comm plane, telemetry registry, and cross-silo server FSM are the
+threaded parts of the framework: receive loops, retry timers, the
+prefetcher, and the round FSM all take ``threading.Lock``s. Two bug
+classes are invisible to unit tests that never hit the right
+interleaving:
+
+- **ordering cycles** — if thread A nests ``lock1 -> lock2`` while
+  thread B nests ``lock2 -> lock1``, the process can deadlock. The
+  checker builds the acquisition graph from ``with self._x:`` nesting
+  (including one level of ``self.method()`` indirection inside the same
+  class, to a fixed point) and reports every cycle — a cycle on a single
+  non-reentrant lock is a guaranteed self-deadlock.
+- **blocking under a lock** — ``time.sleep``, socket sends/receives,
+  payload serialization, or subprocess waits made while holding a lock
+  extend the critical section by an unbounded I/O latency and stall
+  every thread contending for it.
+
+Lock identity is ``ClassName._attr`` (per-instance locks of the same
+class share ordering discipline). Only ``with``-statement acquisition is
+modelled — the codebase has no bare ``.acquire()`` call sites, and the
+checker keeps it that way by flagging them too.
+
+Scope: ``fedml_tpu/comm/``, ``fedml_tpu/cross_silo/``, the telemetry/
+mlops registries, the CLI agent runner, and the prefetcher.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import SEVERITY_WARNING, Checker, Finding, Module, dotted_name
+
+SCOPE_PREFIXES = ("fedml_tpu/comm/", "fedml_tpu/cross_silo/")
+SCOPE_FILES = (
+    "fedml_tpu/core/telemetry.py",
+    "fedml_tpu/core/mlops.py",
+    "fedml_tpu/cli/runner.py",
+    "fedml_tpu/simulation/prefetch.py",
+)
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+REENTRANT_FACTORIES = {"RLock", "Condition"}  # Condition wraps an RLock by default
+
+# dotted suffixes / attribute names that block on I/O or another thread
+BLOCKING_DOTTED = {"time.sleep"}
+BLOCKING_ATTRS = {"sendall", "recv", "recv_into", "accept", "connect",
+                  "publish", "request", "urlopen", "getresponse"}
+BLOCKING_NAME_PARTS = ("serialize",)  # e.g. serialize_params, _serialize
+
+
+class _MethodInfo:
+    __slots__ = ("qual", "cls", "simple", "node",
+                 "acquires", "edges", "blocking", "self_calls_under_lock")
+
+    def __init__(self, qual: str, cls: Optional[str], simple: str, node: ast.AST):
+        self.qual = qual
+        self.cls = cls
+        self.simple = simple
+        self.node = node
+        self.acquires: Set[str] = set()           # every lock taken inside
+        # (outer, inner, lineno) direct nesting edges
+        self.edges: List[Tuple[str, str, int]] = []
+        # (lock, op, lineno) blocking call while lock held
+        self.blocking: List[Tuple[str, str, int]] = []
+        # (held locks tuple, callee simple name, lineno)
+        self.self_calls_under_lock: List[Tuple[Tuple[str, ...], str, int]] = []
+
+
+class LockOrderChecker(Checker):
+    id = "lock-order"
+    description = ("lock acquisition cycles and blocking calls (sleep/send/"
+                   "serialize/socket) made while holding a lock")
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        self._edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+        self._findings: List[Finding] = []
+
+    def interested(self, relpath: str) -> bool:
+        return relpath.startswith(SCOPE_PREFIXES) or relpath in SCOPE_FILES
+
+    # ------------------------------------------------------------- visit
+
+    def visit_module(self, module: Module) -> Iterable[Finding]:
+        lock_attrs = self._collect_lock_attrs(module.tree)
+        methods = self._collect_methods(module, lock_attrs)
+        self._propagate_self_calls(methods)
+        findings: List[Finding] = []
+        for m in methods.values():
+            for outer, inner, lineno in m.edges:
+                prev = self._edges.get((outer, inner))
+                if prev is None:
+                    self._edges[(outer, inner)] = (module.relpath, lineno, m.qual)
+                if outer == inner and not self._reentrant(outer, lock_attrs):
+                    findings.append(Finding(
+                        checker=self.id, path=module.relpath, line=lineno,
+                        message=(f"non-reentrant lock {outer} re-acquired while "
+                                 f"already held in {m.qual} — guaranteed deadlock"),
+                        key=f"{m.qual}:reacquire:{outer}"))
+            for lock, op, lineno in m.blocking:
+                findings.append(Finding(
+                    checker=self.id, path=module.relpath, line=lineno,
+                    message=(f"blocking call {op} while holding {lock} in "
+                             f"{m.qual} — stalls every thread contending for it"),
+                    key=f"{m.qual}:blocking:{op}:{lock}",
+                    severity=SEVERITY_WARNING))
+        # bare .acquire() keeps the with-only modelling honest
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "acquire":
+                owner = dotted_name(node.func.value) or ""
+                if "lock" in owner.lower() or owner.split(".")[-1] in ("_cond",):
+                    findings.append(Finding(
+                        checker=self.id, path=module.relpath, line=node.lineno,
+                        message=(f"bare {owner}.acquire() — use a with-block so "
+                                 "graftcheck can model the critical section"),
+                        key=f"acquire:{owner}", severity=SEVERITY_WARNING))
+        return findings
+
+    def finalize(self) -> Iterable[Finding]:
+        return self._cycle_findings()
+
+    # ----------------------------------------------------------- helpers
+
+    def _reentrant(self, lock_id: str, lock_attrs: Dict[Tuple[Optional[str], str], str]) -> bool:
+        cls, _, attr = lock_id.rpartition(".")
+        kind = lock_attrs.get((cls or None, attr), "")
+        return kind in REENTRANT_FACTORIES
+
+    def _collect_lock_attrs(self, tree: ast.AST) -> Dict[Tuple[Optional[str], str], str]:
+        """(class, attr) -> factory kind for every ``self.x = threading.Lock()``
+        style assignment (module-level ``x = Lock()`` uses class None)."""
+        out: Dict[Tuple[Optional[str], str], str] = {}
+
+        def factory_kind(value: ast.AST) -> Optional[str]:
+            if isinstance(value, ast.Call):
+                name = dotted_name(value.func) or ""
+                last = name.split(".")[-1]
+                if last in LOCK_FACTORIES:
+                    return last
+            return None
+
+        def walk(node: ast.AST, cls: Optional[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    walk(child, child.name)
+                    continue
+                if isinstance(child, ast.Assign):
+                    kind = factory_kind(child.value)
+                    if kind:
+                        for t in child.targets:
+                            if isinstance(t, ast.Attribute) and \
+                                    isinstance(t.value, ast.Name) and t.value.id == "self":
+                                out[(cls, t.attr)] = kind
+                            elif isinstance(t, ast.Name):
+                                out[(cls, t.id)] = kind
+                walk(child, cls)
+
+        walk(tree, None)
+        return out
+
+    def _lock_id(self, expr: ast.AST, cls: Optional[str],
+                 lock_attrs: Dict[Tuple[Optional[str], str], str]) -> Optional[str]:
+        """Lock identity for a with-item context expression, or None."""
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self":
+            attr = expr.attr
+            if (cls, attr) in lock_attrs or "lock" in attr.lower() or attr.endswith("_cond"):
+                return f"{cls}.{attr}" if cls else attr
+        elif isinstance(expr, ast.Name):
+            if (None, expr.id) in lock_attrs or "lock" in expr.id.lower():
+                return expr.id
+        return None
+
+    def _collect_methods(self, module: Module,
+                         lock_attrs: Dict[Tuple[Optional[str], str], str]
+                         ) -> Dict[str, _MethodInfo]:
+        methods: Dict[str, _MethodInfo] = {}
+
+        def visit_func(node, qual: str, cls: Optional[str]):
+            info = _MethodInfo(qual, cls, node.name, node)
+            methods[qual] = info
+            for stmt in node.body:
+                self._visit(stmt, info, cls, lock_attrs, held=())
+            return info
+
+        def walk(node: ast.AST, stack: List[str], cls: Optional[str]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = ".".join(stack + [child.name])
+                    visit_func(child, qual, cls)
+                    walk(child, stack + [child.name], cls)
+                elif isinstance(child, ast.ClassDef):
+                    walk(child, stack + [child.name], child.name)
+                else:
+                    walk(child, stack, cls)
+
+        walk(module.tree, [], None)
+        return methods
+
+    def _visit(self, node: ast.AST, info: _MethodInfo,
+               cls: Optional[str],
+               lock_attrs: Dict[Tuple[Optional[str], str], str],
+               held: Tuple[str, ...]) -> None:
+        """Examine ONE node with the lock set actually held at that point,
+        then recurse — so directly nested ``with`` statements extend the
+        stack no matter how they appear in the tree."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs are separate methods (run unheld)
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in node.items:
+                lock = self._lock_id(item.context_expr, cls, lock_attrs)
+                if lock is None:
+                    continue
+                info.acquires.add(lock)
+                for h in new_held:
+                    info.edges.append((h, lock, node.lineno))
+                new_held = new_held + (lock,)
+            for stmt in node.body:
+                self._visit(stmt, info, cls, lock_attrs, new_held)
+            return
+        self._check_node(node, info, held)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, info, cls, lock_attrs, held)
+
+    def _check_node(self, node: ast.AST, info: _MethodInfo,
+                    held: Tuple[str, ...]) -> None:
+        """Examine ONE node (the recursion guarantees each is seen once,
+        with the lock set actually held at that point)."""
+        if not held or not isinstance(node, ast.Call):
+            return
+        fname = dotted_name(node.func) or ""
+        last = fname.split(".")[-1]
+        op = None
+        if fname in BLOCKING_DOTTED:
+            op = fname
+        elif isinstance(node.func, ast.Attribute) and node.func.attr in BLOCKING_ATTRS:
+            op = f".{node.func.attr}()"
+        elif any(part in last.lower() for part in BLOCKING_NAME_PARTS):
+            op = f"{last}()"
+        elif fname.startswith("subprocess."):
+            op = fname
+        if op is not None:
+            info.blocking.append((held[-1], op, node.lineno))
+        if isinstance(node.func, ast.Attribute) and \
+                isinstance(node.func.value, ast.Name) and node.func.value.id == "self":
+            info.self_calls_under_lock.append((held, node.func.attr, node.lineno))
+
+    def _propagate_self_calls(self, methods: Dict[str, _MethodInfo]) -> None:
+        """Fixed point: a call to self.m() under lock L adds edges
+        L -> every lock m() may acquire (same class only)."""
+        by_cls_simple: Dict[Tuple[Optional[str], str], List[_MethodInfo]] = {}
+        for m in methods.values():
+            by_cls_simple.setdefault((m.cls, m.simple), []).append(m)
+        changed = True
+        while changed:
+            changed = False
+            for m in methods.values():
+                for held, callee_name, lineno in m.self_calls_under_lock:
+                    for callee in by_cls_simple.get((m.cls, callee_name), ()):
+                        for inner in callee.acquires:
+                            for h in held:
+                                edge = (h, inner, lineno)
+                                if (h, inner) not in {(a, b) for a, b, _ in m.edges}:
+                                    m.edges.append(edge)
+                                    changed = True
+                        # locks the callee acquires count as acquired here too,
+                        # so chains self.a() -> self.b() propagate
+                        before = len(m.acquires)
+                        m.acquires |= callee.acquires
+                        changed = changed or len(m.acquires) != before
+
+    # ------------------------------------------------------------ cycles
+
+    def _cycle_findings(self) -> List[Finding]:
+        graph: Dict[str, Set[str]] = {}
+        for (outer, inner), _site in self._edges.items():
+            if outer != inner:
+                graph.setdefault(outer, set()).add(inner)
+        findings: List[Finding] = []
+        reported: Set[frozenset] = set()
+        for start in sorted(graph):
+            path: List[str] = []
+
+            def dfs(lock: str) -> Optional[List[str]]:
+                if lock == start and path:
+                    return list(path)
+                if lock in path:
+                    return None
+                path.append(lock)
+                for nxt in sorted(graph.get(lock, ())):
+                    cycle = dfs(nxt)
+                    if cycle is not None:
+                        return cycle
+                path.pop()
+                return None
+
+            cycle = dfs(start)
+            if cycle:
+                ident = frozenset(cycle)
+                if ident in reported:
+                    continue
+                reported.add(ident)
+                first_edge = (cycle[0], cycle[1] if len(cycle) > 1 else cycle[0])
+                relpath, lineno, qual = self._edges.get(
+                    first_edge, ("fedml_tpu", 1, "?"))
+                order = " -> ".join(cycle + [cycle[0]])
+                findings.append(Finding(
+                    checker=self.id, path=relpath, line=lineno,
+                    message=(f"lock acquisition cycle {order} (first edge in "
+                             f"{qual}) — threads taking these in different "
+                             "orders can deadlock"),
+                    key=f"cycle:{'->'.join(sorted(ident))}"))
+        return findings

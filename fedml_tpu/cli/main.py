@@ -204,6 +204,21 @@ def dispatch(package, edge_ids, run_id, broker_dir, store_dir, timeout):
         raise SystemExit(1)
 
 
+@cli.command("analyze",
+             help="Run the graftcheck static-analysis suite over fedml_tpu/ "
+                  "(jit-purity, determinism, lock-order, config-drift, "
+                  "no-print). Flags are forwarded to the checker driver: "
+                  "--checker ID (repeatable), --json, --baseline PATH, "
+                  "--no-baseline, --write-baseline, --root DIR. Exits 1 on "
+                  "non-baselined findings. See docs/static_analysis.md.",
+             context_settings={"ignore_unknown_options": True})
+@click.argument("graftcheck_args", nargs=-1, type=click.UNPROCESSED)
+def analyze(graftcheck_args):
+    from ..analysis import main as graftcheck_main
+
+    raise SystemExit(graftcheck_main(list(graftcheck_args)))
+
+
 @cli.command("run", help="Run a simulation from a YAML config.")
 @click.option("--cf", "config_file", required=True, type=click.Path(exists=True))
 @click.option("--backend", default=None, help="sp | TPU (overrides YAML)")

@@ -227,16 +227,19 @@ class FedMLEdgeRunner:
             os.makedirs(log_dir, exist_ok=True)
             log_path = os.path.join(log_dir, f"run_{run_id}_edge_{self.edge_id}.log")
             self._report_status(MLOpsMetrics.STATUS_RUNNING)
-            with self._proc_lock:
+            # fork/exec outside the lock — callbacks run on one dispatcher
+            # thread, so only the self._proc handoff below needs the lock
+            # (the watcher thread compares identity before acting)
+            with open(log_path, "w") as log:
                 # the child duplicates the log fd; close the parent's copy
-                with open(log_path, "w") as log:
-                    self._proc = subprocess.Popen(
-                        [sys.executable, entry, "--cf", cfg_path],
-                        cwd=package_dir, env=env,
-                        stdout=log, stderr=subprocess.STDOUT,
-                    )
+                proc = subprocess.Popen(
+                    [sys.executable, entry, "--cf", cfg_path],
+                    cwd=package_dir, env=env,
+                    stdout=log, stderr=subprocess.STDOUT,
+                )
+            with self._proc_lock:
+                self._proc = proc
                 self._current_run = run_id
-                proc = self._proc
             threading.Thread(target=self._watch_train_process,
                              args=(proc, run_id), daemon=True).start()
         except Exception:

@@ -65,7 +65,7 @@ def create_comm_backend(backend: str, rank: int, size: int, args=None, **kw) -> 
             from ..core.mlops import MLOpsConfigs
 
             mqtt_cfg, s3_cfg = MLOpsConfigs(args).fetch_configs()
-        run_id = str(getattr(args, "run_id", 0))
+        run_id = str(getattr(args, "run_id", "0"))
         if broker is None:
             # precedence: an EXPLICIT broker_dir kwarg always wins (the
             # MLOpsConfigs doc's user-proximate rule — a cached config file
@@ -119,7 +119,7 @@ def create_comm_backend(backend: str, rank: int, size: int, args=None, **kw) -> 
                                      or kw.get("download_dir"))
         return cls(
             broker, store, rank=rank, size=size,
-            run_id=str(getattr(args, "run_id", 0)),
+            run_id=str(getattr(args, "run_id", "0")),
             owns_broker=owns_broker,  # factory-created broker dies with the manager
             retry_policy=retry_policy,
             **extra,

@@ -124,13 +124,13 @@ class MLOpsMetrics:
 
     def __init__(self, sink: Optional[MetricsSink] = None):
         self.sink = sink or MetricsSink()
-        self.run_id = 0
+        self.run_id = "0"
         self.edge_id = 0
 
     def set_messenger(self, sink, args=None) -> None:
         self.sink = sink
         if args is not None:
-            self.run_id = getattr(args, "run_id", 0)
+            self.run_id = getattr(args, "run_id", "0")
             self.edge_id = getattr(args, "rank", 0)
 
     def _emit(self, kind: str, payload: Dict[str, Any]) -> None:
@@ -163,7 +163,7 @@ class MLOpsProfilerEvent:
     def __init__(self, args=None, sink: Optional[MetricsSink] = None):
         self.args = args
         self.sink = sink or MetricsSink()
-        self.run_id = getattr(args, "run_id", 0) if args else 0
+        self.run_id = getattr(args, "run_id", "0") if args else "0"
         self._open_events: Dict[str, float] = {}
 
     def log_event_started(self, event_name: str, event_value: Optional[str] = None,

@@ -64,7 +64,7 @@ class FedMLAggregator:
         # the divergence watchdog (server_manager) needs per-slot z-scores to
         # decide who to exclude on rollback, so it forces the sanitizer on
         self.detect = bool(getattr(args, "sanitize_updates", False)) or (
-            float(getattr(args, "watchdog_factor", 0) or 0) > 0)
+            float(getattr(args, "watchdog_factor", 0.0) or 0.0) > 0)
         self._robust = RobustAggregator(
             defense_type=defense,
             norm_bound=float(getattr(args, "norm_bound", 5.0)),

@@ -113,9 +113,12 @@ def run_chaos_drill(args=None, n_clients: Optional[int] = None,
         cfg = dict(PHASE_DEFAULTS)
         cfg.update(overrides)
         args = fedml_tpu.init(config=cfg)
+    # PHASE_DEFAULTS is the single source for drill defaults — a pre-built
+    # args missing a key falls back to the same values the cfg path uses
     n = int(n_clients if n_clients is not None
-            else getattr(args, "client_num_in_total", 2))
-    rounds = int(getattr(args, "comm_round", 1))
+            else getattr(args, "client_num_in_total",
+                         PHASE_DEFAULTS["client_num_in_total"]))
+    rounds = int(getattr(args, "comm_round", PHASE_DEFAULTS["comm_round"]))
 
     registry = telemetry.get_registry()
     before = registry.snapshot()["counters"] if telemetry.enabled() else {}

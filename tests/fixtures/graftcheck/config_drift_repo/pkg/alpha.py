@@ -1,0 +1,9 @@
+"""Fixture package: one side of a conflicting-default pair, plus an
+undocumented key."""
+
+
+def configure(args):
+    retries = int(getattr(args, "retry_count", 0))
+    batch = int(getattr(args, "batch_size", 32))
+    lr = float(getattr(args, "learning_rate", 0.03))
+    return retries, batch, lr

@@ -1,0 +1,12 @@
+"""Fixture package: the other side of the conflicting default, plus
+exempt read shapes (None probe, fallback chain)."""
+
+
+def configure(args):
+    retries = int(getattr(args, "retry_count", 3))
+    lr = float(getattr(args, "learning_rate", 0.03))
+    # None probe: delegates the decision, never conflicts
+    probe = getattr(args, "retry_count", None)
+    # fallback chain: the inner default belongs to the chain
+    window = getattr(args, "retry_window", getattr(args, "retry_count", 9))
+    return retries, lr, probe, window

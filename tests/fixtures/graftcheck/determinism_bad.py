@@ -1,0 +1,22 @@
+"""Fixture: nondeterminism — unseeded RNGs, time seeds, set ordering."""
+
+import random
+import time
+
+import numpy as np
+
+
+def make_rng():
+    return np.random.default_rng()
+
+
+def make_py_rng():
+    return random.Random()
+
+
+def time_seeded():
+    return np.random.default_rng(int(time.time()))
+
+
+def cohort_order(client_ids):
+    return list(set(client_ids))

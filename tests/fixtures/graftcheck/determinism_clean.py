@@ -1,0 +1,18 @@
+"""Fixture: deterministic counterparts of determinism_bad."""
+
+import random
+
+import numpy as np
+
+
+def make_rng(seed):
+    return np.random.default_rng(seed)
+
+
+def make_py_rng(seed):
+    return random.Random(seed)
+
+
+def cohort_order(client_ids):
+    chosen = set(client_ids)
+    return sorted(chosen)

@@ -1,0 +1,28 @@
+"""Fixture: impure operations reachable from jit-traced code."""
+
+import time
+import random
+
+import jax
+import numpy as np
+
+
+def _noise():
+    # reached from the jitted body through the same-module call graph
+    return np.random.normal()
+
+
+@jax.jit
+def step(x):
+    t = time.time()
+    print("stepping", t)
+    r = random.random()
+    return x + t + r + _noise()
+
+
+def loop(xs):
+    def body(carry, x):
+        # lax.scan bodies are traced too
+        return carry + time.monotonic(), x
+
+    return jax.lax.scan(body, 0.0, xs)

@@ -1,0 +1,20 @@
+"""Fixture: pure jit-traced code plus impure code OUTSIDE any trace."""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x, key):
+    noise = jax.random.normal(key, x.shape)
+    return jnp.tanh(x) + 0.1 * noise
+
+
+def timed_host_step(x, key):
+    # host-side timing around the trace is fine — only traced bodies
+    # must stay pure
+    t0 = time.perf_counter()
+    y = step(x, key)
+    return y, time.perf_counter() - t0

@@ -1,0 +1,27 @@
+"""Fixture: lock-order violations (fed to the checker under a relpath
+inside its comm/cross_silo scope — see tests/test_static_analysis.py)."""
+
+import threading
+import time
+
+
+class Channel:
+    def __init__(self):
+        self._send_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+
+    def send(self, sock, payload):
+        with self._send_lock:
+            with self._state_lock:
+                sock.sendall(payload)
+
+    def close(self):
+        # opposite nesting order from send() — the classic AB/BA deadlock
+        with self._state_lock:
+            with self._send_lock:
+                time.sleep(0.1)
+
+    def reenter(self):
+        with self._send_lock:
+            with self._send_lock:
+                pass

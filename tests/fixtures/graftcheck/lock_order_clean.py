@@ -1,0 +1,23 @@
+"""Fixture: consistent lock order, no blocking work under locks."""
+
+import threading
+import time
+
+
+class Channel:
+    def __init__(self):
+        self._send_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+
+    def send(self, sock, payload):
+        with self._send_lock:
+            with self._state_lock:
+                self._pending = payload
+        sock.sendall(payload)
+
+    def close(self):
+        # same order as send()
+        with self._send_lock:
+            with self._state_lock:
+                self._pending = None
+        time.sleep(0.1)

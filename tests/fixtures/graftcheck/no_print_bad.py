@@ -1,0 +1,6 @@
+"""Fixture: a bare print() call in library code."""
+
+
+def announce(round_idx):
+    print(f"round {round_idx} done")
+    return round_idx

@@ -1,0 +1,271 @@
+"""Tier-1: the graftcheck static-analysis suite (fedml_tpu/analysis/).
+
+Three layers:
+
+1. the package itself must be clean — zero non-baselined findings from
+   every checker (the committed baseline in
+   scripts/graftcheck_baseline.json grandfathers the known, deliberate
+   exceptions, and deleting any of its lines must turn the run red);
+2. every checker must actually FIRE on its bad fixture and stay silent
+   on its clean twin (tests/fixtures/graftcheck/);
+3. the shared machinery — suppression comments, baseline round-trip,
+   CLI entry point — must keep its contract.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+from fedml_tpu.analysis import core as gc
+from fedml_tpu.analysis.config_drift import ConfigDriftChecker
+from fedml_tpu.analysis.determinism import DeterminismChecker
+from fedml_tpu.analysis.jit_purity import JitPurityChecker
+from fedml_tpu.analysis.lock_order import LockOrderChecker
+from fedml_tpu.analysis.no_print import NoPrintChecker
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO_ROOT, "tests", "fixtures", "graftcheck")
+
+
+def _run_on_fixture(checker_cls, filename, relpath=None):
+    """One checker over one fixture file; ``relpath`` lets a fixture
+    masquerade as an in-scope module for scope-restricted checkers."""
+    path = os.path.join(FIXTURES, filename)
+    ctx = gc.Context(repo_root=FIXTURES, package_dir=path)
+    mod = gc.load_module(path, FIXTURES)
+    if relpath is not None:
+        mod.relpath = relpath
+    checker = checker_cls(ctx)
+    findings = []
+    if checker.interested(mod.relpath):
+        findings.extend(checker.visit_module(mod))
+    findings.extend(checker.finalize())
+    return findings
+
+
+# ------------------------------------------------------- package is clean
+
+def test_package_has_no_new_findings():
+    rc = gc.main([])
+    assert rc == 0, "graftcheck found non-baselined violations in fedml_tpu/"
+
+
+def test_analyze_runs_fast_enough():
+    # the <30s CPU budget from the adoption contract; generous margin so
+    # CI noise never flakes this
+    import time
+
+    t0 = time.perf_counter()
+    gc.main([])
+    assert time.perf_counter() - t0 < 30.0
+
+
+def test_deleting_a_baseline_line_fails_the_run(tmp_path):
+    baseline_path = gc.default_baseline_path(REPO_ROOT)
+    baseline = gc.load_baseline(baseline_path)
+    assert baseline, "committed baseline should grandfather known findings"
+    pruned = tmp_path / "baseline.json"
+    pruned.write_text(json.dumps(baseline[1:]))
+    rc = gc.main(["--baseline", str(pruned)])
+    assert rc == 1, "a de-baselined known finding must turn the run red"
+
+
+# ------------------------------------------------------------- jit-purity
+
+def test_jit_purity_fires_on_bad_fixture():
+    findings = _run_on_fixture(JitPurityChecker, "jit_purity_bad.py")
+    msgs = "\n".join(f.message for f in findings)
+    assert "time.time" in msgs          # direct impure call in jit body
+    assert "print" in msgs              # host I/O in jit body
+    assert "random.random" in msgs      # unkeyed python RNG in jit body
+    assert "np.random" in msgs          # reached through the call graph
+    assert "time.monotonic" in msgs     # inside a lax.scan body
+    assert all(f.checker == "jit-purity" for f in findings)
+
+
+def test_jit_purity_silent_on_clean_fixture():
+    assert _run_on_fixture(JitPurityChecker, "jit_purity_clean.py") == []
+
+
+# ----------------------------------------------------------- determinism
+
+def test_determinism_fires_on_bad_fixture():
+    findings = _run_on_fixture(DeterminismChecker, "determinism_bad.py")
+    keys = {f.key for f in findings}
+    assert "make_rng:unseeded:default_rng" in keys
+    assert "make_py_rng:unseeded:Random" in keys
+    assert "time_seeded:time-seed:default_rng" in keys
+    assert "cohort_order:set-order" in keys
+
+
+def test_determinism_silent_on_clean_fixture():
+    assert _run_on_fixture(DeterminismChecker, "determinism_clean.py") == []
+
+
+# ------------------------------------------------------------ lock-order
+
+_IN_SCOPE = "fedml_tpu/comm/_graftcheck_fixture.py"
+
+
+def test_lock_order_fires_on_bad_fixture():
+    findings = _run_on_fixture(
+        LockOrderChecker, "lock_order_bad.py", relpath=_IN_SCOPE)
+    msgs = "\n".join(f.message for f in findings)
+    assert "re-acquired" in msgs                       # self-deadlock
+    assert "lock acquisition cycle" in msgs            # AB/BA cycle
+    assert ".sendall()" in msgs                        # blocking under lock
+    assert "time.sleep" in msgs
+
+
+def test_lock_order_silent_on_clean_fixture():
+    findings = _run_on_fixture(
+        LockOrderChecker, "lock_order_clean.py", relpath=_IN_SCOPE)
+    assert findings == []
+
+
+def test_lock_order_ignores_out_of_scope_files():
+    # same bad source, but outside comm/cross_silo/telemetry scope
+    findings = _run_on_fixture(LockOrderChecker, "lock_order_bad.py")
+    assert findings == []
+
+
+# ---------------------------------------------------------- config-drift
+
+def test_config_drift_fires_on_fixture_repo():
+    repo = os.path.join(FIXTURES, "config_drift_repo")
+    findings = gc.run_checkers(
+        [ConfigDriftChecker], os.path.join(repo, "pkg"), repo)
+    keys = {f.key for f in findings}
+    assert "conflicting-default:retry_count" in keys   # 0 vs 3
+    assert "doc-only:ghost_key" in keys                # documented, unread
+    assert "undocumented:batch_size" in keys           # read, undocumented
+    # None probes and fallback-chain inner defaults never conflict
+    assert "conflicting-default:learning_rate" not in keys
+    assert "conflicting-default:retry_window" not in keys
+
+
+# -------------------------------------------------------------- no-print
+
+def test_no_print_fires_on_bad_fixture():
+    findings = _run_on_fixture(NoPrintChecker, "no_print_bad.py")
+    assert len(findings) == 1
+    assert findings[0].checker == "no-print"
+
+
+def test_no_print_silent_on_clean_fixture():
+    # logging calls and print-as-value (log_fn=print) stay legal
+    assert _run_on_fixture(NoPrintChecker, "no_print_clean.py") == []
+
+
+def test_no_print_respects_allowlist():
+    checker = NoPrintChecker(gc.Context(repo_root=REPO_ROOT,
+                                        package_dir=REPO_ROOT))
+    assert not checker.interested("fedml_tpu/cli/main.py")
+    assert not checker.interested("fedml_tpu/utils/chip_probe.py")
+    assert checker.interested("fedml_tpu/core/telemetry.py")
+
+
+# ----------------------------------------------------------- suppression
+
+def _no_print_over(tmp_path, source):
+    path = tmp_path / "mod.py"
+    path.write_text(source)
+    return gc.run_checkers([NoPrintChecker], str(path), str(tmp_path))
+
+
+def test_inline_suppression_drops_finding(tmp_path):
+    src = 'print("x")  # graftcheck: disable=no-print\n'
+    assert _no_print_over(tmp_path, src) == []
+
+
+def test_standalone_comment_suppresses_next_line(tmp_path):
+    src = ('# tooling speaks over stdout; graftcheck: disable=no-print\n'
+           'print("x")\n')
+    assert _no_print_over(tmp_path, src) == []
+
+
+def test_disable_all_suppresses_every_checker(tmp_path):
+    src = 'print("x")  # graftcheck: disable=all\n'
+    assert _no_print_over(tmp_path, src) == []
+
+
+def test_unsuppressed_line_still_fires(tmp_path):
+    src = ('print("a")  # graftcheck: disable=no-print\n'
+           'print("b")\n')
+    findings = _no_print_over(tmp_path, src)
+    assert [f.line for f in findings] == [2]
+
+
+def test_suppression_for_other_checker_does_not_apply(tmp_path):
+    src = 'print("x")  # graftcheck: disable=determinism\n'
+    findings = _no_print_over(tmp_path, src)
+    assert len(findings) == 1
+
+
+# -------------------------------------------------------------- baseline
+
+def test_baseline_round_trip(tmp_path):
+    findings = _run_on_fixture(DeterminismChecker, "determinism_bad.py")
+    assert findings
+    path = tmp_path / "baseline.json"
+    gc.write_baseline(findings, str(path))
+    baseline = gc.load_baseline(str(path))
+    new, old, stale = gc.apply_baseline(findings, baseline)
+    assert new == [] and stale == []
+    assert {f.fingerprint for f in old} == set(baseline)
+
+    # dropping one entry resurfaces exactly that finding as new
+    new, _old, _stale = gc.apply_baseline(findings, baseline[1:])
+    assert [f.fingerprint for f in new] == [baseline[0]]
+
+    # a fingerprint matching nothing is reported stale, never fatal
+    _new, _old, stale = gc.apply_baseline(findings, baseline + ["bogus:x:y"])
+    assert stale == ["bogus:x:y"]
+
+
+def test_baseline_file_is_one_fingerprint_per_line(tmp_path):
+    findings = _run_on_fixture(NoPrintChecker, "no_print_bad.py")
+    path = tmp_path / "baseline.json"
+    gc.write_baseline(findings, str(path))
+    lines = path.read_text().splitlines()
+    # [ ... one quoted fingerprint per interior line ... ]
+    assert lines[0] == "[" and lines[-1] == "]"
+    assert len(lines) == 2 + len({f.fingerprint for f in findings})
+
+
+def test_fingerprints_are_line_number_free():
+    findings = _run_on_fixture(NoPrintChecker, "no_print_bad.py")
+    for f in findings:
+        assert str(f.line) not in f.fingerprint.split(":")[-1] or f.line > 99
+
+
+# --------------------------------------------------------------- frontend
+
+def test_cli_analyze_exits_zero():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "fedml_tpu.cli", "analyze"],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "graftcheck:" in proc.stdout
+
+
+def test_json_output_shape(tmp_path, capsys):
+    bad = os.path.join(FIXTURES, "no_print_bad.py")
+    rc = gc.main(["--json", "--no-baseline", "--checker", "no-print",
+                  "--root", bad])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["checkers"] == ["no-print"]
+    assert len(out["new"]) == 1
+    finding = out["new"][0]
+    assert set(finding) == {"checker", "path", "line", "severity",
+                            "message", "fingerprint"}
+
+
+def test_checker_registry_is_complete():
+    assert sorted(gc.checker_registry()) == [
+        "config-drift", "determinism", "jit-purity", "lock-order", "no-print"]
