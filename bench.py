@@ -386,6 +386,98 @@ def telemetry_overhead_bench(rounds: int = 20, trials: int = 3,
     return 0 if ok else 1
 
 
+def cohort_sweep_bench(sizes=(10, 100, 1000, 10000), pool: int = 20000,
+                       warmup_rounds: int = 2, measured_rounds: int = 3) -> int:
+    """``--cohort-sweep``: CPU-only scaling sweep of the arena-backed round
+    loop over sampled cohort sizes (10/100/1k/10k from a 20k-client pool,
+    SCAFFOLD so every round exercises the client-state gather/scatter path).
+    Synthetic separable 2-class blobs keep the per-client work constant so
+    the sweep isolates cohort-axis scaling: per size it reports rounds/sec
+    plus the per-round phase breakdown (state_gather / state_scatter now
+    attributed) and checks the named phases + host_other sum to round_time.
+    Gate: the 10k-cohort sampled round must clear 1 round/sec."""
+    import math
+
+    import numpy as np
+
+    import fedml_tpu
+    from fedml_tpu.data.federated import ArrayPair, build_federated_data
+    from fedml_tpu.simulation import build_simulator
+
+    spc, dim, class_num = 8, 16, 2
+    rng = np.random.default_rng(0)
+    n = pool * spc
+    y = (np.arange(n) % class_num).astype(np.int64)
+    x = rng.normal(size=(n, dim)).astype(np.float32) \
+        + 2.0 * y[:, None].astype(np.float32)
+    net_map = {c: list(range(c * spc, (c + 1) * spc)) for c in range(pool)}
+    fed = build_federated_data(
+        ArrayPair(x, y), ArrayPair(x[:64], y[:64]), net_map, class_num)
+
+    results = []
+    for per_round in sizes:
+        args = fedml_tpu.init(config=dict(
+            dataset="synthetic_blobs", model="lr",
+            client_num_in_total=pool, client_num_per_round=int(per_round),
+            comm_round=warmup_rounds + measured_rounds,
+            learning_rate=0.1, epochs=1, batch_size=spc,
+            frequency_of_the_test=10_000, random_seed=0,
+            federated_optimizer="SCAFFOLD",
+            # synchronous rounds: with the prefetch pipeline on, round r+1's
+            # host work lands in round r's drain window and the per-round
+            # phase breakdown can exceed that round's wall; sync mode keeps
+            # every phase inside its own round so the sum is exact
+            prefetch=False,
+        ))
+        sim, _ = build_simulator(args, fed_data=fed)
+        assert sim._arena is not None, "sweep must run the arena backend"
+        hist = sim.run(apply_fn=None, log_fn=None)
+        recs = hist[warmup_rounds:]
+        wall = sum(r["round_time"] for r in recs)
+        acc: dict = {}
+        sums_ok = True
+        for r in recs:
+            ps = r["phases"]
+            # host_other is computed as the exact remainder at drain time,
+            # so the breakdown must reproduce round_time to float precision
+            sums_ok = sums_ok and math.isclose(
+                sum(ps.values()), r["round_time"],
+                rel_tol=1e-6, abs_tol=1e-9)
+            for k, v in ps.items():
+                acc[k] = acc.get(k, 0.0) + v
+        results.append({
+            "cohort": int(per_round),
+            "rounds_per_sec": round(measured_rounds / wall, 4) if wall else None,
+            "phase_breakdown_s": {
+                k: round(v / measured_rounds, 6) for k, v in sorted(acc.items())},
+            "phase_sum_equals_round_time": bool(sums_ok),
+            "state_phases_present": bool(
+                "state_gather" in acc and "state_scatter" in acc),
+        })
+        print(f"cohort-sweep: cohort={per_round} "
+              f"rounds_per_sec={results[-1]['rounds_per_sec']}",
+              file=sys.stderr, flush=True)
+    by_cohort = {r["cohort"]: r for r in results}
+    pass_10k = (by_cohort.get(10000, {}).get("rounds_per_sec") or 0.0) > 1.0
+    all_sums = all(r["phase_sum_equals_round_time"] for r in results)
+    all_state = all(r["state_phases_present"] for r in results)
+    line = {
+        "metric": "cohort_sweep_rounds_per_sec",
+        "unit": (f"rounds/sec per sampled cohort size, SCAFFOLD lr on "
+                 f"synthetic blobs ({pool}-client pool, {spc} samples x "
+                 f"dim {dim} each), arena client-state backend, CPU"),
+        "results": results,
+        "pass_10k_above_1rps": bool(pass_10k),
+        "phase_sums_exact": bool(all_sums),
+    }
+    print(json.dumps(line), flush=True)
+    ok = pass_10k and all_sums and all_state
+    print(f"cohort-sweep: 10k>1r/s={pass_10k} phase_sums_exact={all_sums} "
+          f"state_phases={all_state} {'OK' if ok else 'BELOW TARGET'}",
+          file=sys.stderr, flush=True)
+    return 0 if ok else 1
+
+
 def chaos_bench(seed: int = 7) -> int:
     """``--chaos``: CPU-only robustness gate — a full loopback cross-silo
     deployment under a seeded fault plan (message drops + injected transient
@@ -453,6 +545,10 @@ if __name__ == "__main__":
         # host-side guard only — never wait on (or measure) the chip
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         sys.exit(telemetry_overhead_bench())
+    if "--cohort-sweep" in sys.argv:
+        # cohort-axis scaling measurement — host + CPU backend only
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        sys.exit(cohort_sweep_bench())
     if "--chaos" in sys.argv:
         # protocol-level drill — loopback only, never touches the chip
         os.environ.setdefault("JAX_PLATFORMS", "cpu")

@@ -13,6 +13,10 @@ anywhere in ``fedml_tpu/``:
 - **time-derived seeds** — a seed expression containing ``time.*``,
   ``datetime.*``, ``os.urandom`` or ``uuid.*`` defeats the point of
   seeding while still looking seeded in review;
+- **global re-seeding** — ``np.random.seed(...)`` / ``random.seed(...)``
+  mutates the process-global stream: any draw a library makes in between
+  shifts every later cohort, so replays stop being a pure function of
+  (seed, round); construct a local ``default_rng((seed, round))`` instead;
 - **set-order dependence** — iterating a ``set``/``frozenset``
   expression (or materialising one via ``list()``/``tuple()``/
   ``enumerate()``/``.join()``) leaks Python's per-process hash ordering
@@ -92,6 +96,16 @@ class DeterminismChecker(Checker):
             if isinstance(node, ast.Call):
                 fname = dotted_name(node.func) or ""
                 simple = fname.split(".")[-1]
+                parts = fname.split(".")
+                if simple == "seed" and "random" in parts[:-1]:
+                    # np.random.seed / random.seed: re-seeds the process-global
+                    # stream, so any library draw between rounds shifts every
+                    # subsequent cohort — replays stop being a function of
+                    # (seed, round) alone
+                    add(node, "global-seed",
+                        f"{fname}(...) re-seeds the process-global RNG stream "
+                        "— use a local np.random.default_rng((seed, round)) "
+                        "so draws are pure in their inputs")
                 if simple in RNG_CONSTRUCTORS:
                     seeds = _seed_args(node)
                     if not seeds and simple in ("default_rng", "RandomState", "Random"):

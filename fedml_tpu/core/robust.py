@@ -110,8 +110,18 @@ def trimmed_mean(stacked_updates: PyTree, trim_ratio: float = 0.1,
     return jax.tree_util.tree_map(_tm, stacked_updates)
 
 
+def _masked_median(x: jax.Array, valid, n_valid: int) -> jax.Array:
+    """Median over the ``valid`` entries of a 1-D array. ``valid`` is a
+    HOST (static) bool mask — invalid entries sort to +inf and the middle
+    indices are Python ints, so this stays one fused sort, no dynamic
+    shapes. Matches ``jnp.median`` exactly on the valid subset (mean of the
+    two middle order statistics for even counts)."""
+    s = jnp.sort(jnp.where(jnp.asarray(valid), x, jnp.inf))
+    return 0.5 * (s[(n_valid - 1) // 2] + s[n_valid // 2])
+
+
 def sanitize_stacked(stacked_updates: PyTree, weights: jax.Array,
-                     z_thresh: float = 6.0):
+                     z_thresh: float = 6.0, valid=None):
     """Quarantine poisoned rows of a stacked cohort before any aggregation.
 
     Two detectors, both jit-able over the whole cohort at once:
@@ -129,6 +139,13 @@ def sanitize_stacked(stacked_updates: PyTree, weights: jax.Array,
     rows are **zeroed** (not just zero-weighted) and their weight is 0;
     ``quarantine`` is a (C,) bool mask and ``z`` the (C,) robust z-scores
     (``+inf`` for non-finite rows).
+
+    ``valid`` (optional, HOST bool array of shape (C,)) marks real cohort
+    rows when the cohort was padded to a mesh-axis multiple: padded rows
+    are excluded from the median/MAD statistics (an all-zero pad row is a
+    perfectly plausible "inlier" that would drag both) and are never
+    quarantined (their z is 0). ``valid=None`` is byte-identical to the
+    pre-padding behavior.
     """
     leaves = jax.tree_util.tree_leaves(stacked_updates)
     C = leaves[0].shape[0]
@@ -139,11 +156,23 @@ def sanitize_stacked(stacked_updates: PyTree, weights: jax.Array,
         bad = bad | ~jnp.isfinite(xf).all(axis=1)
         sq = sq + jnp.sum(jnp.square(jnp.nan_to_num(xf)), axis=1)
     norm = jnp.sqrt(sq)
-    med = jnp.median(norm)
-    mad = jnp.median(jnp.abs(norm - med))
+    if valid is None:
+        med = jnp.median(norm)
+        mad = jnp.median(jnp.abs(norm - med))
+    else:
+        import numpy as _np
+
+        valid = _np.asarray(valid, bool)
+        n_valid = int(valid.sum())
+        med = _masked_median(norm, valid, n_valid)
+        mad = _masked_median(jnp.abs(norm - med), valid, n_valid)
     scale = jnp.maximum(1.4826 * mad, 1e-6 + 0.05 * med)
     z = jnp.where(bad, jnp.inf, (norm - med) / scale)
     quarantine = bad | (z > z_thresh)
+    if valid is not None:
+        v = jnp.asarray(valid)
+        quarantine = quarantine & v
+        z = jnp.where(v, z, 0.0)
     keep = 1.0 - quarantine.astype(jnp.float32)
     clean = jax.tree_util.tree_map(
         lambda x: jnp.where(
@@ -154,13 +183,25 @@ def sanitize_stacked(stacked_updates: PyTree, weights: jax.Array,
     return clean, weights * keep, quarantine, z
 
 
-def pairwise_sq_dists(stacked_updates: PyTree) -> jax.Array:
+def pairwise_sq_dists(stacked_updates: PyTree, valid=None,
+                      tile_size: Optional[int] = None) -> jax.Array:
     """(C, C) squared L2 distances between clients' updates, computed as one
     vmap-ed reduction over the flattened cohort matrix — XLA lowers the
     ``vmap(row . matrix)`` to a single (C, D) x (D, C) matmul (MXU-friendly)
     instead of C² per-pair subtractions. Non-finite entries are zeroed first
     so a NaN upload cannot poison every distance (its row is caught by
-    :func:`sanitize_stacked` / the Krum score penalty instead)."""
+    :func:`sanitize_stacked` / the Krum score penalty instead).
+
+    ``tile_size`` computes the Gram matrix in client-axis row tiles of that
+    size (``lax.map`` over ``(C/t, t, D) @ (D, C)`` blocks): peak live
+    intermediate drops from the full (C, D) x (C, D) product's workspace to
+    one tile's, and under a sharded jit each device only materializes its
+    own row tiles. Must divide C; ``None`` is the original single matmul.
+
+    ``valid`` (HOST bool (C,)) marks real rows of a padded cohort: any
+    distance involving a padded row is +inf (so Krum never counts a pad row
+    among a client's nearest peers), except the diagonal which stays 0.
+    """
     leaves = jax.tree_util.tree_leaves(stacked_updates)
     C = leaves[0].shape[0]
     flat = jnp.concatenate(
@@ -168,24 +209,42 @@ def pairwise_sq_dists(stacked_updates: PyTree) -> jax.Array:
         axis=1,
     )
     sqn = jnp.sum(flat * flat, axis=1)
-    gram = jax.vmap(lambda r: flat @ r)(flat)
-    return jnp.maximum(sqn[:, None] + sqn[None, :] - 2.0 * gram, 0.0)
+    if tile_size is None:
+        gram = jax.vmap(lambda r: flat @ r)(flat)
+    else:
+        t = int(tile_size)
+        if C % t != 0:
+            raise ValueError(f"tile_size={t} must divide cohort size {C}")
+        tiles = flat.reshape(C // t, t, flat.shape[1])
+        gram = jax.lax.map(lambda blk: blk @ flat.T, tiles).reshape(C, C)
+    d = jnp.maximum(sqn[:, None] + sqn[None, :] - 2.0 * gram, 0.0)
+    if valid is not None:
+        v = jnp.asarray(valid)
+        pair_ok = v[:, None] & v[None, :]
+        d = jnp.where(pair_ok, d, jnp.inf)
+        d = jnp.where(jnp.eye(C, dtype=bool), 0.0, d)
+    return d
 
 
-def krum_scores(dists: jax.Array, n_byz: int) -> jax.Array:
+def krum_scores(dists: jax.Array, n_byz: int,
+                n_valid: Optional[int] = None) -> jax.Array:
     """Krum score per client (Blanchard et al. 2017): the sum of its
     ``C - f - 2`` smallest squared distances to OTHER clients (the self
     distance — the zero first column of the row-sorted matrix — is dropped).
-    Lower = better surrounded by honest peers."""
+    Lower = better surrounded by honest peers. ``n_valid`` caps the
+    neighbor count for padded cohorts (pad rows' distances are +inf, so the
+    cap keeps every real client's score finite)."""
     C = dists.shape[0]
-    k = max(1, min(C - n_byz - 2, C - 1))
+    n = C if n_valid is None else int(n_valid)
+    k = max(1, min(n - n_byz - 2, n - 1))
     s = jnp.sort(dists, axis=1)
     return s[:, 1:k + 1].sum(axis=1)
 
 
 def krum_aggregate(stacked_updates: PyTree, weights: jax.Array,
                    n_byz: int = 0, m: int = 1,
-                   sample_weighted: bool = False):
+                   sample_weighted: bool = False, valid=None,
+                   tile_size: Optional[int] = None):
     """Krum-family aggregation, selection fully inside XLA.
 
     ``m=1`` is classic Krum (the single best-surrounded update), ``m>1`` is
@@ -193,10 +252,19 @@ def krum_aggregate(stacked_updates: PyTree, weights: jax.Array,
     (the paper's form) or by sample weight (``sample_weighted=True``,
     FedAvg-over-Krum-survivors). Zero-weight clients (dropped or already
     quarantined) get an infinite score so they can never be selected.
+    ``valid``/``tile_size`` thread through to :func:`pairwise_sq_dists` /
+    :func:`krum_scores` for padded or memory-tiled cohorts.
     Returns ``(aggregate, selected)`` with ``selected`` a (C,) float mask.
     """
-    scores = krum_scores(pairwise_sq_dists(stacked_updates), n_byz)
+    import numpy as _np
+
+    n_valid = None if valid is None else int(_np.asarray(valid, bool).sum())
+    scores = krum_scores(
+        pairwise_sq_dists(stacked_updates, valid=valid, tile_size=tile_size),
+        n_byz, n_valid=n_valid)
     scores = jnp.where(weights > 0, scores, jnp.inf)
+    if valid is not None:
+        scores = jnp.where(jnp.asarray(valid), scores, jnp.inf)
     C = scores.shape[0]
     m = max(1, min(int(m), C))
     _, idx = jax.lax.top_k(-scores, m)
@@ -239,6 +307,9 @@ class RobustAggregator:
     multi_krum_m: Optional[int] = None
     sanitize: bool = False
     z_thresh: float = 6.0
+    # Krum Gram-matrix row-tile size (must divide the cohort size); None =
+    # one full (C, D) x (D, C) matmul. See pairwise_sq_dists.
+    krum_tile: Optional[int] = None
 
     KRUM_FAMILY = ("krum", "multi_krum", "krum_fedavg")
 
@@ -301,6 +372,7 @@ class RobustAggregator:
             f, m = self._krum_fm(C)
             agg, selected = krum_aggregate(
                 stacked_updates, weights, n_byz=f, m=m,
-                sample_weighted=self.defense_type == "krum_fedavg")
+                sample_weighted=self.defense_type == "krum_fedavg",
+                tile_size=self.krum_tile)
             return agg, info()
         raise ValueError(f"unknown defense_type '{self.defense_type}'")

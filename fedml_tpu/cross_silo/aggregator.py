@@ -191,6 +191,9 @@ class FedMLAggregator:
         round-seeded np.random.choice)."""
         if client_num_per_round == len(client_id_list_in_total):
             return list(client_id_list_in_total)
+        # reference parity: fedavg_api.py seeds the global stream per round,
+        # and RoundStateStore resume snapshots exactly this MT19937 state —
+        # graftcheck: disable=determinism
         np.random.seed(round_idx)
         return list(
             np.random.choice(client_id_list_in_total, client_num_per_round, replace=False)

@@ -109,6 +109,13 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
         watchdog_window=int(getattr(args, "watchdog_window", 5)),
         max_rollbacks=int(getattr(args, "max_rollbacks", 2)),
         rollback_z_thresh=float(getattr(args, "rollback_z_thresh", 3.0)),
+        client_state_capacity=(
+            None if getattr(args, "client_state_capacity", None) is None
+            else int(args.client_state_capacity)
+        ),
+        client_state_spill_dir=getattr(args, "client_state_spill_dir", None),
+        client_state_backend=str(getattr(args, "client_state_backend", "arena")),
+        cohort_shard_axis=str(getattr(args, "cohort_shard_axis", AXIS_CLIENT)),
     )
 
     attack_type = getattr(args, "attack_type", None)

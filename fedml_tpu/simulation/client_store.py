@@ -1,0 +1,390 @@
+"""Vectorized, spillable per-client state storage + the shared cohort vmap.
+
+Two things live here, both born from the ROADMAP "million-client cohorts"
+item:
+
+:func:`cohort_local_update`
+    The one vmap that every engine uses to run ``local_update`` across a
+    stacked cohort. ``fed_sim`` maps state over the leading axis with
+    shared params; ``hierarchical``/``decentralized`` map stacked params
+    with shared (empty) state — both are the same call with different
+    ``in_axes``, so the axis plumbing is written (and tested) once.
+
+:class:`ClientStateArena`
+    Per-client algorithm state as leading-axis stacked pytrees in a
+    fixed-capacity device arena. A host-side ``client_id → slot`` map
+    turns cohort gather/scatter into exactly two jitted index ops
+    (``leaf[slots]`` / ``leaf.at[slots].set(rows)``) — no per-client
+    Python loop ever touches a device buffer. When more clients are
+    registered than ``capacity`` slots, least-recently-used rows spill to
+    host RAM and (optionally, past ``host_capacity``) to msgpack files
+    under ``spill_dir``, so 1M registered clients fit while only resident
+    slots occupy HBM. With a mesh, the arena's capacity axis (and every
+    gathered cohort stack) is sharded along ``axis_name``.
+
+Clients that were never scattered read back the prototype state (what
+``init_client_state`` produced), exactly like the legacy dict path's
+"absent key" case.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PyTree = Any
+
+
+def cohort_local_update(local_update, params, client_states, cohort, rngs,
+                        *, params_axis=None, state_axis=0):
+    """Run ``local_update`` vmapped over the cohort's leading axis.
+
+    ``cohort`` (the per-client batch dict) and ``rngs`` always carry the
+    cohort axis; ``params_axis``/``state_axis`` say whether params and
+    client state are shared (``None``) or stacked (``0``) — the federated
+    engine shares params and stacks state, the hierarchical/decentralized
+    engines stack params and share the (empty) state.
+    """
+    return jax.vmap(local_update, in_axes=(params_axis, state_axis, 0, 0))(
+        params, client_states, cohort, rngs)
+
+
+class ClientStateArena:
+    """Fixed-capacity stacked client-state store with LRU spill tiers.
+
+    Device tier:  one ``(capacity, …)`` array per state leaf.
+    Host tier:    evicted rows as numpy leaves (insertion-ordered).
+    Disk tier:    oldest host rows as msgpack files under ``spill_dir``
+                  once the host tier exceeds ``host_capacity``.
+
+    All device traffic is batched: a cohort gather is one jitted ``take``
+    (plus at most one ``take`` + one ``scatter`` to evict/load around it),
+    a cohort scatter is one jitted ``at[slots].set``.
+    """
+
+    def __init__(self, proto: PyTree, capacity: int, *,
+                 spill_dir: Optional[str] = None,
+                 host_capacity: Optional[int] = None,
+                 mesh=None, axis_name: str = "client"):
+        leaves, treedef = jax.tree_util.tree_flatten(proto)
+        if not leaves:
+            raise ValueError("client-state proto has no leaves; the arena "
+                             "is only built for stateful algorithms")
+        if capacity <= 0:
+            raise ValueError(f"client_state_capacity must be > 0, got {capacity}")
+        if host_capacity is not None and spill_dir is None:
+            raise ValueError("host_capacity without spill_dir would drop "
+                             "evicted client state")
+        self._treedef = treedef
+        self._proto_rows: List[np.ndarray] = [np.asarray(l) for l in leaves]
+        self._mesh = mesh
+        self._axis_name = axis_name
+        self.capacity = int(capacity)
+        row_sh = None
+        self._axis_size = 1
+        if mesh is not None:
+            from ..parallel.sharding import shard_along
+            axis_size = int(mesh.shape[axis_name])
+            self._axis_size = axis_size
+            # slots shard evenly over the axis
+            self.capacity = -(-self.capacity // axis_size) * axis_size
+            row_sh = shard_along(mesh, axis_name, 0)
+        self._row_sh = row_sh
+        self._spill_dir = spill_dir
+        self._host_capacity = host_capacity
+
+        # host-side bookkeeping: slot maps + LRU clock
+        self._slot_of: Dict[int, int] = {}
+        self._slot_client = np.full(self.capacity, -1, dtype=np.int64)
+        self._last_used = np.zeros(self.capacity, dtype=np.int64)
+        self._clock = 0
+        self._spilled: "OrderedDict[int, List[np.ndarray]]" = OrderedDict()
+        self._on_disk: set = set()
+
+        self._leaves = [
+            self._to_device(np.zeros((self.capacity,) + p.shape, p.dtype))
+            for p in self._proto_rows
+        ]
+
+        def _take(arena_leaves, slots):
+            return [l[slots] for l in arena_leaves]
+
+        def _put(arena_leaves, slots, rows):
+            return [l.at[slots].set(r) for l, r in zip(arena_leaves, rows)]
+
+        # out_shardings pins cohort stacks / arena leaves to the client
+        # axis; donation lets XLA update the arena in place on scatter
+        self._take_fn = jax.jit(_take, out_shardings=row_sh)
+        self._put_fn = jax.jit(_put, donate_argnums=(0,), out_shardings=row_sh)
+
+    # ------------------------------------------------------------- public
+
+    def gather(self, client_ids: Sequence[int]) -> PyTree:
+        """Stacked states for ``client_ids`` (duplicates allowed), as one
+        jitted take. Loads/evicts around it as needed."""
+        ids = np.asarray(client_ids, dtype=np.int64)
+        slots = self._ensure(ids)
+        stacked = self._take_fn(self._leaves, jnp.asarray(slots, jnp.int32))
+        return jax.tree_util.tree_unflatten(self._treedef, stacked)
+
+    def scatter(self, client_ids: Sequence[int], stacked: PyTree) -> None:
+        """Write stacked rows back for ``client_ids`` (must be unique and
+        resident — i.e. gathered this round) as one jitted scatter."""
+        ids = np.asarray(client_ids, dtype=np.int64)
+        if len(np.unique(ids)) != len(ids):
+            raise ValueError("scatter ids must be unique (slice padding "
+                             "duplicates off before scattering)")
+        rows, treedef = jax.tree_util.tree_flatten(stacked)
+        if treedef != self._treedef:
+            raise ValueError(
+                f"scatter structure {treedef} != arena proto {self._treedef}")
+        try:
+            slots = np.asarray([self._slot_of[int(c)] for c in ids], np.int64)
+        except KeyError as e:
+            raise KeyError(f"scatter of non-resident client {e}; gather the "
+                           "cohort before scattering it") from e
+        self._leaves = list(
+            self._put_fn(self._leaves, jnp.asarray(slots, jnp.int32), rows))
+        self._clock += 1
+        self._last_used[slots] = self._clock
+
+    def state_of(self, client_id: int) -> PyTree:
+        """One client's current state as host numpy (test/debug helper —
+        this is the slow per-client path the arena exists to avoid)."""
+        cid = int(client_id)
+        if cid in self._slot_of:
+            s = self._slot_of[cid]
+            row = [np.asarray(l[s]) for l in self._leaves]
+        elif cid in self._spilled:
+            row = self._spilled[cid]
+        elif cid in self._on_disk:
+            row = self._read_disk(cid)
+        else:
+            row = self._proto_rows
+        return jax.tree_util.tree_unflatten(
+            self._treedef, [np.asarray(r) for r in row])
+
+    @property
+    def resident_count(self) -> int:
+        return len(self._slot_of)
+
+    @property
+    def spilled_count(self) -> int:
+        return len(self._spilled) + len(self._on_disk)
+
+    # ------------------------------------------- watchdog snapshot/restore
+
+    def snapshot(self):
+        """Copy for divergence rollback. The on-disk tier is not
+        snapshotted (the simulator refuses watchdog + spill_dir)."""
+        if self._on_disk:
+            raise RuntimeError("cannot snapshot an arena with on-disk spill")
+        return (
+            [jnp.copy(l) for l in self._leaves],
+            dict(self._slot_of),
+            self._slot_client.copy(),
+            self._last_used.copy(),
+            self._clock,
+            OrderedDict(self._spilled),
+        )
+
+    def restore(self, snap) -> None:
+        leaves, slot_of, slot_client, last_used, clock, spilled = snap
+        # re-copy: scatter donates the arena, which would consume the
+        # snapshot on the retry round
+        self._leaves = [jnp.copy(l) for l in leaves]
+        self._slot_of = dict(slot_of)
+        self._slot_client = slot_client.copy()
+        self._last_used = last_used.copy()
+        self._clock = clock
+        self._spilled = OrderedDict(spilled)
+        self._on_disk = set()
+
+    # ------------------------------------------------- checkpoint support
+
+    def export_state(self) -> dict:
+        """Orbax-safe snapshot: leaves keyed by flat index (msgpack/orbax
+        turn tuples into lists, so structure is rebuilt from the proto
+        treedef on import), disk tier folded into the host tier."""
+        spilled = {
+            str(cid): {str(i): np.asarray(l) for i, l in enumerate(rows)}
+            for cid, rows in self._spilled.items()
+        }
+        for cid in sorted(self._on_disk):
+            spilled[str(cid)] = {
+                str(i): l for i, l in enumerate(self._read_disk(cid))}
+        state = {
+            "leaves": {str(i): np.asarray(l)
+                       for i, l in enumerate(self._leaves)},
+            "slot_client": self._slot_client.copy(),
+            "last_used": self._last_used.copy(),
+            "clock": np.asarray(self._clock, np.int64),
+        }
+        if spilled:
+            state["spilled"] = spilled
+        return state
+
+    def import_state(self, state: dict) -> None:
+        n = len(self._proto_rows)
+        leaves = [np.asarray(state["leaves"][str(i)]) for i in range(n)]
+        if leaves[0].shape[0] != self.capacity:
+            raise ValueError(
+                f"checkpointed arena capacity {leaves[0].shape[0]} != "
+                f"configured {self.capacity}; restore with the same "
+                "client_state_capacity (and mesh axis size) it was saved with")
+        self._leaves = [self._to_device(l) for l in leaves]
+        self._slot_client = np.asarray(state["slot_client"], np.int64).copy()
+        self._last_used = np.asarray(state["last_used"], np.int64).copy()
+        self._clock = int(np.asarray(state["clock"]))
+        self._slot_of = {int(c): int(s)
+                         for s, c in enumerate(self._slot_client) if c >= 0}
+        self._spilled = OrderedDict()
+        self._on_disk = set()
+        for cid in sorted(state.get("spilled") or {}, key=int):
+            entry = state["spilled"][cid]
+            self._spilled[int(cid)] = [
+                np.asarray(entry[str(i)]) for i in range(n)]
+
+    def preload(self, client_id: int, state_tree: PyTree) -> None:
+        """Seed one client's state into the host tier (legacy dict-style
+        checkpoints feeding an arena-backed run)."""
+        rows = [np.asarray(l) for l in jax.tree_util.tree_leaves(state_tree)]
+        if len(rows) != len(self._proto_rows):
+            raise ValueError("preloaded state leaf count != arena proto")
+        self._spilled[int(client_id)] = rows
+        self._spilled.move_to_end(int(client_id))
+
+    # ------------------------------------------------------------ internal
+
+    def _to_device(self, arr: np.ndarray):
+        if self._row_sh is not None:
+            return jax.device_put(arr, self._row_sh)
+        return jnp.asarray(arr)
+
+    def _ensure(self, ids: np.ndarray) -> np.ndarray:
+        """Make every id resident; return their slots (aligned to ids)."""
+        uniq, first = np.unique(ids, return_index=True)
+        uniq = uniq[np.argsort(first)]
+        if len(uniq) > self.capacity:
+            raise ValueError(
+                f"cohort has {len(uniq)} unique clients but the arena holds "
+                f"{self.capacity} slots; raise client_state_capacity")
+        missing = [int(c) for c in uniq if int(c) not in self._slot_of]
+        if missing:
+            free = np.nonzero(self._slot_client < 0)[0]
+            need = len(missing) - len(free)
+            if need > 0:
+                in_cohort = {int(c) for c in uniq}
+                cand = [int(s) for s in np.nonzero(self._slot_client >= 0)[0]
+                        if int(self._slot_client[s]) not in in_cohort]
+                cand.sort(key=lambda s: (self._last_used[s], s))
+                self._evict(np.asarray(cand[:need], np.int64))
+                free = np.nonzero(self._slot_client < 0)[0]
+            self._load(missing, free[:len(missing)])
+        self._clock += 1
+        slots_uniq = np.asarray([self._slot_of[int(c)] for c in uniq], np.int64)
+        self._last_used[slots_uniq] = self._clock
+        return np.asarray([self._slot_of[int(c)] for c in ids], np.int64)
+
+    def _pad_count(self, n: int) -> int:
+        """Next power of two, rounded up to a mesh-axis multiple: evict/load
+        batch sizes vary round to round, so without bucketing every distinct
+        miss count would recompile the jitted take/scatter (~100ms each on
+        CPU, dominating state_gather); on a mesh the batch's leading axis
+        must additionally divide evenly over the sharded row axis."""
+        p = 1
+        while p < n:
+            p <<= 1
+        a = self._axis_size
+        return -(-p // a) * a
+
+    def _evict(self, victim_slots: np.ndarray) -> None:
+        """Spill LRU victims to the host tier in one batched take (padded to
+        a power-of-two count by repeating the last slot — a duplicate read)."""
+        n = len(victim_slots)
+        pslots = np.empty(self._pad_count(n), np.int64)
+        pslots[:n] = victim_slots
+        pslots[n:] = victim_slots[n - 1]
+        rows = self._take_fn(self._leaves, jnp.asarray(pslots, jnp.int32))
+        host = [np.asarray(r) for r in rows]
+        for j, s in enumerate(victim_slots):
+            cid = int(self._slot_client[s])
+            self._spill(cid, [h[j] for h in host])
+            del self._slot_of[cid]
+            self._slot_client[s] = -1
+
+    def _spill(self, cid: int, rows: List[np.ndarray]) -> None:
+        self._spilled[cid] = rows
+        self._spilled.move_to_end(cid)
+        if self._host_capacity is not None:
+            while len(self._spilled) > self._host_capacity:
+                old_cid, old_rows = self._spilled.popitem(last=False)
+                self._write_disk(old_cid, old_rows)
+
+    def _load(self, client_ids: List[int], slots: np.ndarray) -> None:
+        """Fill ``slots`` with spilled/disk/proto rows in one scatter. The
+        batch is padded to a power-of-two count by duplicating the last
+        (slot, row) pair — duplicate indices write identical values, so the
+        scatter result is unchanged while the jit cache stays O(log n)."""
+        n = len(client_ids)
+        width = self._pad_count(n)
+        stacked = [np.empty((width,) + p.shape, p.dtype)
+                   for p in self._proto_rows]
+        for j, cid in enumerate(client_ids):
+            rows = self._fetch_spilled(cid)
+            if rows is None:
+                rows = self._proto_rows
+            for i, r in enumerate(rows):
+                # the msgpack tier can widen scalar leaves to shape (1,)
+                stacked[i][j] = np.asarray(r).reshape(stacked[i].shape[1:])
+        pslots = np.empty(width, np.int64)
+        pslots[:n] = slots[:n]
+        if width > n:
+            pslots[n:] = pslots[n - 1]
+            for s in stacked:
+                s[n:] = s[n - 1]
+        self._leaves = list(self._put_fn(
+            self._leaves, jnp.asarray(pslots, jnp.int32), stacked))
+        for cid, s in zip(client_ids, slots):
+            self._slot_of[cid] = int(s)
+            self._slot_client[s] = cid
+
+    def _fetch_spilled(self, cid: int) -> Optional[List[np.ndarray]]:
+        if cid in self._spilled:
+            return self._spilled.pop(cid)
+        if cid in self._on_disk:
+            rows = self._read_disk(cid)
+            # the file is left in place (stale but inert): only _on_disk
+            # membership makes it authoritative, and keeping it means a
+            # snapshot taken while this client was on disk stays valid
+            self._on_disk.discard(cid)
+            return rows
+        return None
+
+    def _disk_path(self, cid: int) -> str:
+        return os.path.join(self._spill_dir, f"client_{cid}.msgpack")
+
+    def _write_disk(self, cid: int, rows: List[np.ndarray]) -> None:
+        from ..comm.message import pack_payload
+
+        os.makedirs(self._spill_dir, exist_ok=True)
+        blob = pack_payload({str(i): r for i, r in enumerate(rows)})
+        path = self._disk_path(cid)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+        self._on_disk.add(cid)
+
+    def _read_disk(self, cid: int) -> List[np.ndarray]:
+        from ..comm.message import unpack_payload
+
+        with open(self._disk_path(cid), "rb") as f:
+            payload = unpack_payload(f.read())
+        return [np.asarray(payload[str(i)])
+                for i in range(len(self._proto_rows))]

@@ -24,6 +24,7 @@ from ..algorithms.local_sgd import tree_add
 from ..data.federated import FederatedData
 from ..parallel.mesh import AXIS_CLIENT
 from ..parallel.sharding import replicated, shard_along
+from .client_store import cohort_local_update
 from .fed_sim import SimConfig
 
 PyTree = Any
@@ -94,7 +95,8 @@ class DecentralizedSimulator:
                 z = jax.tree.map(
                     lambda p: p / push_w.reshape((-1,) + (1,) * (p.ndim - 1)), stacked
                 )
-                outs = jax.vmap(local_update, in_axes=(0, None, 0, 0))(z, (), cohort, rngs)
+                outs = cohort_local_update(local_update, z, (), cohort, rngs,
+                                           params_axis=0, state_axis=None)
                 updated = tree_add(z, outs.update)
                 # re-weight by w before pushing so mass is conserved
                 x_push = jax.tree.map(
@@ -103,9 +105,9 @@ class DecentralizedSimulator:
                 new_stacked = _mix(x_push, W)
                 new_push_w = W @ push_w
             else:
-                outs = jax.vmap(local_update, in_axes=(0, None, 0, 0))(
-                    stacked, (), cohort, rngs
-                )
+                outs = cohort_local_update(
+                    local_update, stacked, (), cohort, rngs,
+                    params_axis=0, state_axis=None)
                 new_stacked = _mix(tree_add(stacked, outs.update), W)
                 new_push_w = push_w
             # consensus distance: mean_i ||x_i - x_bar||^2 over all leaves

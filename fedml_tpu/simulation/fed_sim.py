@@ -13,9 +13,19 @@ Replaces all three reference simulators (SURVEY.md §2.3):
   broadcast -> schedule -> local train -> SUM reduce) collapsed into one
   compiled program: the broadcast is sharding, the reduce is a psum.
 
-Client sampling reproduces the reference exactly (``fedavg_api.py:129-143``:
-``np.random.seed(round_idx)`` then ``np.random.choice`` without replacement)
-so accuracy curves are comparable round-for-round.
+Client sampling is ``sampling.sample_clients`` — a pure function of
+(seed, round) drawing from a per-round ``np.random.default_rng`` stream, so
+cohorts are reproducible without touching the process-global RNG (the
+reference's global ``np.random.seed(round_idx)`` sampler lives on in
+``sampling.reference_client_sampling`` for the cross-silo server and parity
+harnesses).
+
+Per-client algorithm state (SCAFFOLD control variates etc.) lives in a
+``client_store.ClientStateArena`` when available: a fixed-capacity stacked
+device arena whose cohort gather/scatter is two jitted index ops, with LRU
+spill to host RAM / disk for registries larger than
+``client_state_capacity``. ``client_state_backend="dict"`` keeps the legacy
+per-client host dict as the bit-exactness oracle.
 """
 
 from __future__ import annotations
@@ -35,19 +45,10 @@ from ..data.federated import FederatedData
 from ..algorithms.local_sgd import make_eval_fn
 from ..parallel.mesh import AXIS_CLIENT
 from ..parallel.sharding import replicated, shard_along
+from .client_store import ClientStateArena, cohort_local_update
+from .sampling import reference_client_sampling, sample_clients  # noqa: F401 (re-export)
 
 PyTree = Any
-
-
-def reference_client_sampling(
-    round_idx: int, client_num_in_total: int, client_num_per_round: int
-) -> np.ndarray:
-    """Bit-for-bit the reference ``_client_sampling`` (fedavg_api.py:129-143)."""
-    if client_num_in_total == client_num_per_round:
-        return np.arange(client_num_in_total)
-    num_clients = min(client_num_per_round, client_num_in_total)
-    np.random.seed(round_idx)
-    return np.random.choice(range(client_num_in_total), num_clients, replace=False)
 
 
 @dataclasses.dataclass
@@ -158,6 +159,24 @@ class SimConfig:
     # exclusion threshold on the failed round's robust z-scores; clients at
     # or above it are dropped from the re-run (fallback: the single worst)
     rollback_z_thresh: float = 3.0
+    # --- million-client cohorts ----------------------------------------
+    # client-state arena (simulation/client_store.py): device slots holding
+    # stacked per-client algorithm state, LRU-spilled to host RAM beyond
+    # this many residents. None = every registered client stays resident
+    # (capacity = client_num_in_total). Must be >= client_num_per_round.
+    client_state_capacity: Optional[int] = None
+    # optional on-disk tier for spilled states (msgpack files); when set,
+    # the host-RAM tier is bounded at the device capacity and overflow
+    # goes to disk. Incompatible with the divergence watchdog (rollback
+    # cannot snapshot the disk tier).
+    client_state_spill_dir: Optional[str] = None
+    # "arena" — vectorized gather/scatter (default); "dict" — the legacy
+    # per-client host dict, kept as the bit-exactness oracle
+    client_state_backend: str = "arena"
+    # mesh axis the cohort (batch, stacked states, per-client RNGs, and
+    # the stacked update inside aggregation) shards over; cohorts are
+    # padded to a multiple of this axis' size (zero-weight rows)
+    cohort_shard_axis: str = AXIS_CLIENT
 
 
 @dataclasses.dataclass
@@ -198,9 +217,8 @@ def _cohort_outputs(alg: FedAlgorithm, params, cohort, client_states, rng):
     data = dict(cohort)
     pos = data.pop("pos")
     rngs = jax.vmap(lambda p: jax.random.fold_in(rng, p))(pos)
-    return jax.vmap(alg.local_update, in_axes=(None, 0, 0, 0))(
-        params, client_states, data, rngs
-    )
+    return cohort_local_update(alg.local_update, params, client_states,
+                               data, rngs)
 
 
 class FedSimulator:
@@ -261,6 +279,11 @@ class FedSimulator:
         self._last_qz = None
         self._last_cohort_ids = None
         self._finite_fn = None  # built lazily by the watchdog loop
+        # test hook: when set, the round step calls
+        # jax.debug.inspect_array_sharding on the stacked update / aggregate
+        # and feeds the observed shardings here. None (default) leaves the
+        # traced program untouched.
+        self._sharding_probe: Optional[Callable[[str, Any], None]] = None
 
         sizes = [len(v) for v in fed_data.train_data_local_dict.values()]
         if cfg.num_local_batches is None:
@@ -283,7 +306,8 @@ class FedSimulator:
             else:
                 self._x_dev = jnp.asarray(train.x)
                 self._y_dev = jnp.asarray(train.y)
-        self._axis_size = 1 if mesh is None else int(mesh.shape[AXIS_CLIENT])
+        self._axis_size = (
+            1 if mesh is None else int(mesh.shape[cfg.cohort_shard_axis]))
         self._batch_counts = {
             c: max(1, -(-len(v) // cfg.batch_size))
             for c, v in fed_data.train_data_local_dict.items()
@@ -341,6 +365,59 @@ class FedSimulator:
                 "SCAFFOLD/DP-SGD/BatchNorm (use 'bucketed' or 'auto')")
         self._packed = schedule == "packed"
         self._bucketed = schedule == "bucketed" and mean_agg
+        # even-schedule cohorts are padded to a multiple of the mesh axis
+        # (zero-weight, zero-mask rows duplicating the last client's slot)
+        # so GSPMD shards the client axis evenly. Padded rows are invisible
+        # to the plain weighted mean and to the sanitizer (static valid
+        # mask), but a custom aggregate / injected attack would see them.
+        self._cohort_pad = 0
+        if mesh is not None and not self._packed and not self._bucketed:
+            self._cohort_pad = (-cfg.client_num_per_round) % self._axis_size
+        if self._cohort_pad and (self.alg.aggregate is not None
+                                 or update_transform is not None):
+            raise ValueError(
+                f"client_num_per_round={cfg.client_num_per_round} is not a "
+                f"multiple of the '{cfg.cohort_shard_axis}' mesh axis size "
+                f"({self._axis_size}): cohort padding supports only the "
+                "plain weighted-mean aggregation (a custom aggregate or "
+                "injected attack would see the padded rows) — pick a "
+                "divisible cohort size")
+        if cfg.client_state_backend not in ("arena", "dict"):
+            raise ValueError(
+                f"client_state_backend={cfg.client_state_backend!r} "
+                "(expected 'arena' or 'dict')")
+        self._arena: Optional[ClientStateArena] = None
+        self._prepare_fn = None
+        if (self._client_state_proto != ()
+                and cfg.client_state_backend == "arena"):
+            capacity = cfg.client_state_capacity or cfg.client_num_in_total
+            if capacity < cfg.client_num_per_round:
+                raise ValueError(
+                    f"client_state_capacity={capacity} < "
+                    f"client_num_per_round={cfg.client_num_per_round}: the "
+                    "whole sampled cohort must fit in the arena")
+            if cfg.watchdog_factor > 0 and cfg.client_state_spill_dir:
+                raise ValueError(
+                    "watchdog rollback cannot snapshot the on-disk spill "
+                    "tier — drop client_state_spill_dir or raise "
+                    "client_state_capacity")
+            self._arena = ClientStateArena(
+                self._client_state_proto, capacity,
+                spill_dir=cfg.client_state_spill_dir,
+                host_capacity=(capacity if cfg.client_state_spill_dir
+                               else None),
+                mesh=mesh, axis_name=cfg.cohort_shard_axis)
+            if algorithm.prepare_client_state is not None:
+                # same per-client prepare as the dict path, vectorized over
+                # the stacked cohort (pure restructuring — bit-exact); on a
+                # mesh the output must stay on the cohort axis (vmap can
+                # broadcast server-state-derived leaves to replicated, which
+                # the round step's in_shardings would then reject)
+                prep_sh = (shard_along(mesh, cfg.cohort_shard_axis, 0)
+                           if mesh is not None else None)
+                self._prepare_fn = jax.jit(
+                    jax.vmap(algorithm.prepare_client_state, in_axes=(None, 0)),
+                    **({} if prep_sh is None else {"out_shardings": prep_sh}))
         self._round_step = self._build_round_step()
         if self._packed:
             self._packed_step = self._build_packed_step()
@@ -355,11 +432,35 @@ class FedSimulator:
         transform = self._update_transform
         detect = self._detect
         z_thresh = float(self.cfg.sanitize_z_thresh)
+        pad = self._cohort_pad
+        c_real = int(self.cfg.client_num_per_round)
+        mesh = self.mesh
+        cohort_sh = (shard_along(mesh, self.cfg.cohort_shard_axis, 0)
+                     if mesh is not None else None)
+        # static (host) validity mask over cohort rows: padded rows must be
+        # invisible to the sanitizer's median/MAD (a zero-update row is a
+        # perfectly plausible inlier that would drag the statistics)
+        valid_np = (np.arange(c_real + pad) < c_real) if pad else None
+
+        def _probe(tag, tree):
+            if self._sharding_probe is not None:
+                probe = self._sharding_probe
+                jax.debug.inspect_array_sharding(
+                    jax.tree_util.tree_leaves(tree)[0],
+                    callback=lambda s, tag=tag: probe(tag, s))
 
         def round_body(params, server_state, cohort, client_states, rng):
             outs = _cohort_outputs(alg, params, cohort, client_states, rng)
             update = outs.update
             w = outs.weight.astype(jnp.float32)
+            if mesh is not None:
+                # pin the stacked update to the cohort axis: everything
+                # below reduces over clients, and without the constraint
+                # GSPMD may all-gather the full stack onto every device
+                # before sanitize/Krum/mean see it
+                update = jax.tree.map(
+                    lambda u: jax.lax.with_sharding_constraint(u, cohort_sh),
+                    update)
             # adversarial corruption first, sanitizer second — the defense
             # must see exactly what a byzantine client would upload
             if transform is not None:
@@ -368,25 +469,35 @@ class FedSimulator:
             if detect:
                 from ..core.robust import sanitize_stacked
 
-                update, w, quar, z = sanitize_stacked(update, w, z_thresh)
+                update, w, quar, z = sanitize_stacked(
+                    update, w, z_thresh, valid=valid_np)
                 # one (2, C) row pair [quarantine flag, robust z] rides back
                 # with the metrics — a single extra host transfer per round
                 qz = jnp.stack([quar.astype(jnp.float32),
                                 jnp.nan_to_num(z, posinf=1e30)])
+            _probe("update", update)
             if alg.aggregate is not None:
                 agg = alg.aggregate(update, w)
             else:
                 from ..core.algframe import weighted_mean
 
                 agg = weighted_mean(update, w)
+            _probe("agg", agg)
             new_params, new_server_state = alg.server_update(params, agg, server_state)
             # reduce metrics to ONE tiny vector inside the program: each
             # separate host read is a device round trip (expensive over a
             # tunneled chip), so the round's metrics come back in a single
             # (2,) transfer — [mean train_loss, train_acc]
             m = outs.metrics
+            if pad:
+                # padded rows are zero-loss/zero-valid; divide by the REAL
+                # cohort size so the loss matches the unpadded program
+                loss = (m["train_loss"].sum()
+                        / jnp.float32(c_real)).astype(jnp.float32)
+            else:
+                loss = m["train_loss"].mean().astype(jnp.float32)
             metrics_vec = jnp.stack([
-                m["train_loss"].mean().astype(jnp.float32),
+                loss,
                 (m["train_correct"].sum()
                  / jnp.maximum(m["train_valid"].sum(), 1.0)).astype(jnp.float32),
             ])
@@ -408,9 +519,7 @@ class FedSimulator:
         # donate params/server_state: the old round's buffers are dead the
         # moment the new ones exist — saves an HBM copy of the model per round
         n_extra = 2 if self._use_device_data else 0
-        if self.mesh is not None:
-            mesh = self.mesh
-            cohort_sh = shard_along(mesh, AXIS_CLIENT, 0)
+        if mesh is not None:
             rep = replicated(mesh)
             out_sh = (rep, rep, cohort_sh, rep)
             if detect:
@@ -564,7 +673,7 @@ class FedSimulator:
 
         if self.mesh is not None:
             mesh = self.mesh
-            cohort_sh = shard_along(mesh, AXIS_CLIENT, 0)
+            cohort_sh = shard_along(mesh, self.cfg.cohort_shard_axis, 0)
             rep = replicated(mesh)
             return jax.jit(
                 packed_round,
@@ -598,7 +707,7 @@ class FedSimulator:
 
         n_extra = 2 if self._use_device_data else 0
         if self.mesh is not None:
-            cohort_sh = shard_along(self.mesh, AXIS_CLIENT, 0)
+            cohort_sh = shard_along(self.mesh, self.cfg.cohort_shard_axis, 0)
             rep = replicated(self.mesh)
             return jax.jit(
                 partial_step,
@@ -620,15 +729,17 @@ class FedSimulator:
             )
             return alg.server_update(params, agg, server_state)
 
+        # sum_wu (arg 2) is donated too: the partial sums are dead once the
+        # mean exists, and at model scale they are a full f32 param copy
         if self.mesh is not None:
             rep = replicated(self.mesh)
             return jax.jit(
                 finalize,
                 in_shardings=(rep, rep, rep, rep),
                 out_shardings=(rep, rep),
-                donate_argnums=(0, 1),
+                donate_argnums=(0, 1, 2),
             )
-        return jax.jit(finalize, donate_argnums=(0, 1))
+        return jax.jit(finalize, donate_argnums=(0, 1, 2))
 
     def _build_eval(self, apply_fn):
         eval_fn = make_eval_fn(apply_fn, self.cfg.loss_kind)
@@ -665,6 +776,25 @@ class FedSimulator:
             return
         for i, c in enumerate(client_ids):
             self.client_states[int(c)] = jax.tree.map(lambda x: x[i], stacked_states)
+
+    def _gather_states(self, client_ids: np.ndarray) -> PyTree:
+        """Stacked, prepared cohort states. Arena backend: one jitted take
+        (+ the vectorized prepare); dict backend: the legacy per-client
+        loop, kept as the bit-exactness oracle."""
+        if self._arena is None:
+            return self._cohort_states(client_ids)
+        stacked = self._arena.gather(client_ids)
+        if self._prepare_fn is not None:
+            stacked = self._prepare_fn(self.server_state, stacked)
+        return stacked
+
+    def _scatter_states(self, client_ids: np.ndarray, stacked_states) -> None:
+        if stacked_states == ():
+            return
+        if self._arena is None:
+            self._store_states(client_ids, stacked_states)
+            return
+        self._arena.scatter(client_ids, stacked_states)
 
     def run(self, apply_fn=None, log_fn=print) -> List[Dict[str, float]]:
         cfg = self.cfg
@@ -711,6 +841,7 @@ class FedSimulator:
                 self._phase_acc.append(("pack_wait", pack_wait))
                 step_rng = jax.random.fold_in(base_rng, round_idx)
                 t_disp = time.perf_counter()
+                n_acc = len(self._phase_acc)
                 with self._span("round_dispatch", str(round_idx)):
                     if inputs.kind == "packed":
                         metrics_vec = self._dispatch_packed(inputs, step_rng)
@@ -718,8 +849,12 @@ class FedSimulator:
                         metrics_vec = self._dispatch_bucketed(inputs, step_rng)
                     else:
                         metrics_vec = self._dispatch_even(inputs, step_rng)
+                # the arena's state_gather/state_scatter phases are recorded
+                # inside the dispatch call — exclude them here so the phase
+                # breakdown partitions the round instead of double counting
+                t_inner = sum(dt for _, dt in self._phase_acc[n_acc:])
                 self._phase_acc.append(
-                    ("dispatch", time.perf_counter() - t_disp))
+                    ("dispatch", time.perf_counter() - t_disp - t_inner))
                 timing = {
                     "pack_time": inputs.pack_time,
                     "pack_wait": pack_wait,
@@ -775,15 +910,18 @@ class FedSimulator:
         def snap():
             return (jax.tree.map(jnp.copy, self.params),
                     jax.tree.map(jnp.copy, self.server_state),
-                    dict(self.client_states))
+                    dict(self.client_states),
+                    None if self._arena is None else self._arena.snapshot())
 
         def restore(state):
-            params, server_state, client_states = state
+            params, server_state, client_states, arena_snap = state
             # re-copy: the restored arrays get donated by the next dispatch,
             # and the same snapshot may need restoring again later
             self.params = jax.tree.map(jnp.copy, params)
             self.server_state = jax.tree.map(jnp.copy, server_state)
             self.client_states = dict(client_states)
+            if arena_snap is not None:
+                self._arena.restore(arena_snap)
 
         if self._finite_fn is None:
             self._finite_fn = jax.jit(
@@ -1046,8 +1184,9 @@ class FedSimulator:
         cfg = self.cfg
         t0 = time.perf_counter()
         with self._span("host_pack", str(round_idx)):
-            client_ids = np.asarray(reference_client_sampling(
-                round_idx, cfg.client_num_in_total, cfg.client_num_per_round
+            client_ids = np.asarray(sample_clients(
+                cfg.seed, round_idx,
+                cfg.client_num_in_total, cfg.client_num_per_round,
             ))
             # round-indexed RNG streams: resume at round k reproduces an
             # uninterrupted run exactly
@@ -1096,26 +1235,57 @@ class FedSimulator:
         if drop is not None:
             mask_np = mask_np * (~drop)[:, None, None]
             samples_np = samples_np * (~drop)
+        pad = self._cohort_pad
+        if pad:
+            # shard-aware packing: zero-weight, zero-mask rows bring the
+            # cohort to a multiple of the mesh axis size; the padding mask
+            # rides in as those zeroed weights/masks, and pos keeps counting
+            # so padded rows fold distinct (unused) RNG streams
+            def _zpad(a):
+                return np.concatenate(
+                    [a, np.zeros((pad,) + a.shape[1:], a.dtype)])
+
+            payload = {k: _zpad(v) for k, v in payload.items()}
+            mask_np = _zpad(mask_np)
+            samples_np = _zpad(samples_np)
         payload["mask"] = mask_np
         payload["num_samples"] = samples_np
-        payload["pos"] = np.arange(len(client_ids), dtype=np.uint32)
+        payload["pos"] = np.arange(len(client_ids) + pad, dtype=np.uint32)
         return payload
 
     def _dispatch_even(self, inputs: RoundInputs, step_rng):
         cohort = {k: jnp.asarray(v) for k, v in inputs.payload.items()}
-        states = self._cohort_states(inputs.client_ids)
+        ids = inputs.client_ids
+        pad = self._cohort_pad
+        stateful = self._client_state_proto != ()
+        if stateful:
+            # padded rows re-gather the last client's slot (zero weight/mask
+            # keeps its extra update rows inert); only real rows scatter back
+            gather_ids = ids if not pad else np.concatenate(
+                [ids, np.repeat(ids[-1], pad)])
+            t = time.perf_counter()
+            states = self._gather_states(gather_ids)
+            self._phase_acc.append(("state_gather", time.perf_counter() - t))
+        else:
+            states = ()
         step_args = (self.params, self.server_state, cohort, states, step_rng)
         if self._use_device_data:
             step_args += (self._x_dev, self._y_dev)
         if self._detect:
             (self.params, self.server_state, new_states, metrics_vec,
-             self._last_qz) = self._round_step(*step_args)
-            self._last_cohort_ids = inputs.client_ids
+             qz) = self._round_step(*step_args)
+            self._last_qz = qz if not pad else qz[:, : len(ids)]
+            self._last_cohort_ids = ids
         else:
             self.params, self.server_state, new_states, metrics_vec = (
                 self._round_step(*step_args)
             )
-        self._store_states(inputs.client_ids, new_states)
+        if stateful:
+            t = time.perf_counter()
+            if pad:
+                new_states = jax.tree.map(lambda x: x[: len(ids)], new_states)
+            self._scatter_states(ids, new_states)
+            self._phase_acc.append(("state_scatter", time.perf_counter() - t))
         return metrics_vec
 
     def _packed_lane_plan(self, client_ids: np.ndarray, drop):
@@ -1380,10 +1550,17 @@ class FedSimulator:
         # the single readback so it overlaps the next round's compute
         loss_sum = correct_sum = valid_sum = None
         n_clients = 0
+        stateful = self._client_state_proto != ()
         for bucket in inputs.payload:
             ids, n_real = bucket["ids"], bucket["n_real"]
             cohort = {k: jnp.asarray(v) for k, v in bucket["payload"].items()}
-            states = self._cohort_states(ids)
+            if stateful:
+                t = time.perf_counter()
+                states = self._gather_states(ids)
+                self._phase_acc.append(
+                    ("state_gather", time.perf_counter() - t))
+            else:
+                states = ()
             step_args = (self.params, cohort, states, step_rng)
             if self._use_device_data:
                 step_args += (self._x_dev, self._y_dev)
@@ -1391,10 +1568,13 @@ class FedSimulator:
             sum_wu = swu if sum_wu is None else jax.tree.map(jnp.add, sum_wu, swu)
             total_w = sw if total_w is None else total_w + sw
             if new_states != ():
-                self._store_states(
+                t = time.perf_counter()
+                self._scatter_states(
                     ids[:n_real],
                     jax.tree.map(lambda x: x[:n_real], new_states),
                 )
+                self._phase_acc.append(
+                    ("state_scatter", time.perf_counter() - t))
             ls = mets["train_loss"][:n_real].sum()
             cs = mets["train_correct"][:n_real].sum()
             vs = mets["train_valid"][:n_real].sum()

@@ -25,7 +25,9 @@ from ..algorithms.local_sgd import tree_add
 from ..data.federated import FederatedData
 from ..parallel.mesh import AXIS_CLIENT
 from ..parallel.sharding import replicated, shard_along
-from .fed_sim import SimConfig, reference_client_sampling
+from .client_store import cohort_local_update
+from .fed_sim import SimConfig
+from .sampling import sample_clients
 
 PyTree = Any
 
@@ -75,9 +77,11 @@ class HierarchicalFedSimulator:
             def group_round(gp, round_rng):
                 client_params = jax.tree.map(lambda p: p[group_ids], gp)
                 rngs = jax.random.split(round_rng, C)
-                outs = jax.vmap(local_update, in_axes=(0, None, 0, 0))(
-                    client_params, (), cohort, rngs
-                )
+                # stacked params, shared (empty) state — the same shared
+                # cohort vmap the federated engine uses
+                outs = cohort_local_update(
+                    local_update, client_params, (), cohort, rngs,
+                    params_axis=0, state_axis=None)
                 w = outs.weight.astype(jnp.float32)
                 w_group = jax.ops.segment_sum(w, group_ids, num_segments=G)
                 agg = jax.tree.map(
@@ -125,8 +129,9 @@ class HierarchicalFedSimulator:
         pack_rng = np.random.default_rng(cfg.seed)
         for round_idx in range(cfg.comm_round):
             t0 = time.perf_counter()
-            client_ids = reference_client_sampling(
-                round_idx, cfg.client_num_in_total, cfg.client_num_per_round
+            client_ids = sample_clients(
+                cfg.seed, round_idx,
+                cfg.client_num_in_total, cfg.client_num_per_round,
             )
             # contiguous even split of the cohort into groups
             group_ids = np.concatenate([

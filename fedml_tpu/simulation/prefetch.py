@@ -27,10 +27,14 @@ Contracts:
   dataset never race a background build, and orbax resume stays
   bit-reproducible.
 
-One caveat the purity argument rests on: ``reference_client_sampling`` seeds
-numpy's *global* RNG (bit-parity with the reference), so nothing else may
-consume global ``np.random`` state concurrently with a build. The simulator
-upholds this by pausing the worker around the only user-code hook points.
+Historical caveat, now moot for the simulator: cohort selection used to go
+through ``reference_client_sampling``, which seeds numpy's *global* RNG, so
+builds could not overlap anything else that touched ``np.random``. The
+engine now samples via ``sampling.sample_clients`` (a local
+``default_rng([seed, round])`` stream), so builds share no mutable RNG
+state at all; the worker is still paused around user hook points because
+``test_on_the_server`` code may touch the dataset (or global numpy state of
+its own) mid-build.
 """
 
 from __future__ import annotations

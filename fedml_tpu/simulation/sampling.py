@@ -1,0 +1,62 @@
+"""Client sampling: the engines' pure per-round sampler + the reference one.
+
+:func:`sample_clients` is what the simulation engines use. It is a pure
+function of ``(seed, round_idx)``: each round draws from a fresh
+``np.random.default_rng([seed, round])`` stream, so prefetch workers,
+concurrent engines, and checkpoint-resumed runs all see identical cohorts
+without sharing any global RNG state. The integer population is passed
+straight to ``Generator.choice`` — a 1M-client registry never materializes
+a Python ``range`` list the way the reference sampler did.
+
+:func:`reference_client_sampling` reproduces the reference bit-for-bit
+(``fedavg_api.py:129-143``: global ``np.random.seed(round_idx)`` +
+``np.random.choice`` without replacement). It survives for the cross-silo
+server — whose :class:`~fedml_tpu.utils.checkpoint.RoundStateStore`
+persists the global MT19937 state across restarts and therefore *depends*
+on the global stream — and for reference-parity harnesses
+(``scripts/parity_vs_reference.py`` drives the torch loop with the same
+sampler the engine under test uses).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def sample_clients(
+    seed: int, round_idx: int, client_num_in_total: int,
+    client_num_per_round: int,
+) -> np.ndarray:
+    """Sampled cohort for one round, pure in ``(seed, round_idx)``.
+
+    Full participation short-circuits to ``arange`` (bit-compatible with
+    the reference there). Otherwise the per-round generator is seeded by
+    the SeedSequence fold-in of (seed, round) — two runs of the same
+    config draw identical cohorts, and no process-global stream is read
+    or advanced.
+    """
+    if client_num_in_total == client_num_per_round:
+        return np.arange(client_num_in_total)
+    n = min(client_num_per_round, client_num_in_total)
+    rng = np.random.default_rng([int(seed), int(round_idx)])
+    return rng.choice(int(client_num_in_total), n, replace=False)
+
+
+def reference_client_sampling(
+    round_idx: int, client_num_in_total: int, client_num_per_round: int
+) -> np.ndarray:
+    """Bit-for-bit the reference ``_client_sampling`` (fedavg_api.py:129-143).
+
+    Kept for the cross-silo server (``RoundStateStore`` snapshots the
+    global MT19937 state, so its resume guarantee is defined in terms of
+    this stream) and for parity scripts; the simulation engines use
+    :func:`sample_clients`.
+    """
+    if client_num_in_total == client_num_per_round:
+        return np.arange(client_num_in_total)
+    num_clients = min(client_num_per_round, client_num_in_total)
+    # reference parity requires the reference's process-global MT19937
+    # stream (cross-silo RoundStateStore persists/restores exactly it) —
+    # graftcheck: disable=determinism
+    np.random.seed(round_idx)
+    return np.random.choice(range(client_num_in_total), num_clients, replace=False)

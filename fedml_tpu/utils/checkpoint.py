@@ -114,13 +114,18 @@ class RoundStateStore:
 
 
 def save_simulator_state(manager: CheckpointManager, sim, round_idx: int) -> None:
-    """Persist a FedSimulator's resumable state."""
+    """Persist a FedSimulator's resumable state. Arena-backed runs save the
+    whole arena (device slots + slot map + spilled rows, disk tier folded
+    in); dict-backed runs keep the legacy per-client mapping."""
     state = {
         "params": sim.params,
         "server_state": sim.server_state,
         "round": round_idx,
         "client_states": {str(k): v for k, v in sim.client_states.items()},
     }
+    arena = getattr(sim, "_arena", None)
+    if arena is not None:
+        state["client_arena"] = arena.export_state()
     manager.save(round_idx, state)
 
 
@@ -129,5 +134,15 @@ def restore_simulator_state(manager: CheckpointManager, sim) -> int:
     state = manager.restore()
     sim.params = state["params"]
     sim.server_state = state["server_state"]
-    sim.client_states = {int(k): v for k, v in state.get("client_states", {}).items()}
+    arena = getattr(sim, "_arena", None)
+    if arena is not None and state.get("client_arena") is not None:
+        arena.import_state(state["client_arena"])
+    elif arena is not None:
+        # legacy dict-style checkpoint feeding an arena-backed run: seed the
+        # host spill tier; rows promote to device slots on first gather
+        for k, v in (state.get("client_states") or {}).items():
+            arena.preload(int(k), v)
+    else:
+        sim.client_states = {
+            int(k): v for k, v in state.get("client_states", {}).items()}
     return int(state["round"]) + 1

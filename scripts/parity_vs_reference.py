@@ -9,8 +9,12 @@ per-round train-loss curves and the final global parameters agree to f32
 tolerance.
 
 Reference semantics replicated on the torch side:
-- client sampling: ``simulation/sp/fedavg/fedavg_api.py:129-143``
-  (``np.random.seed(round_idx)`` then no-replacement ``np.random.choice``)
+- client sampling: the engine's pure per-round sampler
+  (``fedml_tpu.simulation.sampling.sample_clients`` — a
+  ``default_rng([seed, round])`` no-replacement draw; the reference's
+  global ``np.random.seed(round_idx)`` stream survives as
+  ``reference_client_sampling`` for the cross-silo server, but the
+  simulation engines no longer consume it)
 - local training: ``simulation/sp/fedavg/my_model_trainer_classification.py:15``
   (plain SGD, mean-reduction CE on logits, fixed batch order, ``epochs`` passes)
 - aggregation: ``fedavg_api.py:156-171`` (sample-count weighted mean over the
@@ -212,12 +216,13 @@ def run_torch_reference(model_name, flax_init, x, y, idx_map, n_classes,
         return out
 
     for round_idx in range(rounds):
-        # fedavg_api.py:129-143 sampling, bit-for-bit
-        if n_total == per_round:
-            cohort = np.arange(n_total)
-        else:
-            np.random.seed(round_idx)
-            cohort = np.random.choice(range(n_total), per_round, replace=False)
+        # lockstep with the engine's pure per-round sampler (the engine
+        # moved off the reference's global np.random.seed(round_idx) stream;
+        # parity means drawing the SAME cohorts the engine draws)
+        from fedml_tpu.simulation.sampling import sample_clients
+
+        cohort = np.asarray(
+            sample_clients(seed, round_idx, n_total, per_round))
         w_locals, client_losses = [], []
         for cid in cohort:
             model.load_state_dict(copy.deepcopy(w_global))
@@ -325,7 +330,7 @@ def main():
             "not an algorithm-semantics difference, and it drifts the CNN "
             "case past tolerance over rounds)"),
         "basis": (
-            "reference FedAvg semantics (sampling fedavg_api.py:129-143, "
+            "reference FedAvg semantics (engine sample_clients cohorts, "
             "trainer my_model_trainer_classification.py:15, aggregation "
             "fedavg_api.py:156-171) replicated in torch on this CPU vs the "
             "fedml_tpu jitted engine; identical data/init/sampling/batch "

@@ -18,5 +18,9 @@ def time_seeded():
     return np.random.default_rng(int(time.time()))
 
 
+def reseed_global():
+    np.random.seed(0)
+
+
 def cohort_order(client_ids):
     return list(set(client_ids))

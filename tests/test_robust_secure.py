@@ -151,6 +151,83 @@ def test_sanitize_uniform_cohort_no_false_positives():
     np.testing.assert_allclose(np.asarray(w), 1.0)
 
 
+def test_sanitize_valid_mask_matches_subset_run():
+    """Padded (invalid) rows must not shift the median/MAD statistics: a
+    masked 8-row cohort sanitizes identically to the 6-row subset, and the
+    pad rows come back unquarantined with z=0."""
+    rows = [{"w": jnp.ones(8) * v} for v in (0.9, 1.0, 1.1, 1.0, 0.95, 1e4)]
+    # zero pad rows: perfectly plausible "inliers" that would drag the
+    # median/MAD if counted (the failure mode the mask exists to prevent)
+    pads = [{"w": jnp.zeros(8)}] * 2
+    stacked = _stack(rows + pads)
+    valid = jnp.asarray([True] * 6 + [False] * 2)
+    weights = jnp.asarray([1.0] * 6 + [0.0] * 2)  # pads pre-zeroed upstream
+    clean, w, quar, z = sanitize_stacked(stacked, weights, valid=valid)
+    c_s, w_s, quar_s, z_s = sanitize_stacked(_stack(rows), jnp.ones(6))
+    np.testing.assert_array_equal(np.asarray(quar)[:6], np.asarray(quar_s))
+    np.testing.assert_array_equal(np.asarray(clean["w"])[:6],
+                                  np.asarray(c_s["w"]))
+    np.testing.assert_array_equal(np.asarray(z)[:6], np.asarray(z_s))
+    np.testing.assert_array_equal(np.asarray(w)[:6], np.asarray(w_s))
+    # pad rows: never quarantined (the padding weight mask already zeroes
+    # them), z pinned to 0 so they can't trip callers' z-based logging
+    assert not np.asarray(quar)[6:].any()
+    np.testing.assert_array_equal(np.asarray(z)[6:], 0.0)
+    np.testing.assert_array_equal(np.asarray(w)[6:], 0.0)
+
+
+def test_pairwise_dists_tiled_matches_untiled():
+    """The client-axis tiling (how the sharded Krum path bounds the C x C
+    distance matrix working set) is exact, and a non-divisor tile is a
+    hard error."""
+    import pytest
+
+    rng = np.random.default_rng(1)
+    stacked = {"w": jnp.asarray(rng.normal(size=(8, 5)).astype(np.float32))}
+    base = np.asarray(pairwise_sq_dists(stacked))
+    for t in (1, 2, 4, 8):
+        np.testing.assert_allclose(
+            np.asarray(pairwise_sq_dists(stacked, tile_size=t)), base,
+            rtol=1e-5)
+    with pytest.raises(ValueError, match="tile_size"):
+        pairwise_sq_dists(stacked, tile_size=3)
+
+
+def test_pairwise_dists_valid_mask_isolates_pads():
+    rng = np.random.default_rng(2)
+    stacked = {"w": jnp.asarray(rng.normal(size=(6, 4)).astype(np.float32))}
+    valid = jnp.asarray([True] * 4 + [False] * 2)
+    d = np.asarray(pairwise_sq_dists(stacked, valid=valid))
+    base = np.array(pairwise_sq_dists({"w": stacked["w"][:4]}))
+    # the valid path pins its diagonal to exactly 0; the plain path leaves
+    # fp residue there — compare off-diagonal entries
+    np.fill_diagonal(base, 0.0)
+    np.testing.assert_allclose(d[:4, :4], base, rtol=1e-5)
+    # any pair touching a pad row is pushed to +inf (never a Krum
+    # neighbour), except the self-distance diagonal which stays 0
+    assert np.isinf(d[4:, :4]).all() and np.isinf(d[:4, 4:]).all()
+    np.testing.assert_array_equal(np.diag(d), 0.0)
+
+
+def test_krum_valid_mask_matches_subset_selection():
+    """Krum on a padded cohort (valid mask + n_valid-adjusted neighbour
+    count) selects the same clients and aggregates to the same value as
+    Krum on the unpadded subset."""
+    honest = [{"w": jnp.ones(6) * (1.0 + 0.01 * i)} for i in range(7)]
+    byz = [{"w": jnp.ones(6) * 100.0}, {"w": jnp.ones(6) * -80.0}]
+    stacked9 = _stack(honest + byz)
+    agg9, sel9 = krum_aggregate(stacked9, jnp.ones(9), n_byz=2, m=3)
+    pads = [{"w": jnp.full(6, 7e7)}] * 3
+    stacked12 = _stack(honest + byz + pads)
+    valid = jnp.asarray([True] * 9 + [False] * 3)
+    agg12, sel12 = krum_aggregate(stacked12, jnp.ones(12), n_byz=2, m=3,
+                                  valid=valid, tile_size=4)
+    np.testing.assert_array_equal(np.asarray(sel12)[:9], np.asarray(sel9))
+    assert not np.asarray(sel12)[9:].any()
+    np.testing.assert_allclose(np.asarray(agg12["w"]),
+                               np.asarray(agg9["w"]), rtol=1e-5)
+
+
 def test_weighted_trimmed_mean_matches_oracle():
     x = np.array([[-50.0], [1.0], [2.0], [3.0], [60.0]], np.float32)
     w = np.array([9.0, 1.0, 2.0, 3.0, 9.0], np.float32)

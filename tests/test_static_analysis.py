@@ -98,6 +98,7 @@ def test_determinism_fires_on_bad_fixture():
     assert "make_rng:unseeded:default_rng" in keys
     assert "make_py_rng:unseeded:Random" in keys
     assert "time_seeded:time-seed:default_rng" in keys
+    assert "reseed_global:global-seed" in keys
     assert "cohort_order:set-order" in keys
 
 
