@@ -17,6 +17,10 @@ anywhere in ``fedml_tpu/``:
   mutates the process-global stream: any draw a library makes in between
   shifts every later cohort, so replays stop being a pure function of
   (seed, round); construct a local ``default_rng((seed, round))`` instead;
+- **unseeded stochastic rounding** — ``stochastic_quantize`` /
+  ``stochastic_key`` / ``build_stacked_roundtrip`` (comm/codec.py) called
+  with the seed omitted or ``None``: the codec has no global-RNG fallback,
+  so a missing seed collapses every client onto one rounding stream;
 - **set-order dependence** — iterating a ``set``/``frozenset``
   expression (or materialising one via ``list()``/``tuple()``/
   ``enumerate()``/``.join()``) leaks Python's per-process hash ordering
@@ -39,6 +43,17 @@ RNG_CONSTRUCTORS = {
     "default_rng", "RandomState", "Random", "SeedSequence", "PRNGKey", "key",
 }
 TIME_SOURCES = ("time.", "datetime.", "os.urandom", "uuid.")
+
+# codec stochastic-rounding entry points (comm/codec.py) and the positional
+# index of their ``seed`` parameter. The seed feeds the counter-hash key
+# chain; omitting it or passing a literal ``None`` collapses every client
+# onto one rounding stream and silently breaks the numpy<->XLA bit-parity
+# contract the simulator/cross-silo parity tests rely on.
+STOCHASTIC_ROUND_FNS = {
+    "stochastic_key": 0,            # (seed, round_idx, client_id, ...)
+    "build_stacked_roundtrip": 1,   # (spec, seed)
+    "stochastic_quantize": 2,       # (vals, bits, seed, round_idx, ...)
+}
 
 
 def _is_set_expr(node: ast.AST) -> bool:
@@ -114,6 +129,30 @@ class DeterminismChecker(Checker):
                             "explicit seed so replays are bit-identical")
                     for s in seeds:
                         if _contains_time_source(s):
+                            add(node, f"time-seed:{simple}",
+                                f"time/entropy-derived seed in {fname}(...) "
+                                "defeats replay determinism")
+                if simple in STOCHASTIC_ROUND_FNS:
+                    pos = STOCHASTIC_ROUND_FNS[simple]
+                    seeds = [kw.value for kw in node.keywords
+                             if kw.arg == "seed"]
+                    starred = any(isinstance(a, ast.Starred)
+                                  for a in node.args[:pos + 1])
+                    if not seeds and not starred and len(node.args) > pos:
+                        seeds = [node.args[pos]]
+                    has_kwsplat = any(kw.arg is None for kw in node.keywords)
+                    if not seeds and not starred and not has_kwsplat:
+                        add(node, f"stochastic-unseeded:{simple}",
+                            f"{fname}(...) called without a seed — stochastic "
+                            "rounding has no global-RNG fallback; pass the "
+                            "run seed so replays are bit-identical")
+                    for s in seeds:
+                        if isinstance(s, ast.Constant) and s.value is None:
+                            add(node, f"stochastic-unseeded:{simple}",
+                                f"{fname}(..., seed=None) — stochastic "
+                                "rounding needs an explicit integer seed; "
+                                "None is not a deterministic key")
+                        elif _contains_time_source(s):
                             add(node, f"time-seed:{simple}",
                                 f"time/entropy-derived seed in {fname}(...) "
                                 "defeats replay determinism")

@@ -268,11 +268,15 @@ def run(config_file, backend):
 @click.option("--defend/--no-defend", default=True,
               help="Byzantine scenario: run with sanitizer + multi-Krum "
                    "(default) or undefended (shows the damage).")
+@click.option("--codec", default=None, metavar="SPEC",
+              help="Run the drill with the compressed update plane on "
+                   "(comm_codec spec, e.g. 'delta|topk:0.01|q8' or 'q8') — "
+                   "proves faults on compressed frames are absorbed.")
 @click.option("--timeout", default=120.0, type=float,
               help="Hang bound: the drill fails if the run outlives this.")
 def chaos_drill(seed, rounds, clients, drop_rate, duplicate_rate,
                 fail_send_rate, crash_rank, crash_at_round, byzantine_kind,
-                byzantine_rate, byzantine_scale, defend, timeout):
+                byzantine_rate, byzantine_scale, defend, codec, timeout):
     """Stand up a full cross-silo deployment (server + clients, real codec,
     real round FSM) under the given fault plan and verify every round still
     closes. Exits 1 if the run hangs or loses rounds — the same check
@@ -296,9 +300,23 @@ def chaos_drill(seed, rounds, clients, drop_rate, duplicate_rate,
         if defend:
             kw.update(defense_type="multi_krum", sanitize_updates=True,
                       watchdog_factor=2.0)
+    if codec is not None:
+        # validate the spec before standing up a whole deployment
+        from ..comm.codec import parse_codec_spec
+
+        parse_codec_spec(codec)
+        kw.update(comm_codec=codec)
+    from ..core import telemetry
+    if codec is not None and not telemetry.enabled():
+        # the codec verdict reads fedml_codec_* counter deltas
+        telemetry.configure(enabled=True)
     result = run_chaos_drill(join_timeout_s=timeout, **kw)
     click.echo(result.summary())
     if not result.ok:
+        raise SystemExit(1)
+    if codec is not None and not result.codec_bytes_wire:
+        click.echo("codec drill: FAIL — comm_codec was set but no "
+                   "fedml_codec_* traffic was recorded")
         raise SystemExit(1)
 
 

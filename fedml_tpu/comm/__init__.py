@@ -8,6 +8,15 @@ replacing the reference's ``core/distributed/communication`` stack
 """
 
 from .base import BaseCommunicationManager, Observer
+from .codec import (
+    CodecSpec,
+    UpdateCodec,
+    decode_tree,
+    encode_tree,
+    parse_codec_spec,
+    resolve_codec_spec,
+    resolve_downlink_spec,
+)
 from .message import (
     Message,
     compress_tree,
@@ -33,6 +42,9 @@ __all__ = [
     "BaseCommunicationManager", "Observer",
     "Message", "pack_payload", "unpack_payload",
     "compress_tree", "decompress_tree", "is_compressed",
+    "CodecSpec", "UpdateCodec", "parse_codec_spec",
+    "encode_tree", "decode_tree",
+    "resolve_codec_spec", "resolve_downlink_spec",
     "LoopbackCommManager", "LoopbackHub", "get_default_hub",
     "ClientManager", "FedMLCommManager", "ServerManager", "create_comm_backend",
     "MqttS3CommManager", "MqttS3MnnCommManager", "PubSubBroker", "InProcessBroker", "FileSystemBroker",

@@ -1,0 +1,713 @@
+"""Composable update-compression pipeline (the "compressed update plane").
+
+Promotes the bare int8 ``compress_tree`` (message.py) into a first-class codec
+with three composable stages selected by a spec string, e.g.
+``delta|topk:0.01|q8``:
+
+``delta``
+    Subtract the round-base params from the payload before later stages.
+    Engages only when the encoder is given an explicit ``base`` tree —
+    cross-silo *uplink* updates are already round-base deltas (the trainer
+    ships ``local - global``), so there the stage is a documented passthrough.
+    Arithmetic runs in float64 so ``decode(encode(x)) == x`` bit-exactly for
+    float32 inputs when delta is the terminal stage.
+
+``topk:<rho>``
+    Per-leaf magnitude top-k sparsification, ``k = ceil(rho * n)``, with
+    error-feedback residuals: the total lossy error of this round (top-k +
+    quantization) is carried into the next round's payload, which is what
+    makes aggressive sparsification converge (EF-SGD). Residuals are owned by
+    the *encoder* side — per-client dicts in cross-silo, the
+    ``ClientStateArena`` in the simulator — and never travel on the wire.
+    Ties are broken by a stable argsort of ``-|x|`` so numpy and JAX select
+    identical coordinates.
+
+``q8`` / ``q4``
+    Stochastic int8/int4 quantization with per-256-element absmax scales
+    (same chunking as the native codec). Rounding noise comes from a
+    counter-based hash keyed on ``(seed, round, client, leaf, element)`` —
+    no global RNG, bit-identical between the numpy wire path and the JAX
+    simulator path, deterministic per (seed, round, client). int4 values are
+    nibble-packed for the wire via the native library (numpy fallback).
+
+Decode is fully context-free for uplink frames (no RNG, no residuals; a
+``base`` is only needed when the encoder actually applied delta), which is
+what lets ``FaultyCommManager``'s decompress-then-corrupt byzantine path and
+the server's decompress -> sanitize -> aggregate ordering compose unchanged.
+Server *broadcasts* must stay stateless (they fan out to many receivers and
+must survive drops/rejoins), so the downlink policy keeps only the
+quantization stage of a spec — see ``resolve_downlink_spec``.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import zlib
+from dataclasses import dataclass
+from typing import Any, Dict, List, MutableMapping, Optional, Tuple
+
+import numpy as np
+
+from .message import (
+    _dtype_token,
+    _resolve_dtype,
+    _tree_flatten_named,
+    _tree_unflatten_named,
+)
+
+CODEC_FRAME_KEY = "__codec__"
+CODEC_FRAME_VERSION = 1
+
+# Same chunk length as native quantize_i8 so scale tensors are interchangeable.
+_QCHUNK = 256
+# Leaves smaller than this ship raw (scales + index overhead would dominate).
+_MIN_LEAF = 64
+
+# Per-backend defaults for ``comm_codec: auto`` — blob-per-message backends
+# (MQTT+S3) pay per byte on the WAN and want the full pipeline; socket
+# backends default to plain quantization; loopback is in-process so
+# compression is pure overhead.
+BACKEND_DEFAULT_SPECS: Dict[str, Optional[str]] = {
+    "MQTT_S3": "delta|topk:0.01|q8",
+    "MQTT_S3_MNN": "delta|topk:0.01|q8",
+    "GRPC": "q8",
+    "TRPC": "q8",
+    "LOOPBACK": None,
+}
+
+
+# --------------------------------------------------------------------------
+# spec grammar
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class CodecSpec:
+    """Parsed ``comm_codec`` spec. Stage order is canonical:
+    delta -> topk -> quant (each optional, quant at most one of q8/q4)."""
+
+    text: str
+    delta: bool = False
+    topk: Optional[float] = None
+    bits: Optional[int] = None
+
+    @property
+    def bound(self) -> int:
+        return {8: 127, 4: 7}[self.bits]
+
+
+def parse_codec_spec(spec: str) -> CodecSpec:
+    """Parse and validate a spec string like ``delta|topk:0.01|q8``.
+
+    Raises ValueError on unknown stages, out-of-range top-k fractions,
+    duplicate stages, or non-canonical stage order.
+    """
+    text = str(spec).strip()
+    if not text:
+        raise ValueError("empty codec spec")
+    delta = False
+    topk: Optional[float] = None
+    bits: Optional[int] = None
+    # canonical position of the last stage seen; order must be non-decreasing
+    last_pos = -1
+    for stage in text.split("|"):
+        stage = stage.strip()
+        if stage == "delta":
+            pos = 0
+            if delta:
+                raise ValueError(f"duplicate stage 'delta' in codec spec {text!r}")
+            delta = True
+        elif stage.startswith("topk:"):
+            pos = 1
+            if topk is not None:
+                raise ValueError(f"duplicate stage 'topk' in codec spec {text!r}")
+            try:
+                topk = float(stage.split(":", 1)[1])
+            except (IndexError, ValueError):
+                raise ValueError(f"bad top-k fraction in codec spec {text!r}")
+            if not (0.0 < topk <= 1.0):
+                raise ValueError(
+                    f"top-k fraction must be in (0, 1], got {topk} in {text!r}")
+        elif stage in ("q8", "q4"):
+            pos = 2
+            if bits is not None:
+                raise ValueError(f"duplicate quant stage in codec spec {text!r}")
+            bits = 8 if stage == "q8" else 4
+        else:
+            raise ValueError(
+                f"unknown codec stage {stage!r} in spec {text!r} "
+                "(expected delta, topk:<frac>, q8, or q4)")
+        if pos < last_pos:
+            raise ValueError(
+                f"codec stages out of order in {text!r}: "
+                "canonical order is delta|topk:<frac>|q8")
+        last_pos = pos
+    return CodecSpec(text=text, delta=delta, topk=topk, bits=bits)
+
+
+_quantize_warned = False
+
+
+def resolve_codec_spec(args: Any, backend: Optional[str] = None) -> Optional[str]:
+    """Resolve the effective uplink codec spec from config.
+
+    Precedence: explicit ``comm_codec`` ("none"/"off" disables, "auto" picks
+    the per-backend default) > deprecated ``comm_quantize: true`` (maps to
+    "q8" with a one-time warning) > None (codec disabled; wire traffic is
+    byte-identical to a build without this module).
+    """
+    global _quantize_warned
+    spec = getattr(args, "comm_codec", None)
+    if spec is not None:
+        spec = str(spec).strip()
+        if spec.lower() in ("", "none", "off"):
+            return None
+        if spec.lower() == "auto":
+            b = (backend or str(getattr(args, "backend", "LOOPBACK"))).upper()
+            spec = BACKEND_DEFAULT_SPECS.get(b)
+            if spec is None:
+                return None
+        parse_codec_spec(spec)  # validate at config time, not mid-round
+        return spec
+    if getattr(args, "comm_quantize", False):
+        if not _quantize_warned:
+            _quantize_warned = True
+            logging.warning(
+                "comm_quantize is deprecated; use comm_codec: \"q8\" "
+                "(mapping applied automatically)")
+        return "q8"
+    return None
+
+
+def downlink_spec(uplink: Optional[str]) -> Optional[str]:
+    """Stateless projection of an uplink spec for server broadcasts: keep
+    only the quantization stage. delta/topk carry per-receiver encoder state
+    (bases, residuals) that cannot survive drops/rejoins on a fan-out path."""
+    if not uplink:
+        return None
+    cs = parse_codec_spec(uplink)
+    if cs.bits == 8:
+        return "q8"
+    if cs.bits == 4:
+        return "q4"
+    return None
+
+
+def resolve_downlink_spec(args: Any, uplink: Optional[str]) -> Optional[str]:
+    """Downlink (broadcast) spec: ``comm_codec_downlink`` when set
+    ("none" disables, "auto" projects the uplink spec), else the stateless
+    projection of the uplink spec. Stateful stages are rejected."""
+    explicit = getattr(args, "comm_codec_downlink", None)
+    if explicit is None:
+        return downlink_spec(uplink)
+    text = str(explicit).strip()
+    if text.lower() in ("", "none", "off"):
+        return None
+    if text.lower() == "auto":
+        return downlink_spec(uplink)
+    cs = parse_codec_spec(text)
+    if cs.delta or cs.topk is not None:
+        raise ValueError(
+            f"comm_codec_downlink={text!r}: broadcast codecs must be "
+            "stateless (quantization only); delta/topk are uplink stages")
+    return text
+
+
+# --------------------------------------------------------------------------
+# counter-based RNG for stochastic rounding (numpy <-> JAX bit parity)
+# --------------------------------------------------------------------------
+# lowbias32 finalizer. Scalars mix in python ints (numpy scalar uint ops warn
+# on overflow); arrays mix in uint32 with silent C-style wraparound, using the
+# exact same constants, so both worlds produce identical streams.
+
+_MIX_C1 = 0x7FEB352D
+_MIX_C2 = 0x846CA68B
+_KEY_SALT = 0x9E3779B9
+_U32 = 0xFFFFFFFF
+
+
+def _mix32_py(x: int) -> int:
+    x &= _U32
+    x ^= x >> 16
+    x = (x * _MIX_C1) & _U32
+    x ^= x >> 15
+    x = (x * _MIX_C2) & _U32
+    x ^= x >> 16
+    return x
+
+
+def _mix32_arr(x, xp):
+    x = x ^ (x >> xp.uint32(16))
+    x = x * xp.uint32(_MIX_C1)
+    x = x ^ (x >> xp.uint32(15))
+    x = x * xp.uint32(_MIX_C2)
+    x = x ^ (x >> xp.uint32(16))
+    return x
+
+
+def _leaf_hash(path: str) -> int:
+    return zlib.crc32(path.encode("utf-8")) & _U32
+
+
+def stochastic_key(seed: int, round_idx: int, client_id: int,
+                   leaf_hash: int = 0) -> int:
+    """Per-(seed, round, client, leaf) base key for stochastic rounding.
+    Rounding is deterministic given this tuple — there is no fallback to a
+    global RNG, so every call site must supply a real seed."""
+    h = (int(seed) ^ _KEY_SALT) & _U32
+    for t in (round_idx, client_id, leaf_hash):
+        h = _mix32_py(h ^ (int(t) & _U32))
+    return h
+
+
+def _uniform_u01(idx_u32, base_u32, xp):
+    """Hash (element index XOR base key) -> f32 uniform in [0, 1)."""
+    h = _mix32_arr(idx_u32 ^ base_u32, xp)
+    return (h >> xp.uint32(8)).astype(xp.float32) * xp.float32(1.0 / (1 << 24))
+
+
+# --------------------------------------------------------------------------
+# quantization core (shared arithmetic; numpy wire path + batched JAX path)
+# --------------------------------------------------------------------------
+
+def _pad_len(m: int) -> int:
+    return -(-m // _QCHUNK) * _QCHUNK
+
+
+# Power-of-two scale exponents: the per-chunk scale is 2^(ea - _EB[bits])
+# where 2^(ea-1) <= absmax < 2^ea (frexp), so |q| <= 2^_EB[bits] <= bound.
+# Pow2 scales make every op in the quant pipeline exact arithmetic except the
+# single rounding in (v/s + u) — which is why the numpy wire path and the
+# jitted XLA path are bit-identical: reciprocal-multiply and FMA rewrites
+# cannot perturb exact products, where a free absmax/bound scale diverges by
+# 1 ulp under XLA's division rewrite. Cost: scales are 2-4x coarser than
+# absmax/bound (roughly one bit of precision), still well inside the error
+# budget the tests pin down.
+_EB = {8: 6, 4: 2}
+
+
+def _pow2_scales(amax, eb: int, xp):
+    _, ea = xp.frexp(amax)
+    s = xp.ldexp(xp.float32(1.0), ea - eb)
+    return xp.where(amax > 0, s, xp.float32(1.0)).astype(xp.float32)
+
+
+def stochastic_quantize(vals: np.ndarray, bits: int,
+                        seed: int, round_idx: int, client_id: int,
+                        leaf_hash: int = 0,
+                        ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Stochastically round a f32 vector to int8/int4 levels with per-256
+    absmax scales. Returns (q int8, scales f32, decoded f32). Deterministic
+    per (seed, round_idx, client_id, leaf_hash) — see ``stochastic_key``."""
+    spec_bound = np.float32({8: 127, 4: 7}[bits])
+    vals = np.ascontiguousarray(vals, np.float32).ravel()
+    m = vals.size
+    mpad = _pad_len(m)
+    nc = mpad // _QCHUNK
+    base = np.uint32(stochastic_key(seed, round_idx, client_id, leaf_hash))
+    u = _uniform_u01(np.arange(mpad, dtype=np.uint32), base, np)
+    vp = np.zeros(mpad, np.float32)
+    vp[:m] = vals
+    blk = vp.reshape(nc, _QCHUNK)
+    amax = np.abs(blk).max(axis=1)
+    s = _pow2_scales(amax, _EB[bits], np)
+    q = np.clip(np.floor(blk / s[:, None] + u.reshape(nc, _QCHUNK)),
+                -spec_bound, spec_bound).astype(np.int8)
+    dec = (q.astype(np.float32) * s[:, None]).reshape(-1)[:m]
+    return q.reshape(-1)[:m], s, dec
+
+
+def dequantize(q: np.ndarray, scales: np.ndarray, m: int) -> np.ndarray:
+    """Inverse of ``stochastic_quantize`` (context-free: ints + scales only)."""
+    mpad = _pad_len(m)
+    qp = np.zeros(mpad, np.float32)
+    qp[:m] = np.asarray(q, np.int8).astype(np.float32)[:m]
+    blk = qp.reshape(-1, _QCHUNK)
+    return (blk * np.asarray(scales, np.float32)[:, None]).reshape(-1)[:m]
+
+
+def pack_int4(q: np.ndarray) -> np.ndarray:
+    """Pack int8 values in [-7, 7] two-per-byte (bias +8 -> high/low nibble).
+    Odd lengths pad with the zero level. Native path with numpy fallback."""
+    from .. import native
+
+    q = np.ascontiguousarray(q, np.int8)
+    n = q.size
+    out = np.empty((n + 1) // 2, np.uint8)
+    lib = native.get_lib()
+    if lib is not None and hasattr(lib, "pack_i4") and n:
+        lib.pack_i4(q.ctypes.data, n, out.ctypes.data)
+        return out
+    b = (q.astype(np.int16) + 8).astype(np.uint8)
+    if n % 2:
+        b = np.concatenate([b, np.uint8([8])])
+    return ((b[0::2] << 4) | b[1::2]).astype(np.uint8)
+
+
+def unpack_int4(packed: np.ndarray, n: int) -> np.ndarray:
+    """Inverse of ``pack_int4``: ceil(n/2) bytes -> n int8 values."""
+    from .. import native
+
+    packed = np.ascontiguousarray(packed, np.uint8)
+    out = np.empty(n, np.int8)
+    lib = native.get_lib()
+    if lib is not None and hasattr(lib, "unpack_i4") and n:
+        lib.unpack_i4(packed.ctypes.data, n, out.ctypes.data)
+        return out
+    hi = (packed >> 4).astype(np.int8) - 8
+    lo = (packed & 0x0F).astype(np.int8) - 8
+    inter = np.empty(2 * packed.size, np.int8)
+    inter[0::2] = hi
+    inter[1::2] = lo
+    return inter[:n]
+
+
+# --------------------------------------------------------------------------
+# tree codec (wire path, numpy)
+# --------------------------------------------------------------------------
+
+def _compressible(arr: np.ndarray) -> bool:
+    if arr.size < _MIN_LEAF:
+        return False
+    if arr.dtype.kind == "f":
+        return True
+    # ml_dtypes types (bfloat16, float8_*) present as void with no fields
+    return arr.dtype.kind == "V" and arr.dtype.names is None
+
+
+class UpdateCodec:
+    """Spec-driven tree encoder/decoder for the wire path.
+
+    ``encode`` is the stateful side: it takes the determinism context
+    (seed, round, client), an optional delta ``base`` tree, and an optional
+    mutable ``residuals`` mapping (path -> flat f32 residual) that it reads
+    and updates in place when the spec has a top-k stage. ``decode`` needs
+    nothing but the frame (plus ``base`` iff the encoder applied delta).
+    """
+
+    def __init__(self, spec):
+        self.spec = spec if isinstance(spec, CodecSpec) else parse_codec_spec(spec)
+
+    # -- encode ------------------------------------------------------------
+
+    def encode(self, tree: Any, *, seed: int = 0, round_idx: int = 0,
+               client_id: int = 0, base: Any = None,
+               residuals: Optional[MutableMapping[str, np.ndarray]] = None,
+               ) -> Dict[str, Any]:
+        flat, _ = _tree_flatten_named(tree)
+        base_flat = _tree_flatten_named(base)[0] if base is not None else {}
+        leaves = {}
+        for path, leaf in flat.items():
+            leaves[path] = self._encode_leaf(
+                path, leaf, base_flat.get(path),
+                seed, round_idx, client_id, residuals)
+        return {CODEC_FRAME_KEY: CODEC_FRAME_VERSION, "spec": self.spec.text,
+                "leaves": leaves, "treedef": None}
+
+    def _encode_leaf(self, path, leaf, base_leaf, seed, round_idx, client_id,
+                     residuals):
+        arr = np.asarray(leaf)
+        if not _compressible(arr):
+            return {"raw": arr, "c": 0}
+        spec = self.spec
+        rec: Dict[str, Any] = {"c": 1, "dt": _dtype_token(arr.dtype),
+                               "shape": list(arr.shape)}
+        x64 = np.asarray(arr, np.float64).ravel()
+        if spec.delta and base_leaf is not None:
+            b64 = np.asarray(base_leaf, np.float64).ravel()
+            if b64.shape == x64.shape:
+                x64 = x64 - b64
+                rec["d"] = 1
+        if spec.topk is None and spec.bits is None:
+            # delta is the terminal stage: keep f64 so decode(+base) is exact
+            rec["v"] = x64
+            return rec
+        x = x64.astype(np.float32)
+        n = x.size
+        vals = x
+        idx = None
+        if spec.topk is not None:
+            if residuals is not None:
+                r = residuals.get(path)
+                if r is None or r.shape != x.shape:
+                    r = np.zeros_like(x)
+                x = x + r
+            k = max(1, int(math.ceil(spec.topk * n)))
+            idx = np.argsort(-np.abs(x), kind="stable")[:k].astype(np.uint32)
+            vals = x[idx]
+            rec["idx"] = idx
+        if spec.bits is not None:
+            q, s, dec = stochastic_quantize(
+                vals, spec.bits, seed, round_idx, client_id, _leaf_hash(path))
+            rec["b"] = spec.bits
+            rec["nv"] = int(vals.size)
+            rec["q"] = pack_int4(q) if spec.bits == 4 else q
+            rec["s"] = s
+        else:
+            rec["v"] = vals
+            dec = vals
+        if spec.topk is not None and residuals is not None:
+            new_r = x.copy()
+            new_r[idx] -= dec
+            residuals[path] = new_r
+        return rec
+
+    # -- decode ------------------------------------------------------------
+
+    def decode(self, frame: Dict[str, Any], *, base: Any = None) -> Any:
+        base_flat = _tree_flatten_named(base)[0] if base is not None else {}
+        flat = {}
+        for path, rec in frame["leaves"].items():
+            flat[path] = self._decode_leaf(path, rec, base_flat.get(path))
+        return _tree_unflatten_named(flat, frame.get("treedef"))
+
+    def _decode_leaf(self, path, rec, base_leaf):
+        if not rec.get("c"):
+            return np.asarray(rec["raw"])
+        shape = tuple(int(d) for d in rec["shape"])
+        dt = _resolve_dtype(rec["dt"])
+        n = int(np.prod(shape)) if shape else 1
+        if "b" in rec:
+            m = int(rec["nv"])
+            bits = int(rec["b"])
+            q = unpack_int4(np.asarray(rec["q"]), m) if bits == 4 \
+                else np.asarray(rec["q"], np.int8)
+            vals = dequantize(q, np.asarray(rec["s"], np.float32), m)
+        else:
+            vals = np.asarray(rec["v"])
+        if "idx" in rec:
+            dense = np.zeros(n, np.float32)
+            dense[np.asarray(rec["idx"], np.int64)] = vals.astype(np.float32)
+        else:
+            dense = vals
+        if rec.get("d"):
+            if base_leaf is None:
+                raise ValueError(
+                    f"codec frame leaf {path!r} is delta-encoded; decoding "
+                    "requires the base tree")
+            dense = dense.astype(np.float64) \
+                + np.asarray(base_leaf, np.float64).ravel()
+        return np.asarray(dense.reshape(shape).astype(dt))
+
+
+def encode_tree(tree: Any, spec, **ctx) -> Dict[str, Any]:
+    """Encode a pytree into a codec frame (see ``UpdateCodec.encode``)."""
+    return UpdateCodec(spec).encode(tree, **ctx)
+
+
+def decode_tree(frame: Dict[str, Any], *, base: Any = None) -> Any:
+    """Decode a codec frame; context-free unless the frame carries delta."""
+    return UpdateCodec(frame["spec"]).decode(frame, base=base)
+
+
+def is_codec_frame(obj: Any) -> bool:
+    return isinstance(obj, dict) and bool(obj.get(CODEC_FRAME_KEY))
+
+
+# --------------------------------------------------------------------------
+# byte accounting
+# --------------------------------------------------------------------------
+
+_REC_ARRAY_KEYS = ("q", "s", "idx", "v", "raw")
+
+
+def tree_nbytes(tree: Any) -> int:
+    """Payload bytes of an uncompressed pytree (array bytes only)."""
+    flat, _ = _tree_flatten_named(tree)
+    return sum(np.asarray(leaf).nbytes for leaf in flat.values())
+
+
+def frame_nbytes(frame: Dict[str, Any]) -> int:
+    """Payload bytes of a codec (or legacy quantized) frame — array bytes
+    only, ignoring msgpack key overhead, mirroring ``tree_nbytes``."""
+    total = 0
+    for rec in frame["leaves"].values():
+        for key in _REC_ARRAY_KEYS:
+            if key in rec:
+                total += np.asarray(rec[key]).nbytes
+    return total
+
+
+def spec_wire_nbytes(spec, tree: Any) -> Tuple[int, int]:
+    """Static (uncompressed, compressed) byte estimate of encoding ``tree``
+    with ``spec`` — depends only on shapes/dtypes, so the simulator can
+    account codec bytes without materializing frames."""
+    cs = spec if isinstance(spec, CodecSpec) else parse_codec_spec(spec)
+    flat, _ = _tree_flatten_named(tree)
+    raw = 0
+    coded = 0
+    for leaf in flat.values():
+        arr = np.asarray(leaf)
+        raw += arr.nbytes
+        if not _compressible(arr):
+            coded += arr.nbytes
+            continue
+        n = arr.size
+        m = n
+        leaf_bytes = 0
+        if cs.topk is not None:
+            m = max(1, int(math.ceil(cs.topk * n)))
+            leaf_bytes += 4 * m  # uint32 indices
+        if cs.bits is not None:
+            nc = _pad_len(m) // _QCHUNK
+            leaf_bytes += (m if cs.bits == 8 else (m + 1) // 2) + 4 * nc
+        elif cs.topk is not None:
+            leaf_bytes += 4 * m  # f32 values
+        else:
+            leaf_bytes += 8 * n if cs.delta else arr.nbytes
+        coded += leaf_bytes
+    return raw, coded
+
+
+# --------------------------------------------------------------------------
+# batched JAX roundtrip (simulator parity path)
+# --------------------------------------------------------------------------
+
+def _flatten_with_paths(tree) -> Tuple[List[str], List[Any], Any]:
+    """Flatten a pytree to ("/"-joined paths, leaves, treedef) with the same
+    path strings as ``_tree_flatten_named`` produces for nested dicts, so
+    leaf hashes (and thus stochastic-rounding streams) match the wire path."""
+    import jax
+
+    keyed, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    paths = []
+    leaves = []
+    for kp, leaf in keyed:
+        parts = []
+        for entry in kp:
+            if hasattr(entry, "key"):
+                parts.append(str(entry.key))
+            elif hasattr(entry, "name"):
+                parts.append(str(entry.name))
+            elif hasattr(entry, "idx"):
+                parts.append(str(entry.idx))
+            else:  # pragma: no cover - future key types
+                parts.append(str(entry))
+        paths.append("/".join(parts))
+        leaves.append(leaf)
+    return paths, leaves, treedef
+
+
+def build_stacked_roundtrip(spec, seed: int):
+    """Build the simulator-side codec: a jit-safe function applying
+    encode+decode per client along the leading cohort axis.
+
+    Returns ``fn(update, residuals, cids_u32, round_u32) ->
+    (decoded_update, new_residuals)`` where every leaf of ``update`` and
+    ``residuals`` has shape (C, *leaf_shape), ``cids_u32`` is the (C,) client
+    id vector and ``round_u32`` a traced uint32 scalar (traced, so rounds
+    don't recompile). Delta is a passthrough here — simulator updates are
+    round-base deltas with no explicit base, the same semantics as the
+    cross-silo uplink. Residual leaves are f32 mirrors of the update leaves;
+    leaves too small to compress pass through with residuals untouched.
+    """
+    cs = spec if isinstance(spec, CodecSpec) else parse_codec_spec(spec)
+
+    def roundtrip(update, residuals, cids_u32, round_u32):
+        import jax
+        import jax.numpy as jnp
+
+        paths, leaves, treedef = _flatten_with_paths(update)
+        if cs.topk is not None:
+            _, res_leaves, _ = _flatten_with_paths(residuals)
+        else:
+            # no error feedback — the residual tree may be empty ()
+            res_leaves = [None] * len(leaves)
+        out_leaves = []
+        out_res = []
+        for path, leaf, res in zip(paths, leaves, res_leaves):
+            n = int(np.prod(leaf.shape[1:])) if leaf.ndim > 1 else 1
+            if n < _MIN_LEAF or not jnp.issubdtype(leaf.dtype, jnp.floating):
+                out_leaves.append(leaf)
+                out_res.append(res)
+                continue
+            C = leaf.shape[0]
+            x = leaf.astype(jnp.float32).reshape(C, n)
+            if cs.topk is not None:
+                xw = x + res.astype(jnp.float32).reshape(C, n)
+                k = max(1, int(math.ceil(cs.topk * n)))
+                idx = jnp.argsort(-jnp.abs(xw), axis=1, stable=True)[:, :k]
+                vals = jnp.take_along_axis(xw, idx, axis=1)
+            else:
+                xw = x
+                vals = x
+            if cs.bits is not None:
+                dec_vals = _quant_roundtrip_jnp(
+                    vals, cs.bits, seed, round_u32, cids_u32,
+                    _leaf_hash(path), jnp)
+            else:
+                dec_vals = vals
+            if cs.topk is not None:
+                dense = jnp.zeros((C, n), jnp.float32)
+                dense = dense.at[jnp.arange(C)[:, None], idx].set(dec_vals)
+                new_r = xw - dense
+                out = dense
+                out_res.append(new_r.reshape(res.shape).astype(res.dtype))
+            else:
+                out = dec_vals
+                out_res.append(res)
+            out_leaves.append(out.reshape(leaf.shape).astype(leaf.dtype))
+        decoded = jax.tree_util.tree_unflatten(treedef, out_leaves)
+        if cs.topk is None:
+            return decoded, residuals
+        return decoded, jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(residuals), out_res)
+
+    return roundtrip
+
+
+def _quant_roundtrip_jnp(vals, bits, seed, round_u32, cids_u32, leaf_hash, jnp):
+    """Batched quantize+dequantize, arithmetic identical to the numpy pair
+    ``stochastic_quantize``/``dequantize`` (bit-exact parity is tested)."""
+    bound = jnp.float32({8: 127, 4: 7}[bits])
+    C, m = vals.shape
+    mpad = _pad_len(m)
+    nc = mpad // _QCHUNK
+    # base key per row: same mixing chain as stochastic_key(), with the
+    # traced round/client entering as uint32 arrays
+    h = jnp.uint32((int(seed) ^ _KEY_SALT) & _U32)
+    h = _mix32_arr(h ^ round_u32.astype(jnp.uint32), jnp)
+    h = _mix32_arr(h ^ cids_u32.astype(jnp.uint32), jnp)  # (C,)
+    h = _mix32_arr(h ^ jnp.uint32(leaf_hash), jnp)
+    u = _uniform_u01(jnp.arange(mpad, dtype=jnp.uint32)[None, :],
+                     h[:, None], jnp)  # (C, mpad)
+    vp = jnp.zeros((C, mpad), jnp.float32).at[:, :m].set(vals)
+    blk = vp.reshape(C, nc, _QCHUNK)
+    amax = jnp.abs(blk).max(axis=-1)
+    s = _pow2_scales(amax, _EB[bits], jnp)
+    q = jnp.clip(jnp.floor(blk / s[..., None] + u.reshape(C, nc, _QCHUNK)),
+                 -bound, bound)
+    # wire path stores int8 and multiplies back in f32; same values here
+    dec = (q.astype(jnp.int8).astype(jnp.float32) * s[..., None])
+    return dec.reshape(C, mpad)[:, :m]
+
+
+# --------------------------------------------------------------------------
+# telemetry
+# --------------------------------------------------------------------------
+
+def record_codec(direction: str, nbytes_in: int, nbytes_out: int,
+                 seconds: Optional[float] = None,
+                 plane: str = "uplink") -> None:
+    """Record one codec operation: ``fedml_codec_bytes_in/out`` counters plus
+    compression-ratio and cost histograms. ``direction`` is encode/decode
+    (bytes_in = bytes entering that operation); ``plane`` separates the
+    heavily-compressed client->server update path ("uplink") from the
+    quantize-only broadcast path ("downlink")."""
+    from ..core import telemetry
+
+    if not telemetry.enabled():
+        return
+    reg = telemetry.get_registry()
+    reg.counter("fedml_codec_bytes_in",
+                direction=direction, plane=plane).inc(float(nbytes_in))
+    reg.counter("fedml_codec_bytes_out",
+                direction=direction, plane=plane).inc(float(nbytes_out))
+    if nbytes_out:
+        ratio = nbytes_in / nbytes_out if direction == "encode" \
+            else nbytes_out / nbytes_in
+        reg.histogram("fedml_codec_ratio", scheme=(1.0, 2.0, 12),
+                      direction=direction, plane=plane).observe(ratio)
+    if seconds is not None:
+        reg.histogram("fedml_codec_seconds", scheme=telemetry.SECONDS_SCHEME,
+                      direction=direction, plane=plane).observe(seconds)

@@ -73,7 +73,9 @@ def unpack_payload(data: bytes) -> Any:
 def compress_tree(tree: Any) -> Dict[str, Any]:
     """Lossy int8 compression of a float pytree for WAN shipping (~3.9x
     smaller than f32): per-256-chunk absmax scales via the native codec
-    (fedml_tpu/native, numpy fallback). Non-float leaves pass through."""
+    (fedml_tpu/native, numpy fallback). Non-float leaves pass through.
+    The source dtype rides along so float64 leaves decompress back to
+    float64 (lossy values, faithful dtype)."""
     from .. import native
 
     flat, treedef = _tree_flatten_named(tree)
@@ -82,29 +84,41 @@ def compress_tree(tree: Any) -> Dict[str, Any]:
         arr = np.asarray(arr)
         if arr.dtype in (np.float32, np.float64) and arr.size >= 64:
             q, scales = native.quantize_i8(arr.astype(np.float32))
-            out[key] = {"q": q, "s": scales, "shape": list(arr.shape), "c": 1}
+            out[key] = {"q": q, "s": scales, "shape": list(arr.shape),
+                        "c": 1, "dt": _dtype_token(arr.dtype)}
         else:
             out[key] = {"raw": arr, "c": 0}
     return {"__quantized__": 1, "leaves": out, "treedef": treedef}
 
 
 def decompress_tree(payload: Dict[str, Any]) -> Any:
+    """Decode a compressed frame — either a legacy ``__quantized__`` int8
+    frame or a ``__codec__`` pipeline frame (comm/codec.py)."""
     from .. import native
 
+    if payload.get("__codec__"):
+        from .codec import decode_tree
+
+        return decode_tree(payload)
     flat = {}
     for key, rec in payload["leaves"].items():
         if rec.get("c"):
-            flat[key] = native.dequantize_i8(
+            arr = native.dequantize_i8(
                 np.asarray(rec["q"], np.int8), np.asarray(rec["s"], np.float32),
                 tuple(rec["shape"]),
             )
+            if "dt" in rec:  # restore source dtype (pre-fix frames lack it)
+                arr = arr.astype(_resolve_dtype(rec["dt"]))
+            flat[key] = arr
         else:
             flat[key] = np.asarray(rec["raw"])
     return _tree_unflatten_named(flat, payload["treedef"])
 
 
 def is_compressed(obj: Any) -> bool:
-    return isinstance(obj, dict) and obj.get("__quantized__") == 1
+    if not isinstance(obj, dict):
+        return False
+    return obj.get("__quantized__") == 1 or bool(obj.get("__codec__"))
 
 
 def _tree_flatten_named(tree: Any):

@@ -12,6 +12,7 @@ SURVEY.md §3.2 calls out.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -99,10 +100,20 @@ class FedMLAggregator:
 
     def add_local_trained_result(self, index: int, model_params: PyTree, sample_num) -> None:
         logging.debug("add_model. index = %d", index)
+        from ..comm import codec as comm_codec
         from ..comm.message import decompress_tree, is_compressed
 
         if is_compressed(model_params):
-            model_params = decompress_tree(model_params)
+            # decompress BEFORE sanitize/aggregate — the robust defenses (and
+            # FaultyCommManager's decompress-then-corrupt byzantine path)
+            # always see plain update trees
+            t0 = time.perf_counter()
+            with telemetry.get_tracer().span("codec.decode", slot=index):
+                frame_bytes = comm_codec.frame_nbytes(model_params)
+                model_params = decompress_tree(model_params)
+            comm_codec.record_codec(
+                "decode", frame_bytes, comm_codec.tree_nbytes(model_params),
+                time.perf_counter() - t0)
         self.model_dict[index] = model_params
         self.sample_num_dict[index] = float(sample_num)
         self.flag_client_model_uploaded_dict[index] = True

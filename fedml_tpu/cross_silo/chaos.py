@@ -56,10 +56,21 @@ class ChaosDrillResult:
     # rollbacks observed during the drill (0 unless defenses are on)
     quarantined: float = 0.0
     rollbacks: float = 0.0
+    # compressed update plane: raw/wire byte deltas keyed by plane
+    # (uplink/downlink); empty unless comm_codec was active in the drill
+    codec_bytes_raw: Dict[str, float] = dataclasses.field(default_factory=dict)
+    codec_bytes_wire: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     @property
     def ok(self) -> bool:
         return self.rounds_completed >= self.rounds_expected
+
+    def codec_ratio(self, plane: str = "uplink") -> float:
+        """Raw/wire compression ratio observed on one plane (1.0 when the
+        codec was off or produced no traffic there)."""
+        raw = self.codec_bytes_raw.get(plane, 0.0)
+        wire = self.codec_bytes_wire.get(plane, 0.0)
+        return raw / wire if raw > 0 and wire > 0 else 1.0
 
     def summary(self) -> str:
         faults = ", ".join(f"{k}={int(v)}"
@@ -68,28 +79,36 @@ class ChaosDrillResult:
         if self.quarantined or self.rollbacks:
             healing = (f" | quarantined={int(self.quarantined)} "
                        f"rollbacks={int(self.rollbacks)}")
+        codec = ""
+        if self.codec_bytes_wire:
+            codec = (f" | codec uplink {self.codec_ratio('uplink'):.1f}x "
+                     f"({int(self.codec_bytes_wire.get('uplink', 0))}B wire)")
         return (
             f"chaos drill: {'PASS' if self.ok else 'FAIL'} — "
             f"{self.rounds_completed}/{self.rounds_expected} rounds in "
             f"{self.elapsed_s:.1f}s | faults injected: {faults or 'none'} | "
             f"sends retried={int(self.send_retries)} "
-            f"declared-dead={int(self.send_failures)}" + healing
+            f"declared-dead={int(self.send_failures)}" + healing + codec
         )
 
 
 def _label_totals(counters: Dict[str, float], name: str,
-                  label: Optional[str] = None) -> Dict[str, float]:
+                  label: Optional[str] = None,
+                  where: Optional[Dict[str, str]] = None) -> Dict[str, float]:
     """Collect ``name{...}`` counters from a registry snapshot; with
-    ``label``, key the result by that label's value."""
+    ``label``, key the result by that label's value; ``where`` keeps only
+    series whose labels match every given key=value pair."""
     out: Dict[str, float] = {}
     for key, value in counters.items():
         if not (key == name or key.startswith(name + "{")):
             continue
+        inner = key[len(name):].strip("{}")
+        labels = dict(kv.split("=", 1) for kv in inner.split(",") if "=" in kv)
+        if where and any(labels.get(k) != v for k, v in where.items()):
+            continue
         if label is None:
             out["total"] = out.get("total", 0.0) + value
             continue
-        inner = key[len(name):].strip("{}")
-        labels = dict(kv.split("=", 1) for kv in inner.split(",") if "=" in kv)
         k = labels.get(label, "?")
         out[k] = out.get(k, 0.0) + value
     return out
@@ -150,11 +169,15 @@ def run_chaos_drill(args=None, n_clients: Optional[int] = None,
 
     after = registry.snapshot()["counters"] if telemetry.enabled() else {}
 
-    def delta(name, label=None):
-        a = _label_totals(after, name, label)
-        b = _label_totals(before, name, label)
+    def delta(name, label=None, where=None):
+        a = _label_totals(after, name, label, where)
+        b = _label_totals(before, name, label, where)
         return {k: v - b.get(k, 0.0) for k, v in a.items()}
 
+    # codec accounting from the ENCODE side only: the drill hosts server and
+    # clients in one process, so summing encode+decode would double-count
+    # every frame. encode's in=raw / out=wire on both planes.
+    enc = {"direction": "encode"}
     return ChaosDrillResult(
         rounds_completed=len(server.history) if not hung else
         min(len(server.history), rounds - 1),  # a hung run never passes
@@ -166,4 +189,6 @@ def run_chaos_drill(args=None, n_clients: Optional[int] = None,
         history=list(server.history),
         quarantined=sum(delta("fedml_quarantined_total").values()),
         rollbacks=sum(delta("fedml_rollbacks_total").values()),
+        codec_bytes_raw=delta("fedml_codec_bytes_in", "plane", enc),
+        codec_bytes_wire=delta("fedml_codec_bytes_out", "plane", enc),
     )

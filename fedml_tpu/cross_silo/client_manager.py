@@ -10,10 +10,14 @@ reference's weighted param mean but half the numerical drift in bf16.
 from __future__ import annotations
 
 import logging
+import time
 from typing import Dict
 
+import numpy as np
 
 from ..comm import Message, ClientManager
+from ..comm import codec as comm_codec
+from ..comm.message import decompress_tree, is_compressed
 from ..comm.resilience import SendFailure
 from ..comm.utils import log_communication_tick, log_communication_tock
 from ..core import telemetry
@@ -30,6 +34,14 @@ class FedMLClientManager(ClientManager):
         # trace ids observed per round (restored from the server's stamped
         # init/sync messages) — the client half of round-trace parity
         self.round_trace_ids: Dict[int, str] = {}
+        # uplink codec: this manager owns the per-client error-feedback
+        # residuals (path -> flat f32), keyed to the stable rank — they never
+        # travel on the wire, and stochastic rounding is deterministic per
+        # (random_seed, round_idx, rank)
+        spec = comm_codec.resolve_codec_spec(args, backend)
+        self._codec = comm_codec.UpdateCodec(spec) if spec else None
+        self._codec_residuals: Dict[str, np.ndarray] = {}
+        self._codec_seed = int(getattr(args, "random_seed", 0))
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -59,8 +71,24 @@ class FedMLClientManager(ClientManager):
         reply.add_params(MyMessage.MSG_ARG_KEY_CLIENT_STATUS, MyMessage.MSG_CLIENT_STATUS_IDLE)
         self.send_message(reply)
 
+    def _maybe_decode(self, params):
+        """Decode a compressed server broadcast (context-free: downlink
+        frames are quantization-only, see codec.resolve_downlink_spec).
+        Dispatch is on the frame itself so a client without ``comm_codec``
+        configured still understands a compressing server."""
+        if params is None or not is_compressed(params):
+            return params
+        t0 = time.perf_counter()
+        tree = decompress_tree(params)
+        comm_codec.record_codec(
+            "decode", comm_codec.frame_nbytes(params),
+            comm_codec.tree_nbytes(tree), time.perf_counter() - t0,
+            plane="downlink")
+        return tree
+
     def _on_init(self, msg: Message) -> None:
-        global_model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        global_model_params = self._maybe_decode(
+            msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
         client_index = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX)
         self.trainer.update_model(global_model_params)
         self.trainer.update_dataset(int(client_index))
@@ -70,7 +98,8 @@ class FedMLClientManager(ClientManager):
         self._train()
 
     def _on_sync(self, msg: Message) -> None:
-        global_model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
+        global_model_params = self._maybe_decode(
+            msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
         client_index = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX)
         self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.round_idx + 1))
         self.trainer.update_model(global_model_params)
@@ -89,10 +118,18 @@ class FedMLClientManager(ClientManager):
             "client.train", round_idx=self.round_idx, client=self.rank
         ):
             update, local_sample_num = self.trainer.train(self.round_idx)
-        if getattr(self.args, "comm_quantize", False):
-            from ..comm.message import compress_tree
-
-            update = compress_tree(update)
+        if self._codec is not None:
+            t0 = time.perf_counter()
+            raw_nbytes = comm_codec.tree_nbytes(update)
+            with telemetry.get_tracer().span(
+                "codec.encode", round_idx=self.round_idx, client=self.rank
+            ):
+                update = self._codec.encode(
+                    update, seed=self._codec_seed, round_idx=self.round_idx,
+                    client_id=self.rank, residuals=self._codec_residuals)
+            comm_codec.record_codec(
+                "encode", raw_nbytes, comm_codec.frame_nbytes(update),
+                time.perf_counter() - t0)
         msg = Message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER, self.rank, 0)
         msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, update)
         msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, local_sample_num)

@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Set
 import numpy as np
 
 from ..comm import Message, ServerManager
+from ..comm import codec as comm_codec
 from ..comm.resilience import SendFailure
 from ..comm.utils import log_round_end, log_round_start
 from ..core import telemetry
@@ -124,6 +125,16 @@ class FedMLServerManager(ServerManager):
 
             sink = MetricsSink(path=getattr(args, "tracking_path", None))
             self.mlops_event = MLOpsProfilerEvent(args, sink=sink)
+        # downlink codec: broadcasts keep only the stateless quantization
+        # stage of the configured spec (delta/topk residual state cannot
+        # survive a fan-out path with drops/rejoins). Encoded once per params
+        # object — the one-slot identity cache covers every per-client
+        # add_params of the same round's broadcast.
+        dspec = comm_codec.resolve_downlink_spec(
+            args, comm_codec.resolve_codec_spec(args, backend))
+        self._bcast_codec = comm_codec.UpdateCodec(dspec) if dspec else None
+        self._bcast_cache = (None, None)
+        self._codec_seed = int(getattr(args, "random_seed", 0))
 
     # --- round protocol -----------------------------------------------------
 
@@ -131,6 +142,28 @@ class FedMLServerManager(ServerManager):
         """Kick the handshake (the reference's MQTT broker emits
         CONNECTION_READY; loopback/gRPC deployments call start())."""
         self._on_connection_ready(None)
+
+    def _encode_broadcast(self, params):
+        """Encode global params for a broadcast (no-op when no downlink
+        codec). Cached by params identity so one round's fan-out encodes
+        once regardless of cohort size or re-send paths."""
+        if self._bcast_codec is None or params is None:
+            return params
+        cached, frame = self._bcast_cache
+        if cached is params:
+            return frame
+        t0 = time.perf_counter()
+        with telemetry.get_tracer().span("codec.encode",
+                                         round_idx=self.round_idx):
+            frame = self._bcast_codec.encode(
+                params, seed=self._codec_seed, round_idx=self.round_idx,
+                client_id=0)
+        comm_codec.record_codec(
+            "encode", comm_codec.tree_nbytes(params),
+            comm_codec.frame_nbytes(frame), time.perf_counter() - t0,
+            plane="downlink")
+        self._bcast_cache = (params, frame)
+        return frame
 
     def send_init_msg(self) -> None:
         log_round_start(self.rank, self.round_idx)
@@ -140,7 +173,8 @@ class FedMLServerManager(ServerManager):
             self.aggregator.set_expected_this_round(
                 len(self.client_id_list_in_this_round))
             round_gen = self._round_gen
-        global_model_params = self.aggregator.get_global_model_params()
+        global_model_params = self._encode_broadcast(
+            self.aggregator.get_global_model_params())
         self._round_ctx = telemetry.new_round_context(self.round_idx)
         if self._round_ctx is not None:
             self.round_trace_ids[self.round_idx] = self._round_ctx.trace_id
@@ -331,7 +365,8 @@ class FedMLServerManager(ServerManager):
         sync = Message(
             MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, sender)
         sync.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
-                        self.aggregator.get_global_model_params())
+                        self._encode_broadcast(
+                            self.aggregator.get_global_model_params()))
         sync.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
                         int(self.data_silo_index_list[slot]))
         sync.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
@@ -424,7 +459,8 @@ class FedMLServerManager(ServerManager):
     def _missing_sync_msgs_locked(self) -> List[Message]:
         """SYNC re-sends for cohort members with no upload and no death mark
         this round. Caller holds the round lock."""
-        global_model_params = self.aggregator.get_global_model_params()
+        global_model_params = self._encode_broadcast(
+            self.aggregator.get_global_model_params())
         msgs = []
         for idx, cid in enumerate(self.client_id_list_in_this_round):
             if self.aggregator.has_upload_from(idx) or cid in self._dead_clients:
@@ -543,7 +579,8 @@ class FedMLServerManager(ServerManager):
         self._round_ctx = telemetry.new_round_context(self.round_idx)
         if self._round_ctx is not None:
             self.round_trace_ids[self.round_idx] = self._round_ctx.trace_id
-        global_model_params = self.aggregator.get_global_model_params()
+        global_model_params = self._encode_broadcast(
+            self.aggregator.get_global_model_params())
         msgs = []
         for idx, client_id in enumerate(self.client_id_list_in_this_round):
             sync = Message(MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, client_id)
@@ -621,11 +658,12 @@ class FedMLServerManager(ServerManager):
             "watchdog: round %d rollback #%d (%s) — re-running without "
             "clients %s", self.round_idx, self._rollbacks_this_round,
             "loss spike" if spike else "non-finite state", sorted(cand))
+        pre_frame = self._encode_broadcast(pre_params)
         msgs = []
         for idx, cid in enumerate(self.client_id_list_in_this_round):
             sync = Message(
                 MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, cid)
-            sync.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, pre_params)
+            sync.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, pre_frame)
             sync.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
                             int(self.data_silo_index_list[idx]))
             sync.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)

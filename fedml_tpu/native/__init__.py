@@ -102,6 +102,15 @@ def get_lib() -> Optional[ctypes.CDLL]:
         lib.dequantize_i8.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p
         ]
+        try:  # absent from pre-int4 prebuilt .so (no-toolchain path)
+            lib.pack_i4.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p
+            ]
+            lib.unpack_i4.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p
+            ]
+        except AttributeError:
+            logging.debug("native lib predates int4 pack; numpy fallback")
         _lib = lib
     except OSError as e:
         logging.debug("native load failed: %s", e)

@@ -154,4 +154,32 @@ void dequantize_i8(const int8_t* q, const float* scales, int64_t n, float* dst) 
     }
 }
 
+// ---------------------------------------------------------------------------
+// 3. int4 nibble packing: int8 levels in [-7, 7] biased by +8 into the
+//    high/low nibbles of one byte (odd tails pad with the zero level).
+//    Quantization math stays in Python (shared with the JAX path) — the
+//    native layer only does the byte shuffling.
+// ---------------------------------------------------------------------------
+
+void pack_i4(const int8_t* q, int64_t n, uint8_t* dst) {
+    const int64_t pairs = n / 2;
+    for (int64_t p = 0; p < pairs; ++p) {
+        const uint8_t hi = (uint8_t)(q[2 * p] + 8);
+        const uint8_t lo = (uint8_t)(q[2 * p + 1] + 8);
+        dst[p] = (uint8_t)((hi << 4) | (lo & 0x0F));
+    }
+    if (n % 2) {
+        const uint8_t hi = (uint8_t)(q[n - 1] + 8);
+        dst[pairs] = (uint8_t)((hi << 4) | 8);  // pad nibble = zero level
+    }
+}
+
+void unpack_i4(const uint8_t* packed, int64_t n, int8_t* dst) {
+    for (int64_t i = 0; i < n; ++i) {
+        const uint8_t b = packed[i / 2];
+        const uint8_t nib = (i % 2 == 0) ? (uint8_t)(b >> 4) : (uint8_t)(b & 0x0F);
+        dst[i] = (int8_t)((int)nib - 8);
+    }
+}
+
 }  // extern "C"

@@ -116,6 +116,15 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
         client_state_spill_dir=getattr(args, "client_state_spill_dir", None),
         client_state_backend=str(getattr(args, "client_state_backend", "arena")),
         cohort_shard_axis=str(getattr(args, "cohort_shard_axis", AXIS_CLIENT)),
+        # only an EXPLICIT spec engages the in-sim codec ("auto" resolves
+        # per wire backend and the simulator has none; comm_quantize is a
+        # cross-silo knob and must not silently change sim numerics)
+        comm_codec=(
+            None
+            if str(getattr(args, "comm_codec", "") or "").lower()
+            in ("", "none", "off", "auto")
+            else str(args.comm_codec)
+        ),
     )
 
     attack_type = getattr(args, "attack_type", None)

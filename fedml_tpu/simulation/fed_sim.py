@@ -177,6 +177,17 @@ class SimConfig:
     # the stacked update inside aggregation) shards over; cohorts are
     # padded to a multiple of this axis' size (zero-weight rows)
     cohort_shard_axis: str = AXIS_CLIENT
+    # --- compressed update plane ---------------------------------------
+    # wire-codec spec (comm/codec.py grammar, e.g. "delta|topk:0.01|q8"):
+    # apply the cross-silo uplink codec's lossy encode+decode to every
+    # client's update inside the compiled round step, with per-client
+    # error-feedback residuals in a ClientStateArena when the spec has a
+    # top-k stage. Forces the even schedule (the roundtrip needs the full
+    # stacked cohort) and a params-shaped client update. EF residuals are
+    # NOT snapshotted by the watchdog — a rolled-back round's residual
+    # carry survives the re-run, same as a real client re-encoding.
+    # None = updates flow uncompressed (bit-identical to pre-codec runs).
+    comm_codec: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -327,7 +338,31 @@ class FedSimulator:
         # pin the even schedule (packed/bucketed never materialize the stack)
         self._update_transform = update_transform
         self._detect = bool(cfg.sanitize_updates or cfg.watchdog_factor > 0)
-        force_even = self._detect or update_transform is not None
+        # compressed update plane: the wire codec's lossy roundtrip runs per
+        # client inside the round step — the simulator half of the parity
+        # harness for the cross-silo uplink codec (same spec grammar, same
+        # stochastic-rounding streams keyed by (seed, round, client id))
+        self._codec_spec = None
+        self._codec_rt = None
+        self._codec_arena: Optional[ClientStateArena] = None
+        self._codec_record = None
+        self._codec_wire = (0, 0)
+        if cfg.comm_codec:
+            from ..comm import codec as wire_codec
+
+            self._codec_spec = wire_codec.parse_codec_spec(cfg.comm_codec)
+            if not getattr(algorithm, "update_is_params", True):
+                raise ValueError(
+                    "comm_codec compresses params-shaped client updates; "
+                    f"algorithm {type(algorithm).__name__} produces a "
+                    "custom update structure")
+            self._codec_rt = wire_codec.build_stacked_roundtrip(
+                self._codec_spec, cfg.seed)
+            self._codec_record = wire_codec.record_codec
+            self._codec_wire = wire_codec.spec_wire_nbytes(
+                self._codec_spec, init_variables)
+        force_even = (self._detect or update_transform is not None
+                      or self._codec_spec is not None)
         mean_agg = (
             algorithm.aggregate is None
             and getattr(algorithm, "update_is_params", True)
@@ -347,8 +382,9 @@ class FedSimulator:
         if force_even and schedule in ("packed", "bucketed"):
             raise ValueError(
                 f"cohort_schedule='{schedule}' is incompatible with the "
-                "update sanitizer / watchdog / injected attacks — those "
-                "need the full stacked cohort (use 'even' or 'auto')")
+                "update sanitizer / watchdog / injected attacks / "
+                "comm_codec — those need the full stacked cohort "
+                "(use 'even' or 'auto')")
         if force_even:
             schedule = "even"
         if schedule == "auto":
@@ -418,6 +454,17 @@ class FedSimulator:
                 self._prepare_fn = jax.jit(
                     jax.vmap(algorithm.prepare_client_state, in_axes=(None, 0)),
                     **({} if prep_sh is None else {"out_shardings": prep_sh}))
+        if self._codec_spec is not None and self._codec_spec.topk is not None:
+            # per-client error-feedback residuals: f32 params-shaped rows in
+            # their own arena (same slot machinery as algorithm state, but
+            # the two trees have different protos so they cannot share one)
+            capacity = max(cfg.client_state_capacity or cfg.client_num_in_total,
+                           cfg.client_num_per_round)
+            res_proto = jax.tree.map(
+                lambda p: np.zeros(np.shape(p), np.float32), init_variables)
+            self._codec_arena = ClientStateArena(
+                res_proto, capacity, mesh=mesh,
+                axis_name=cfg.cohort_shard_axis)
         self._round_step = self._build_round_step()
         if self._packed:
             self._packed_step = self._build_packed_step()
@@ -449,7 +496,11 @@ class FedSimulator:
                     jax.tree_util.tree_leaves(tree)[0],
                     callback=lambda s, tag=tag: probe(tag, s))
 
-        def round_body(params, server_state, cohort, client_states, rng):
+        codec_rt = self._codec_rt
+        codec_ef = self._codec_arena is not None
+
+        def round_body(params, server_state, cohort, client_states, rng,
+                       codec_res=(), cids_u32=None, round_u32=None):
             outs = _cohort_outputs(alg, params, cohort, client_states, rng)
             update = outs.update
             w = outs.weight.astype(jnp.float32)
@@ -461,6 +512,12 @@ class FedSimulator:
                 update = jax.tree.map(
                     lambda u: jax.lax.with_sharding_constraint(u, cohort_sh),
                     update)
+            if codec_rt is not None:
+                # lossy wire roundtrip FIRST: the attacker corrupts what the
+                # server decodes (cross-silo decompress-then-corrupt order)
+                # and the sanitizer sees what the attacker produced
+                update, codec_res = codec_rt(
+                    update, codec_res, cids_u32, round_u32)
             # adversarial corruption first, sanitizer second — the defense
             # must see exactly what a byzantine client would upload
             if transform is not None:
@@ -501,18 +558,30 @@ class FedSimulator:
                 (m["train_correct"].sum()
                  / jnp.maximum(m["train_valid"].sum(), 1.0)).astype(jnp.float32),
             ])
+            ret = (new_params, new_server_state, outs.state, metrics_vec)
             if detect:
-                return (new_params, new_server_state, outs.state,
-                        metrics_vec, qz)
-            return new_params, new_server_state, outs.state, metrics_vec
+                ret += (qz,)
+            if codec_ef:
+                ret += (codec_res,)
+            return ret
 
         if self._use_device_data:
             # device-resident path: the cohort carries only an index
             # rectangle (host->device per round = a few KB of indices)
-            def round_step(params, server_state, cohort, client_states, rng,
-                           x_all, y_all):
-                data = _gather_from_device(dict(cohort), x_all, y_all)
-                return round_body(params, server_state, data, client_states, rng)
+            if codec_rt is not None:
+                def round_step(params, server_state, cohort, client_states,
+                               rng, codec_res, cids_u32, round_u32,
+                               x_all, y_all):
+                    data = _gather_from_device(dict(cohort), x_all, y_all)
+                    return round_body(params, server_state, data,
+                                      client_states, rng, codec_res,
+                                      cids_u32, round_u32)
+            else:
+                def round_step(params, server_state, cohort, client_states,
+                               rng, x_all, y_all):
+                    data = _gather_from_device(dict(cohort), x_all, y_all)
+                    return round_body(params, server_state, data,
+                                      client_states, rng)
         else:
             round_step = round_body
 
@@ -521,12 +590,20 @@ class FedSimulator:
         n_extra = 2 if self._use_device_data else 0
         if mesh is not None:
             rep = replicated(mesh)
+            in_sh = (rep, rep, cohort_sh, cohort_sh, rep)
+            if codec_rt is not None:
+                # residual stack + client-id vector ride the cohort axis;
+                # the round scalar is replicated
+                in_sh += (cohort_sh, cohort_sh, rep)
+            in_sh += (rep,) * n_extra
             out_sh = (rep, rep, cohort_sh, rep)
             if detect:
                 out_sh += (rep,)
+            if codec_ef:
+                out_sh += (cohort_sh,)
             return jax.jit(
                 round_step,
-                in_shardings=(rep, rep, cohort_sh, cohort_sh, rep) + (rep,) * n_extra,
+                in_shardings=in_sh,
                 out_shardings=out_sh,
                 donate_argnums=(0, 1),
             )
@@ -1258,34 +1335,56 @@ class FedSimulator:
         ids = inputs.client_ids
         pad = self._cohort_pad
         stateful = self._client_state_proto != ()
+        # padded rows re-gather the last client's slot (zero weight/mask
+        # keeps its extra update rows inert); only real rows scatter back
+        gather_ids = ids if not pad else np.concatenate(
+            [ids, np.repeat(ids[-1], pad)])
         if stateful:
-            # padded rows re-gather the last client's slot (zero weight/mask
-            # keeps its extra update rows inert); only real rows scatter back
-            gather_ids = ids if not pad else np.concatenate(
-                [ids, np.repeat(ids[-1], pad)])
             t = time.perf_counter()
             states = self._gather_states(gather_ids)
             self._phase_acc.append(("state_gather", time.perf_counter() - t))
         else:
             states = ()
         step_args = (self.params, self.server_state, cohort, states, step_rng)
+        if self._codec_rt is not None:
+            # EF residuals ride the same padded-gather pattern as client
+            # state; the id vector keys each row's stochastic-rounding stream
+            t = time.perf_counter()
+            codec_res = (self._codec_arena.gather(gather_ids)
+                         if self._codec_arena is not None else ())
+            step_args += (codec_res,
+                          jnp.asarray(gather_ids.astype(np.uint32)),
+                          jnp.uint32(inputs.round_idx))
+            self._phase_acc.append(("codec", time.perf_counter() - t))
         if self._use_device_data:
             step_args += (self._x_dev, self._y_dev)
+        out = self._round_step(*step_args)
+        if self._codec_arena is not None:
+            *out, new_codec_res = out
         if self._detect:
             (self.params, self.server_state, new_states, metrics_vec,
-             qz) = self._round_step(*step_args)
+             qz) = out
             self._last_qz = qz if not pad else qz[:, : len(ids)]
             self._last_cohort_ids = ids
         else:
-            self.params, self.server_state, new_states, metrics_vec = (
-                self._round_step(*step_args)
-            )
+            self.params, self.server_state, new_states, metrics_vec = out
         if stateful:
             t = time.perf_counter()
             if pad:
                 new_states = jax.tree.map(lambda x: x[: len(ids)], new_states)
             self._scatter_states(ids, new_states)
             self._phase_acc.append(("state_scatter", time.perf_counter() - t))
+        if self._codec_rt is not None:
+            t = time.perf_counter()
+            if self._codec_arena is not None:
+                if pad:
+                    new_codec_res = jax.tree.map(
+                        lambda x: x[: len(ids)], new_codec_res)
+                self._codec_arena.scatter(ids, new_codec_res)
+            dt = time.perf_counter() - t
+            self._phase_acc.append(("codec", dt))
+            raw, coded = self._codec_wire
+            self._codec_record("encode", raw * len(ids), coded * len(ids), dt)
         return metrics_vec
 
     def _packed_lane_plan(self, client_ids: np.ndarray, drop):

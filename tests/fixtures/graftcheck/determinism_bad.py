@@ -24,3 +24,23 @@ def reseed_global():
 
 def cohort_order(client_ids):
     return list(set(client_ids))
+
+
+def quantize_without_seed(vals, codec):
+    # seed omitted entirely (only vals, bits passed)
+    return codec.stochastic_quantize(vals, 8)
+
+
+def quantize_none_seed(vals, codec):
+    return codec.stochastic_quantize(vals, 8, seed=None, round_idx=0,
+                                     client_id=0)
+
+
+def key_time_seed(codec):
+    import time
+
+    return codec.stochastic_key(int(time.time()), 0, 0)
+
+
+def roundtrip_without_seed(spec, codec):
+    return codec.build_stacked_roundtrip(spec)

@@ -16,3 +16,20 @@ def make_py_rng(seed):
 def cohort_order(client_ids):
     chosen = set(client_ids)
     return sorted(chosen)
+
+
+def quantize_seeded(vals, codec, seed, round_idx, client_id):
+    return codec.stochastic_quantize(vals, 8, seed, round_idx, client_id)
+
+
+def key_seeded(codec, seed):
+    return codec.stochastic_key(seed, 0, 0)
+
+
+def roundtrip_seeded(spec, codec, seed):
+    return codec.build_stacked_roundtrip(spec, seed=seed)
+
+
+def roundtrip_forwarded(spec, codec, **kw):
+    # kwargs splat may carry the seed — not flaggable statically
+    return codec.build_stacked_roundtrip(spec, **kw)
