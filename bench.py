@@ -494,13 +494,7 @@ def chaos_bench(seed: int = 7) -> int:
         "metric": "chaos_drill_rounds_completed",
         "unit": (f"rounds closed under seeded faults (seed={seed}, drop 20%, "
                  "fail-send 20%, rank-3 crash at round 1) / rounds expected"),
-        "value": result.rounds_completed,
-        "expected": result.rounds_expected,
-        "elapsed_s": round(result.elapsed_s, 3),
-        "faults_injected": {k: int(v)
-                            for k, v in result.faults_injected.items()},
-        "send_retries": int(result.send_retries),
-        "send_failures": int(result.send_failures),
+        **result.json_record(),
     }
     print(json.dumps(line), flush=True)
     print(result.summary(), file=sys.stderr, flush=True)
@@ -523,13 +517,9 @@ def chaos_bench(seed: int = 7) -> int:
         "metric": "chaos_byzantine_quarantined",
         "unit": (f"sanitizer quarantine hits under NaN uploads from rank 2 "
                  f"(seed={seed}); run must close finite"),
-        "value": int(byz.quarantined),
-        "rounds_completed": byz.rounds_completed,
-        "expected": byz.rounds_expected,
-        "elapsed_s": round(byz.elapsed_s, 3),
+        **byz.json_record(),
         "final_local_train_loss": (round(last_loss, 4)
                                    if finite else "non-finite"),
-        "rollbacks": int(byz.rollbacks),
     }
     print(json.dumps(line), flush=True)
     print(byz.summary(), file=sys.stderr, flush=True)
@@ -635,6 +625,35 @@ def codec_sweep_bench(specs=("q8", "delta|topk:0.05|q8", "delta|topk:0.01|q8"),
     return 0 if ok else 1
 
 
+def loadgen_bench(duration_s: float = 2.0, seed: int = 0) -> int:
+    """``--loadgen``: overload gate for the tenancy control plane — the
+    check-in load generator must sustain >=10k offered check-ins/sec through
+    the real message codec against a bounded queue, with shedding visible in
+    the per-tenant counters and the queue depth never passing its bound. The
+    JSON line records the throughput/shed frontier."""
+    from fedml_tpu.core import telemetry
+    from fedml_tpu.cross_silo.loadgen import run_loadgen
+
+    telemetry.configure(enabled=True)
+    report = run_loadgen(duration_s=duration_s, producers=2,
+                         queue_maxsize=512, tenants=2, churn=0.1, seed=seed)
+    rate_ok = report.offered_rate >= 10_000.0
+    shed_visible = (report.shed == 0
+                    or sum(report.per_tenant_shed.values()) > 0)
+    line = {
+        "metric": "loadgen_checkins_per_sec",
+        "unit": (f"offered device check-ins/sec over {duration_s:.0f}s "
+                 f"(2 producers, 2 tenants, 10% seeded churn, seed={seed}, "
+                 "512-deep bounded queue), real msgpack codec both ways, CPU"),
+        **report.json_record(),
+        "pass_10k_per_sec": bool(rate_ok),
+        "shed_visible_in_telemetry": bool(shed_visible),
+    }
+    print(json.dumps(line), flush=True)
+    print(report.summary(), file=sys.stderr, flush=True)
+    return 0 if (report.ok and rate_ok and shed_visible) else 1
+
+
 if __name__ == "__main__":
     if "--host-pack" in sys.argv:
         # host-side measurement only — never wait on (or measure) the chip
@@ -656,4 +675,8 @@ if __name__ == "__main__":
         # compression frontier — loopback + CPU simulator only
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         sys.exit(codec_sweep_bench())
+    if "--loadgen" in sys.argv:
+        # check-in overload drill — host threads + codec only, no chip
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        sys.exit(loadgen_bench())
     sys.exit(main())

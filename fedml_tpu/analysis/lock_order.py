@@ -23,7 +23,8 @@ modelled — the codebase has no bare ``.acquire()`` call sites, and the
 checker keeps it that way by flagging them too.
 
 Scope: ``fedml_tpu/comm/``, ``fedml_tpu/cross_silo/``, the telemetry/
-mlops registries, the CLI agent runner, and the prefetcher.
+mlops registries, the tenancy control plane, the CLI agent runner, the
+prefetcher, and the multi-tenant simulation driver.
 """
 
 from __future__ import annotations
@@ -37,8 +38,10 @@ SCOPE_PREFIXES = ("fedml_tpu/comm/", "fedml_tpu/cross_silo/")
 SCOPE_FILES = (
     "fedml_tpu/core/telemetry.py",
     "fedml_tpu/core/mlops.py",
+    "fedml_tpu/core/tenancy.py",
     "fedml_tpu/cli/runner.py",
     "fedml_tpu/simulation/prefetch.py",
+    "fedml_tpu/simulation/multi_run.py",
 )
 
 LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}

@@ -274,9 +274,16 @@ def run(config_file, backend):
                    "proves faults on compressed frames are absorbed.")
 @click.option("--timeout", default=120.0, type=float,
               help="Hang bound: the drill fails if the run outlives this.")
+@click.option("--tenant", default=None,
+              help="Scope the drill's telemetry accounting to this tenant "
+                   "(counters land tenant-labeled; deltas filter to them).")
+@click.option("--json", "as_json", is_flag=True,
+              help="Emit the drill outcome as one JSON line (the same "
+                   "reporter bench.py --chaos uses) instead of the summary.")
 def chaos_drill(seed, rounds, clients, drop_rate, duplicate_rate,
                 fail_send_rate, crash_rank, crash_at_round, byzantine_kind,
-                byzantine_rate, byzantine_scale, defend, codec, timeout):
+                byzantine_rate, byzantine_scale, defend, codec, timeout,
+                tenant, as_json):
     """Stand up a full cross-silo deployment (server + clients, real codec,
     real round FSM) under the given fault plan and verify every round still
     closes. Exits 1 if the run hangs or loses rounds — the same check
@@ -307,16 +314,140 @@ def chaos_drill(seed, rounds, clients, drop_rate, duplicate_rate,
         parse_codec_spec(codec)
         kw.update(comm_codec=codec)
     from ..core import telemetry
-    if codec is not None and not telemetry.enabled():
-        # the codec verdict reads fedml_codec_* counter deltas
+    if (codec is not None or tenant is not None) and not telemetry.enabled():
+        # the codec verdict and tenant scoping read counter deltas
         telemetry.configure(enabled=True)
-    result = run_chaos_drill(join_timeout_s=timeout, **kw)
-    click.echo(result.summary())
+    result = run_chaos_drill(join_timeout_s=timeout, tenant=tenant, **kw)
+    click.echo(json.dumps(result.json_record()) if as_json
+               else result.summary())
     if not result.ok:
         raise SystemExit(1)
     if codec is not None and not result.codec_bytes_wire:
         click.echo("codec drill: FAIL — comm_codec was set but no "
                    "fedml_codec_* traffic was recorded")
+        raise SystemExit(1)
+
+
+@cli.command("serve",
+             help="Run N federated jobs multi-tenant over one device mesh.")
+@click.option("--job", "-j", "job_specs", multiple=True, required=True,
+              metavar="NAME=CONFIG.yaml[:PRIORITY]",
+              help="One tenant job: a name, its YAML config, and an optional "
+                   "scheduler priority weight (repeat for each tenant).")
+@click.option("--capacity-bytes", default=2 << 30, type=int,
+              help="Admission budget: total device bytes jobs may reserve.")
+@click.option("--max-jobs", default=8, type=int,
+              help="Max concurrently admitted jobs.")
+@click.option("--max-queue", default=16, type=int,
+              help="Admission queue bound (beyond it: reject).")
+@click.option("--quantum", default=1.0, type=float,
+              help="Deficit-round-robin quantum per scheduling cycle.")
+@click.option("--checkpoint-root", default=None, type=click.Path(),
+              help="Per-tenant checkpoint namespaces live under this root.")
+@click.option("--json", "as_json", is_flag=True,
+              help="Emit one JSON line per tenant instead of summaries.")
+def serve(job_specs, capacity_bytes, max_jobs, max_queue, quantum,
+          checkpoint_root, as_json):
+    """Admit each job against the byte budget (admit / queue / reject, typed
+    verdicts), then interleave the admitted jobs' round steps fairly over one
+    mesh — per-tenant telemetry, checkpoints, and numerics stay isolated
+    (each job's history is bit-identical to running it solo). Exits 1 if any
+    job is rejected or fails."""
+    from ..arguments import SECTION_FAMILIES, load_yaml_config
+    from ..core import telemetry
+    from ..simulation import MultiTenantSimDriver, TenantJob
+
+    if not telemetry.enabled():
+        telemetry.configure(enabled=True)
+
+    def flat(cfg):
+        # same section-flattening rule as Arguments.set_attr_from_config
+        out = {}
+        for section, content in cfg.items():
+            if isinstance(content, dict) and (
+                    section in SECTION_FAMILIES or section.endswith("_args")):
+                out.update(content)
+            else:
+                out[section] = content
+        return out
+
+    jobs = []
+    for spec in job_specs:
+        name, eq, rest = spec.partition("=")
+        if not eq or not name:
+            raise click.BadParameter(
+                f"--job wants NAME=CONFIG.yaml[:PRIORITY], got '{spec}'")
+        path, colon, prio = rest.rpartition(":")
+        try:
+            priority = float(prio) if colon else 1.0
+        except ValueError:
+            path, priority = rest, 1.0  # the ':' belonged to the path
+        if not colon:
+            path = rest
+        if not os.path.exists(path):
+            raise click.BadParameter(f"--job {name}: no such config '{path}'")
+        jobs.append(TenantJob(name, flat(load_yaml_config(path)),
+                              priority=priority))
+
+    driver = MultiTenantSimDriver(
+        jobs, capacity_bytes=capacity_bytes, max_concurrent=max_jobs,
+        max_queue=max_queue, quantum=quantum,
+        checkpoint_root=checkpoint_root, log_fn=click.echo)
+    results = driver.run()
+    ok = True
+    for name in sorted(results):
+        r = results[name]
+        ok = ok and r.ok
+        if as_json:
+            last = r.history[-1] if r.history else {}
+            click.echo(json.dumps({
+                "tenant": r.tenant, "decision": r.verdict.decision,
+                "ok": r.ok, "rounds": len(r.history),
+                "rounds_expected": r.rounds_expected,
+                "elapsed_s": round(r.elapsed_s, 3), "error": r.error,
+                "final_train_loss": last.get("train_loss"),
+            }))
+        else:
+            click.echo(r.summary())
+    if not ok:
+        raise SystemExit(1)
+
+
+@cli.command("loadgen",
+             help="Replay device check-in overload against the bounded "
+                  "check-in queue and report the throughput/shed frontier.")
+@click.option("--duration", default=1.0, type=float,
+              help="Drill length in seconds.")
+@click.option("--rate", default=0.0, type=float,
+              help="Target aggregate check-ins/sec (0 = producers run flat "
+                   "out to find the natural ceiling).")
+@click.option("--producers", default=2, type=int)
+@click.option("--queue-maxsize", default=512, type=int,
+              help="Check-in queue bound; overflow is shed, never buffered.")
+@click.option("--tenants", default=2, type=int,
+              help="Tenant count check-ins round-robin across.")
+@click.option("--churn", default=0.1, type=float,
+              help="Seeded fraction of devices that vanish mid-announce.")
+@click.option("--seed", default=0, type=int)
+@click.option("--json", "as_json", is_flag=True,
+              help="Emit the frontier as one JSON line.")
+def loadgen(duration, rate, producers, queue_maxsize, tenants, churn, seed,
+            as_json):
+    """Every check-in rides the real message codec; shedding shows up in the
+    per-tenant ``fedml_checkins_shed_total`` counters and the queue's depth
+    high-water mark can never pass the bound. Exits 1 if the accounting
+    doesn't close (offered != accepted + shed) or the bound broke."""
+    from ..core import telemetry
+    from ..cross_silo.loadgen import run_loadgen
+
+    if not telemetry.enabled():
+        telemetry.configure(enabled=True)
+    report = run_loadgen(duration_s=duration, target_rate=rate,
+                         producers=producers, queue_maxsize=queue_maxsize,
+                         tenants=tenants, churn=churn, seed=seed)
+    click.echo(json.dumps(report.json_record()) if as_json
+               else report.summary())
+    if not report.ok:
         raise SystemExit(1)
 
 

@@ -153,6 +153,32 @@ class _NullMetric:
 _NULL = _NullMetric()
 
 
+# --- tenant scoping ----------------------------------------------------------
+
+# The multi-tenant control plane (core/tenancy.py) isolates telemetry by
+# stamping a ``tenant`` label on every series created while a tenant scope is
+# active. The scope is a contextvar — it does NOT inherit into new threads,
+# so per-tenant worker threads must enter :func:`tenant_scope` inside their
+# own thread body (the multi-run driver and chaos drill both do).
+_tenant_var: "contextvars.ContextVar[Optional[str]]" = (
+    contextvars.ContextVar("fedml_tpu_tenant", default=None))
+
+
+def current_tenant() -> Optional[str]:
+    return _tenant_var.get()
+
+
+@contextlib.contextmanager
+def tenant_scope(tenant: Optional[str]):
+    """Attribute every metric created in the block to ``tenant``. ``None``
+    is a no-op scope (series stay unlabeled — byte-identical to today)."""
+    token = _tenant_var.set(None if tenant is None else str(tenant))
+    try:
+        yield tenant
+    finally:
+        _tenant_var.reset(token)
+
+
 # --- registry ---------------------------------------------------------------
 
 
@@ -181,6 +207,11 @@ class MetricsRegistry:
              factory: Callable[[], Any]):
         if not self.enabled:
             return _NULL
+        # active tenant scope: the series splits per tenant (an explicit
+        # tenant= label from the caller wins over the ambient scope)
+        tenant = _tenant_var.get()
+        if tenant is not None and "tenant" not in labels:
+            labels = dict(labels, tenant=tenant)
         key = _key(name, labels)
         with self._lock:
             ent = self._metrics.get(key)
@@ -260,6 +291,51 @@ class MetricsRegistry:
                     hist.counts[i] += int(c)
                 hist.sum += float(h["sum"])
                 hist.count += int(h["count"])
+
+
+class TenantRegistry:
+    """Tenant-scoped facade over a :class:`MetricsRegistry`: every series
+    accessed through it carries ``tenant=<name>``, and :meth:`snapshot`
+    keeps only that tenant's series — the isolated registry view the chaos
+    drill and the multi-run driver hand each job."""
+
+    def __init__(self, tenant: str, registry: Optional[MetricsRegistry] = None):
+        self.tenant = str(tenant)
+        self._reg = registry if registry is not None else _state.registry
+
+    @property
+    def enabled(self) -> bool:
+        return self._reg.enabled
+
+    def counter(self, name: str, **labels) -> Counter:
+        labels.setdefault("tenant", self.tenant)
+        return self._reg.counter(name, **labels)
+
+    def gauge(self, name: str, **labels) -> Gauge:
+        labels.setdefault("tenant", self.tenant)
+        return self._reg.gauge(name, **labels)
+
+    def histogram(self, name: str,
+                  scheme: Tuple[float, float, int] = SECONDS_SCHEME,
+                  **labels) -> Histogram:
+        labels.setdefault("tenant", self.tenant)
+        return self._reg.histogram(name, scheme, **labels)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The underlying snapshot restricted to this tenant's series."""
+        full = self._reg.snapshot()
+        out: Dict[str, Any] = {}
+        for kind, series in full.items():
+            out[kind] = {
+                k: v for k, v in series.items()
+                if _parse_key(k)[1].get("tenant") == self.tenant
+            }
+        return out
+
+
+def scoped_registry(tenant: str,
+                    registry: Optional[MetricsRegistry] = None) -> TenantRegistry:
+    return TenantRegistry(tenant, registry)
 
 
 def _parse_key(key: str) -> Tuple[str, Dict[str, str]]:

@@ -1,0 +1,485 @@
+"""Multi-tenant job control plane: admission, fair scheduling, overload.
+
+Production FL platforms run many concurrent federated jobs against one
+shared accelerator pool (FedML MLOps in PAPER.md; Flower / NVIDIA FLARE
+interop). This module is the job-level layer above the round FSM:
+
+- **ResourceEnvelope / AdmissionVerdict / JobRegistry** — jobs declare what
+  they will consume (cohort size, model bytes, a device-memory estimate
+  priced with ``core/scheduler.py``'s cost model); the registry admits jobs
+  under a byte-capacity budget and bounded concurrency, queues the next few,
+  and rejects the rest with a typed verdict instead of letting an oversized
+  job OOM the mesh mid-round.
+- **DeficitRoundRobinScheduler** — fair interleaving of round steps across
+  admitted tenants: each scheduling cycle tops a tenant's deficit up by
+  ``quantum * priority`` and a tenant runs one round step when its deficit
+  covers its declared per-round cost, so cheap jobs are not starved behind
+  expensive ones and long-run service converges to the priority weights.
+  Tenants whose *measured* step cost chronically overruns their declared
+  envelope are demoted (priority multiplied down), the polite version of
+  killing a noisy neighbor.
+- **CheckinQueue** — overload as a first-class state: a bounded device
+  check-in queue with backpressure. A full queue sheds (rejects) the
+  check-in and counts it (``fedml_checkins_shed_total{tenant=...}``) rather
+  than growing without bound; the depth gauge makes saturation visible.
+
+Telemetry flows through :mod:`fedml_tpu.core.telemetry`'s tenant scoping:
+every series these classes write is tenant-labeled when created under a
+:func:`telemetry.tenant_scope` (or when a tenant is passed explicitly), so
+one tenant's counters provably cannot pollute another's.
+
+Thread-safety: every structure here is shared between tenant worker threads
+and the scheduler; all mutation happens under a per-object lock, and no
+blocking call (sleep, send, wait) ever runs while one is held (enforced by
+graftcheck's lock-order checker — this file is in its scope).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Sequence
+
+from . import telemetry
+
+# Decision values a JobRegistry can return.
+ADMIT = "admit"
+QUEUE = "queue"
+REJECT = "reject"
+
+
+# --- resource envelopes ------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ResourceEnvelope:
+    """What one federated job declares it will consume per round.
+
+    ``round_cost`` is in the same relative units as
+    :func:`fedml_tpu.core.scheduler.dp_schedule` workloads (client batch
+    counts x model cost); ``device_memory_bytes`` is the admission currency:
+    params + server opt state (~2x params) + the stacked cohort of client
+    updates, the live set a round step holds at aggregation time.
+    """
+
+    tenant: str
+    cohort_size: int
+    model_bytes: int
+    rounds: int = 1
+    round_cost: float = 1.0
+    priority: float = 1.0
+    device_memory_bytes: int = 0
+
+    def __post_init__(self):
+        if self.cohort_size <= 0:
+            raise ValueError(f"cohort_size must be positive, got "
+                             f"{self.cohort_size}")
+        if self.model_bytes < 0 or self.priority <= 0:
+            raise ValueError("model_bytes must be >= 0 and priority > 0")
+        if self.device_memory_bytes == 0:
+            object.__setattr__(self, "device_memory_bytes",
+                               self.estimate_device_memory_bytes(
+                                   self.cohort_size, self.model_bytes))
+
+    @staticmethod
+    def estimate_device_memory_bytes(cohort_size: int,
+                                     model_bytes: int) -> int:
+        # params + server state (opt momentum etc., ~2x params) + the
+        # stacked per-client update tensor the aggregation step holds
+        return int(model_bytes * (3 + cohort_size))
+
+    @classmethod
+    def from_workloads(cls, tenant: str, workloads: Sequence[float],
+                       model_bytes: int, rounds: int = 1,
+                       priority: float = 1.0) -> "ResourceEnvelope":
+        """Price a round from per-client workloads (``dp_schedule`` units:
+        e.g. batch counts); the round cost is the total batch-work the mesh
+        must retire for one round of this job."""
+        return cls(
+            tenant=str(tenant),
+            cohort_size=len(workloads),
+            model_bytes=int(model_bytes),
+            rounds=int(rounds),
+            round_cost=float(sum(workloads)) or 1.0,
+            priority=float(priority),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class AdmissionVerdict:
+    """Typed admission outcome — the control plane's answer to "may this
+    job run now": ``admit`` (capacity reserved), ``queue`` (wait for a
+    release), or ``reject`` (would never fit / queue full)."""
+
+    tenant: str
+    decision: str  # ADMIT | QUEUE | REJECT
+    reason: str
+    requested_bytes: int
+    available_bytes: int
+    capacity_bytes: int
+    queue_position: Optional[int] = None
+
+    @property
+    def admitted(self) -> bool:
+        return self.decision == ADMIT
+
+    @property
+    def queued(self) -> bool:
+        return self.decision == QUEUE
+
+    @property
+    def rejected(self) -> bool:
+        return self.decision == REJECT
+
+    def summary(self) -> str:
+        pos = (f" (queue position {self.queue_position})"
+               if self.queue_position is not None else "")
+        return (f"admission[{self.tenant}]: {self.decision}{pos} — "
+                f"{self.reason} (requested {self.requested_bytes}B, "
+                f"available {self.available_bytes}B of "
+                f"{self.capacity_bytes}B)")
+
+
+class JobRegistry:
+    """Admission control over one device mesh's memory budget.
+
+    ``admit`` reserves envelope bytes against ``capacity_bytes`` and a
+    ``max_concurrent`` job slot; jobs that would fit but can't right now
+    queue FIFO (up to ``max_queue``); jobs that could NEVER fit — or arrive
+    at a full queue — are rejected outright. ``release`` frees a job's
+    reservation and promotes queued jobs that now fit, returning their
+    fresh ``admit`` verdicts so the caller can start them.
+    """
+
+    def __init__(self, capacity_bytes: int, max_concurrent: int = 8,
+                 max_queue: int = 16):
+        self.capacity_bytes = int(capacity_bytes)
+        self.max_concurrent = int(max_concurrent)
+        self.max_queue = int(max_queue)
+        self._lock = threading.Lock()
+        self._active: Dict[str, ResourceEnvelope] = {}
+        self._queue: Deque[ResourceEnvelope] = deque()
+
+    # ------------------------------------------------------------- internals
+
+    def _available_locked(self) -> int:
+        return self.capacity_bytes - sum(
+            e.device_memory_bytes for e in self._active.values())
+
+    def _verdict(self, env: ResourceEnvelope, decision: str, reason: str,
+                 available: int, queue_position: Optional[int] = None
+                 ) -> AdmissionVerdict:
+        v = AdmissionVerdict(
+            tenant=env.tenant, decision=decision, reason=reason,
+            requested_bytes=env.device_memory_bytes,
+            available_bytes=available, capacity_bytes=self.capacity_bytes,
+            queue_position=queue_position,
+        )
+        reg = telemetry.get_registry()
+        if reg.enabled:
+            reg.counter("fedml_admissions_total", decision=decision,
+                        tenant=env.tenant).inc()
+            reg.gauge("fedml_admitted_jobs").set(len(self._active))
+            reg.gauge("fedml_admission_queue_depth").set(len(self._queue))
+        return v
+
+    def _try_admit_locked(self, env: ResourceEnvelope
+                          ) -> Optional[AdmissionVerdict]:
+        available = self._available_locked()
+        if (env.device_memory_bytes <= available
+                and len(self._active) < self.max_concurrent):
+            self._active[env.tenant] = env
+            return self._verdict(
+                env, ADMIT, "capacity reserved",
+                available - env.device_memory_bytes)
+        return None
+
+    # ------------------------------------------------------------- public
+
+    def admit(self, env: ResourceEnvelope) -> AdmissionVerdict:
+        with self._lock:
+            if env.tenant in self._active or any(
+                    q.tenant == env.tenant for q in self._queue):
+                return self._verdict(
+                    env, REJECT, "tenant already registered",
+                    self._available_locked())
+            if env.device_memory_bytes > self.capacity_bytes:
+                return self._verdict(
+                    env, REJECT,
+                    "envelope exceeds total mesh capacity — would never fit",
+                    self._available_locked())
+            v = self._try_admit_locked(env)
+            if v is not None:
+                return v
+            if len(self._queue) >= self.max_queue:
+                return self._verdict(
+                    env, REJECT, "admission queue full — shed",
+                    self._available_locked())
+            self._queue.append(env)
+            return self._verdict(
+                env, QUEUE,
+                "insufficient capacity now — queued for a release",
+                self._available_locked(),
+                queue_position=len(self._queue) - 1)
+
+    def release(self, tenant: str) -> List[AdmissionVerdict]:
+        """Free ``tenant``'s reservation; returns admit verdicts for every
+        queued job the freed capacity now covers (FIFO, no overtaking)."""
+        promoted: List[AdmissionVerdict] = []
+        with self._lock:
+            self._active.pop(str(tenant), None)
+            while self._queue:
+                v = self._try_admit_locked(self._queue[0])
+                if v is None:
+                    break
+                self._queue.popleft()
+                promoted.append(v)
+            reg = telemetry.get_registry()
+            if reg.enabled:
+                reg.gauge("fedml_admitted_jobs").set(len(self._active))
+                reg.gauge("fedml_admission_queue_depth").set(len(self._queue))
+        return promoted
+
+    def active_tenants(self) -> List[str]:
+        with self._lock:
+            return list(self._active)
+
+    def queued_tenants(self) -> List[str]:
+        with self._lock:
+            return [e.tenant for e in self._queue]
+
+    def available_bytes(self) -> int:
+        with self._lock:
+            return self._available_locked()
+
+
+# --- fair scheduling ---------------------------------------------------------
+
+
+class DeficitRoundRobinScheduler:
+    """Deficit round-robin over per-tenant run queues.
+
+    Classic DRR (Shreedhar & Varghese) with the flow cost replaced by the
+    tenant's declared per-round cost in ``dp_schedule`` units: each cycle
+    visits tenants in rotation, tops each visited deficit up by
+    ``quantum * priority``, and serves the first tenant whose deficit covers
+    its cost. The caller charges the *measured* cost after the step
+    (:meth:`charge`), which both burns the deficit and feeds the over-budget
+    detector: a tenant whose measured costs run past
+    ``over_budget_factor x declared`` for ``demote_after`` consecutive
+    steps has its priority multiplied by ``demote_factor`` (floored), so a
+    mis-declared envelope degrades its own service, not its neighbors'.
+    """
+
+    def __init__(self, quantum: float = 1.0, demote_factor: float = 0.5,
+                 over_budget_factor: float = 2.0, demote_after: int = 3,
+                 min_priority: float = 0.05):
+        self.quantum = float(quantum)
+        self.demote_factor = float(demote_factor)
+        self.over_budget_factor = float(over_budget_factor)
+        self.demote_after = int(demote_after)
+        self.min_priority = float(min_priority)
+        self._lock = threading.Lock()
+        self._order: Deque[str] = deque()
+        self._cost: Dict[str, float] = {}
+        self._priority: Dict[str, float] = {}
+        self._deficit: Dict[str, float] = {}
+        self._served: Dict[str, float] = {}
+        self._steps: Dict[str, int] = {}
+        self._over_streak: Dict[str, int] = {}
+        self._demotions: Dict[str, int] = {}
+        # True while the head tenant's once-per-visit quantum top-up has
+        # already been applied (cleared when the rotation moves past it)
+        self._topped: Dict[str, bool] = {}
+
+    def register(self, tenant: str, round_cost: float,
+                 priority: float = 1.0) -> None:
+        tenant = str(tenant)
+        with self._lock:
+            if tenant in self._cost:
+                raise ValueError(f"tenant {tenant!r} already registered")
+            self._order.append(tenant)
+            self._cost[tenant] = max(float(round_cost), 1e-9)
+            self._priority[tenant] = float(priority)
+            self._deficit[tenant] = 0.0
+            self._served.setdefault(tenant, 0.0)
+            self._steps.setdefault(tenant, 0)
+            self._over_streak[tenant] = 0
+            self._topped[tenant] = False
+
+    def unregister(self, tenant: str) -> None:
+        tenant = str(tenant)
+        with self._lock:
+            if tenant in self._cost:
+                self._order.remove(tenant)
+                del self._cost[tenant]
+                del self._priority[tenant]
+                del self._deficit[tenant]
+                self._topped.pop(tenant, None)
+
+    def next_tenant(self, ready: Optional[Sequence[str]] = None
+                    ) -> Optional[str]:
+        """Pick the next tenant to grant one round step. ``ready`` (when
+        given) restricts the choice to tenants currently able to run —
+        others keep their rotation slot but are skipped without a top-up.
+        Returns ``None`` when no (ready) tenant is registered."""
+        with self._lock:
+            if not self._order:
+                return None
+            ready_set = None if ready is None else {str(t) for t in ready}
+            if ready_set is not None and not (ready_set & set(self._order)):
+                return None
+            # textbook DRR: the head tenant keeps being granted while its
+            # deficit covers a round, and its once-per-visit top-up is
+            # quantum * priority — so long-run service is proportional to
+            # priority and independent of per-round unit cost. Deficits grow
+            # every full rotation, so a pick is guaranteed in at most
+            # ceil(max cost / (quantum * min priority)) cycles.
+            while True:
+                for _ in range(len(self._order)):
+                    t = self._order[0]
+                    if ready_set is None or t in ready_set:
+                        if self._deficit[t] >= self._cost[t]:
+                            return t  # stay at head: visit not spent yet
+                        if not self._topped[t]:
+                            self._topped[t] = True
+                            self._deficit[t] += (
+                                self.quantum * self._priority[t])
+                            if self._deficit[t] >= self._cost[t]:
+                                return t
+                    # visit over (or tenant not ready): move on
+                    self._topped[t] = False
+                    self._order.rotate(-1)
+
+    def charge(self, tenant: str, measured_cost: float) -> None:
+        """Burn ``tenant``'s deficit by the measured step cost and update
+        the over-budget streak / demotion state."""
+        tenant = str(tenant)
+        with self._lock:
+            if tenant not in self._cost:
+                return
+            cost = max(float(measured_cost), 0.0)
+            # burn the measured cost, but never let one pathological step
+            # push the deficit below one declared round (starvation bound)
+            self._deficit[tenant] = max(
+                self._deficit[tenant] - cost, -self._cost[tenant])
+            self._served[tenant] = self._served.get(tenant, 0.0) + cost
+            self._steps[tenant] = self._steps.get(tenant, 0) + 1
+            declared = self._cost[tenant]
+            if cost > self.over_budget_factor * declared:
+                self._over_streak[tenant] += 1
+            else:
+                self._over_streak[tenant] = 0
+            if self._over_streak[tenant] >= self.demote_after:
+                self._over_streak[tenant] = 0
+                old = self._priority[tenant]
+                new = max(old * self.demote_factor, self.min_priority)
+                if new < old:
+                    self._priority[tenant] = new
+                    self._demotions[tenant] = (
+                        self._demotions.get(tenant, 0) + 1)
+                    reg = telemetry.get_registry()
+                    if reg.enabled:
+                        reg.counter("fedml_tenant_demotions_total",
+                                    tenant=tenant).inc()
+
+    def served(self, tenant: str) -> float:
+        with self._lock:
+            return self._served.get(str(tenant), 0.0)
+
+    def priority(self, tenant: str) -> float:
+        with self._lock:
+            return self._priority.get(str(tenant), 0.0)
+
+    def demotions(self, tenant: str) -> int:
+        with self._lock:
+            return self._demotions.get(str(tenant), 0)
+
+    def stats(self) -> Dict[str, Dict[str, float]]:
+        with self._lock:
+            return {
+                t: {
+                    "served": self._served.get(t, 0.0),
+                    "steps": float(self._steps.get(t, 0)),
+                    "priority": self._priority.get(t, 0.0),
+                    "demotions": float(self._demotions.get(t, 0)),
+                }
+                for t in sorted(set(self._served) | set(self._cost))
+            }
+
+
+# --- overload: bounded check-in queue ---------------------------------------
+
+
+class CheckinQueue:
+    """Bounded device check-in queue with load shedding.
+
+    ``offer`` is the ingress edge the load generator (and a real gateway)
+    hammers: it either enqueues and returns True, or — queue full — sheds
+    the check-in, counts it per tenant
+    (``fedml_checkins_shed_total{tenant=...}``), and returns False, so
+    overload produces bounded memory and a visible counter instead of an
+    unbounded backlog. ``poll`` is the drain side (the admission/round
+    plane). The depth gauge is updated on both edges; its high-water mark
+    is tracked so a drill can assert the bound held.
+    """
+
+    def __init__(self, maxsize: int = 1024):
+        if maxsize <= 0:
+            raise ValueError(f"maxsize must be positive, got {maxsize}")
+        self.maxsize = int(maxsize)
+        self._lock = threading.Lock()
+        self._items: Deque[Any] = deque()
+        self._offered = 0
+        self._accepted = 0
+        self._shed = 0
+        self._max_depth = 0
+
+    def offer(self, item: Any, tenant: Optional[str] = None) -> bool:
+        reg = telemetry.get_registry()
+        with self._lock:
+            self._offered += 1
+            if len(self._items) >= self.maxsize:
+                self._shed += 1
+                shed, depth = self._shed, len(self._items)
+            else:
+                self._items.append(item)
+                self._accepted += 1
+                shed, depth = None, len(self._items)
+                if depth > self._max_depth:
+                    self._max_depth = depth
+        # metric writes happen outside the queue lock: the registry has its
+        # own lock and lock-order discipline forbids nesting the two
+        if reg.enabled:
+            labels = {} if tenant is None else {"tenant": str(tenant)}
+            if shed is not None:
+                reg.counter("fedml_checkins_shed_total", **labels).inc()
+            else:
+                reg.counter("fedml_checkins_accepted_total", **labels).inc()
+            reg.gauge("fedml_checkin_queue_depth").set(depth)
+        return shed is None
+
+    def poll(self) -> Optional[Any]:
+        reg = telemetry.get_registry()
+        with self._lock:
+            item = self._items.popleft() if self._items else None
+            depth = len(self._items)
+        if item is not None and reg.enabled:
+            reg.gauge("fedml_checkin_queue_depth").set(depth)
+        return item
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "offered": self._offered,
+                "accepted": self._accepted,
+                "shed": self._shed,
+                "depth": len(self._items),
+                "max_depth": self._max_depth,
+                "maxsize": self.maxsize,
+            }

@@ -60,6 +60,8 @@ class ChaosDrillResult:
     # (uplink/downlink); empty unless comm_codec was active in the drill
     codec_bytes_raw: Dict[str, float] = dataclasses.field(default_factory=dict)
     codec_bytes_wire: Dict[str, float] = dataclasses.field(default_factory=dict)
+    # tenant whose scoped registry the drill accounted against (None = global)
+    tenant: Optional[str] = None
 
     @property
     def ok(self) -> bool:
@@ -91,6 +93,32 @@ class ChaosDrillResult:
             f"declared-dead={int(self.send_failures)}" + healing + codec
         )
 
+    def json_record(self) -> dict:
+        """The drill outcome as one JSON-able dict — the single reporter
+        behind ``bench.py --chaos`` and ``fedml-tpu chaos-drill --json``
+        (callers add their own ``metric``/``unit`` framing on top)."""
+        rec = {
+            "rounds_completed": self.rounds_completed,
+            "rounds_expected": self.rounds_expected,
+            "elapsed_s": round(self.elapsed_s, 3),
+            "faults_injected": {k: int(v)
+                                for k, v in sorted(self.faults_injected.items())},
+            "send_retries": int(self.send_retries),
+            "send_failures": int(self.send_failures),
+            "quarantined": int(self.quarantined),
+            "rollbacks": int(self.rollbacks),
+            "ok": self.ok,
+        }
+        if self.tenant is not None:
+            rec["tenant"] = self.tenant
+        if self.codec_bytes_wire:
+            rec["codec_bytes_raw"] = {
+                k: int(v) for k, v in sorted(self.codec_bytes_raw.items())}
+            rec["codec_bytes_wire"] = {
+                k: int(v) for k, v in sorted(self.codec_bytes_wire.items())}
+            rec["codec_uplink_ratio"] = round(self.codec_ratio("uplink"), 3)
+        return rec
+
 
 def _label_totals(counters: Dict[str, float], name: str,
                   label: Optional[str] = None,
@@ -115,13 +143,21 @@ def _label_totals(counters: Dict[str, float], name: str,
 
 
 def run_chaos_drill(args=None, n_clients: Optional[int] = None,
-                    join_timeout_s: float = 120.0, **overrides
-                    ) -> ChaosDrillResult:
+                    join_timeout_s: float = 120.0,
+                    tenant: Optional[str] = None, registry=None,
+                    **overrides) -> ChaosDrillResult:
     """Run one seeded chaos deployment over loopback and report the outcome.
 
     ``overrides`` lands on top of :data:`PHASE_DEFAULTS` (so e.g.
     ``fault_crash_rank=1`` or ``fault_drop_rate=0.4`` tweak the plan);
     passing a pre-built ``args`` skips the defaults entirely.
+
+    ``tenant``/``registry`` scope the drill's accounting to one tenant: every
+    server/client thread runs inside :func:`telemetry.tenant_scope`, so the
+    resilience counters land tenant-labeled, and the before/after deltas are
+    filtered to that tenant's series. Passing a
+    :class:`~fedml_tpu.core.telemetry.TenantRegistry` (from
+    :func:`telemetry.scoped_registry`) implies its tenant.
     """
     import fedml_tpu
     from ..comm import LoopbackHub
@@ -139,20 +175,33 @@ def run_chaos_drill(args=None, n_clients: Optional[int] = None,
                          PHASE_DEFAULTS["client_num_in_total"]))
     rounds = int(getattr(args, "comm_round", PHASE_DEFAULTS["comm_round"]))
 
-    registry = telemetry.get_registry()
+    if registry is None:
+        registry = telemetry.get_registry()
+    if tenant is None:
+        tenant = getattr(registry, "tenant", None)
     before = registry.snapshot()["counters"] if telemetry.enabled() else {}
+
+    def scoped(fn):
+        # contextvars do not inherit into threads: each drill thread must
+        # enter the tenant scope inside its own body
+        def runner():
+            with telemetry.tenant_scope(tenant):
+                fn()
+        return runner
 
     hub = LoopbackHub()
     server = FedML_Horizontal(args, 0, n, backend="LOOPBACK", hub=hub)
     clients = [FedML_Horizontal(args, rank, n, backend="LOOPBACK", hub=hub)
                for rank in range(1, n + 1)]
-    threads = [threading.Thread(target=c.run, daemon=True, name=f"chaos-c{i+1}")
+    threads = [threading.Thread(target=scoped(c.run), daemon=True,
+                                name=f"chaos-c{i+1}")
                for i, c in enumerate(clients)]
     t0 = time.perf_counter()
     for t in threads:
         t.start()
-    server.start()
-    server_thread = threading.Thread(target=server.run, daemon=True,
+    with telemetry.tenant_scope(tenant):
+        server.start()  # caller-thread sends must carry the label too
+    server_thread = threading.Thread(target=scoped(server.run), daemon=True,
                                      name="chaos-server")
     server_thread.start()
     server_thread.join(timeout=join_timeout_s)
@@ -168,10 +217,12 @@ def run_chaos_drill(args=None, n_clients: Optional[int] = None,
     elapsed = time.perf_counter() - t0
 
     after = registry.snapshot()["counters"] if telemetry.enabled() else {}
+    twhere = {"tenant": tenant} if tenant is not None else {}
 
     def delta(name, label=None, where=None):
-        a = _label_totals(after, name, label, where)
-        b = _label_totals(before, name, label, where)
+        w = dict(where or {}, **twhere) or None
+        a = _label_totals(after, name, label, w)
+        b = _label_totals(before, name, label, w)
         return {k: v - b.get(k, 0.0) for k, v in a.items()}
 
     # codec accounting from the ENCODE side only: the drill hosts server and
@@ -191,4 +242,5 @@ def run_chaos_drill(args=None, n_clients: Optional[int] = None,
         rollbacks=sum(delta("fedml_rollbacks_total").values()),
         codec_bytes_raw=delta("fedml_codec_bytes_in", "plane", enc),
         codec_bytes_wire=delta("fedml_codec_bytes_out", "plane", enc),
+        tenant=tenant,
     )

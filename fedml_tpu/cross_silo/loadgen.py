@@ -1,0 +1,255 @@
+"""Check-in load generator: overload drills for the tenancy control plane.
+
+Production FL serving is dominated not by training rounds but by device
+*check-in* traffic — millions of phones announcing themselves, most of which
+must be turned away politely. This harness replays tens of thousands of
+simulated device check-ins per second through the real comm plane
+(``comm.Message`` + msgpack codec, so every check-in pays honest
+serialization cost) against a bounded
+:class:`~fedml_tpu.core.tenancy.CheckinQueue`:
+
+- N producer threads mint per-device check-in messages (round-robin across
+  tenants), run each through a seeded
+  :class:`~fedml_tpu.comm.resilience.FaultPlan` for realistic churn (a
+  dropped check-in is a device that went away mid-announce — deterministic
+  under the seed, so drills replay), and ``offer`` the serialized frame;
+- one consumer drains the queue at its natural rate, deserializing each
+  frame back through the codec — when producers outrun it, the bounded
+  queue sheds and the per-tenant ``fedml_checkins_shed_total`` counters and
+  depth gauge make the overload visible;
+- the report carries the throughput/shed frontier: offered rate, processed
+  rate, shed fraction, and the queue's high-water mark (which can never
+  exceed ``queue_maxsize`` — that bound is the "zero unbounded memory
+  growth" guarantee).
+
+Front doors: ``fedml-tpu loadgen`` (CLI), ``bench.py --loadgen`` (JSON
+line), and ``tests/test_tenancy.py`` (``-m loadgen``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..comm.message import Message
+from ..comm.resilience import FaultPlan, FaultRule
+from ..core import telemetry
+from ..core.tenancy import CheckinQueue
+from .chaos import _label_totals
+
+MSG_TYPE_CHECKIN = "device_checkin"
+TENANT_KEY = "tenant"
+
+LOADGEN_DEFAULTS = dict(
+    loadgen_duration_s=1.0,
+    loadgen_target_rate=0.0,  # 0 = unthrottled (find the natural ceiling)
+    loadgen_producers=2,
+    loadgen_queue_maxsize=512,
+    loadgen_tenants=2,
+    loadgen_churn=0.1,
+    loadgen_seed=0,
+    loadgen_payload_bytes=64,
+    # fixed simulated device population per producer: devices re-check-in
+    # modulo this, which also bounds the fault plan's per-edge sequence
+    # table (no per-message memory growth on long drills)
+    loadgen_population=50_000,
+)
+
+
+@dataclasses.dataclass
+class LoadGenReport:
+    elapsed_s: float
+    offered: int
+    accepted: int
+    shed: int
+    processed: int
+    churned: int
+    max_queue_depth: int
+    queue_maxsize: int
+    per_tenant_shed: Dict[str, float]
+    per_tenant_accepted: Dict[str, float]
+
+    @property
+    def offered_rate(self) -> float:
+        return self.offered / self.elapsed_s if self.elapsed_s > 0 else 0.0
+
+    @property
+    def processed_rate(self) -> float:
+        return self.processed / self.elapsed_s if self.elapsed_s > 0 else 0.0
+
+    @property
+    def shed_fraction(self) -> float:
+        return self.shed / self.offered if self.offered else 0.0
+
+    @property
+    def ok(self) -> bool:
+        """Accounting closes and the queue bound held: every offered
+        check-in was either accepted or shed, every processed frame was
+        accepted first, and the depth high-water mark never passed the
+        configured bound."""
+        return (self.offered == self.accepted + self.shed
+                and self.processed <= self.accepted
+                and self.max_queue_depth <= self.queue_maxsize)
+
+    def summary(self) -> str:
+        return (
+            f"loadgen: {'PASS' if self.ok else 'FAIL'} — "
+            f"{self.offered_rate:,.0f} check-ins/s offered "
+            f"({self.processed_rate:,.0f}/s processed) over "
+            f"{self.elapsed_s:.2f}s | shed {self.shed} "
+            f"({self.shed_fraction:.1%}), churned {self.churned} | "
+            f"queue depth max {self.max_queue_depth}/{self.queue_maxsize}"
+        )
+
+    def json_record(self) -> dict:
+        """The throughput/shed frontier as one JSON-able dict (the shape
+        ``bench.py --loadgen`` emits)."""
+        return {
+            "elapsed_s": round(self.elapsed_s, 4),
+            "offered": self.offered,
+            "offered_per_sec": round(self.offered_rate, 1),
+            "processed": self.processed,
+            "processed_per_sec": round(self.processed_rate, 1),
+            "shed": self.shed,
+            "shed_fraction": round(self.shed_fraction, 4),
+            "churned": self.churned,
+            "max_queue_depth": self.max_queue_depth,
+            "queue_maxsize": self.queue_maxsize,
+            "queue_depth_bounded": self.max_queue_depth <= self.queue_maxsize,
+            "per_tenant_shed": {k: int(v)
+                                for k, v in sorted(self.per_tenant_shed.items())},
+            "per_tenant_accepted": {
+                k: int(v) for k, v in sorted(self.per_tenant_accepted.items())},
+            "ok": self.ok,
+        }
+
+
+def _checkin_frame(device_id: int, tenant: str, payload: bytes) -> Message:
+    msg = Message(type=MSG_TYPE_CHECKIN, sender_id=device_id, receiver_id=0)
+    msg.add_params(TENANT_KEY, tenant)
+    msg.add_params("capabilities", payload)
+    return msg
+
+
+def run_loadgen(duration_s: float = 1.0, target_rate: float = 0.0,
+                producers: int = 2, queue_maxsize: int = 512,
+                tenants: int = 2, churn: float = 0.1, seed: int = 0,
+                payload_bytes: int = 64,
+                population: int = 50_000) -> LoadGenReport:
+    """Drive the bounded check-in queue as hard as requested and report the
+    throughput/shed frontier. ``target_rate`` throttles the *aggregate*
+    offered rate (0 = each producer runs flat out)."""
+    tenant_names = [f"tenant{i}" for i in range(max(1, int(tenants)))]
+    queue = CheckinQueue(maxsize=int(queue_maxsize))
+    plan = FaultPlan(seed=int(seed),
+                     rules=(FaultRule(action="drop", rate=float(churn)),)
+                     if churn > 0 else ())
+    payload = bytes(int(payload_bytes))
+    stop = threading.Event()
+    churned = [0] * int(producers)
+    processed = [0]
+    per_rate = (float(target_rate) / max(1, int(producers))
+                if target_rate and target_rate > 0 else 0.0)
+
+    registry = telemetry.get_registry()
+    before = (registry.snapshot()["counters"]
+              if telemetry.enabled() else {})
+
+    def produce(worker: int) -> None:
+        t0 = time.perf_counter()
+        i = 0
+        n_tenants = len(tenant_names)
+        pop = max(1, int(population))
+        while not stop.is_set():
+            device_id = worker * 10_000_000 + (i % pop)
+            tenant = tenant_names[device_id % n_tenants]
+            msg = _checkin_frame(device_id, tenant, payload)
+            if plan.active and plan.decide(msg).drop:
+                # seeded churn: this device dropped off mid-announce
+                churned[worker] += 1
+            else:
+                data = msg.to_bytes()
+                queue.offer(data, tenant=tenant)
+            i += 1
+            if per_rate > 0 and i % 64 == 0:
+                # pace toward the per-producer rate (sleep holds no lock)
+                ahead = i / per_rate - (time.perf_counter() - t0)
+                if ahead > 0.001:
+                    time.sleep(min(ahead, 0.05))
+
+    def consume() -> None:
+        while True:
+            data = queue.poll()
+            if data is None:
+                if stop.is_set():
+                    return
+                time.sleep(0.0005)
+                continue
+            msg = Message.from_bytes(data)  # real codec on the drain side too
+            telemetry.record_receive("loadgen", len(data))
+            processed[0] += 1
+            assert msg.get_type() == MSG_TYPE_CHECKIN
+
+    threads = [threading.Thread(target=produce, args=(w,), daemon=True,
+                                name=f"loadgen-p{w}")
+               for w in range(max(1, int(producers)))]
+    consumer = threading.Thread(target=consume, daemon=True,
+                                name="loadgen-consumer")
+    t0 = time.perf_counter()
+    consumer.start()
+    for t in threads:
+        t.start()
+    # bounded wall-clock: the drill runs for duration_s, then drains
+    time.sleep(max(0.01, float(duration_s)))
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    elapsed = time.perf_counter() - t0
+    consumer.join(timeout=10.0)
+
+    after = (registry.snapshot()["counters"]
+             if telemetry.enabled() else {})
+
+    def delta(name: str) -> Dict[str, float]:
+        a = _label_totals(after, name, label="tenant")
+        b = _label_totals(before, name, label="tenant")
+        return {k: v - b.get(k, 0.0) for k, v in a.items()}
+
+    stats = queue.stats()
+    return LoadGenReport(
+        elapsed_s=elapsed,
+        offered=stats["offered"],
+        accepted=stats["accepted"],
+        shed=stats["shed"],
+        processed=processed[0],
+        churned=sum(churned),
+        max_queue_depth=stats["max_depth"],
+        queue_maxsize=stats["maxsize"],
+        per_tenant_shed=delta("fedml_checkins_shed_total"),
+        per_tenant_accepted=delta("fedml_checkins_accepted_total"),
+    )
+
+
+def run_loadgen_from_args(args) -> LoadGenReport:
+    """Map the flat ``loadgen_*`` config keys onto :func:`run_loadgen`."""
+    d = LOADGEN_DEFAULTS
+    return run_loadgen(
+        duration_s=float(getattr(args, "loadgen_duration_s",
+                                 d["loadgen_duration_s"])),
+        target_rate=float(getattr(args, "loadgen_target_rate",
+                                  d["loadgen_target_rate"])),
+        producers=int(getattr(args, "loadgen_producers",
+                              d["loadgen_producers"])),
+        queue_maxsize=int(getattr(args, "loadgen_queue_maxsize",
+                                  d["loadgen_queue_maxsize"])),
+        tenants=int(getattr(args, "loadgen_tenants",
+                            d["loadgen_tenants"])),
+        churn=float(getattr(args, "loadgen_churn", d["loadgen_churn"])),
+        seed=int(getattr(args, "loadgen_seed", d["loadgen_seed"])),
+        payload_bytes=int(getattr(args, "loadgen_payload_bytes",
+                                  d["loadgen_payload_bytes"])),
+        population=int(getattr(args, "loadgen_population",
+                               d["loadgen_population"])),
+    )

@@ -21,6 +21,7 @@ from ..parallel.mesh import AXIS_CLIENT, MeshConfig, create_mesh
 from .fed_sim import FedSimulator, SimConfig, reference_client_sampling
 from .hierarchical import HierarchicalFedSimulator
 from .decentralized import DecentralizedSimulator
+from .multi_run import MultiTenantSimDriver, TenantJob, TenantRunResult
 
 __all__ = [
     "FedSimulator",
@@ -29,6 +30,9 @@ __all__ = [
     "SimulatorTPU",
     "HierarchicalFedSimulator",
     "DecentralizedSimulator",
+    "MultiTenantSimDriver",
+    "TenantJob",
+    "TenantRunResult",
     "reference_client_sampling",
     "build_simulator",
 ]

@@ -295,6 +295,14 @@ class FedSimulator:
         # and feeds the observed shardings here. None (default) leaves the
         # traced program untouched.
         self._sharding_probe: Optional[Callable[[str, Any], None]] = None
+        # multi-tenant round gate (simulation/multi_run.py): called with the
+        # round index at the top of every round-loop iteration, BEFORE the
+        # round's own timing starts — the fair scheduler blocks here until
+        # this job's turn on the mesh. The gate may append a
+        # ("tenant_wait", seconds) interval to _phase_acc so the wait is
+        # attributed rather than lumped into host_other. None (default) =
+        # single-tenant, zero behavior change.
+        self._round_gate: Optional[Callable[[int], None]] = None
 
         sizes = [len(v) for v in fed_data.train_data_local_dict.values()]
         if cfg.num_local_batches is None:
@@ -906,6 +914,8 @@ class FedSimulator:
         self._last_round_end = time.perf_counter()
         try:
             for round_idx in rounds:
+                if self._round_gate is not None:
+                    self._round_gate(round_idx)
                 t0 = time.perf_counter()
                 if self._prefetcher is not None:
                     inputs = self._prefetcher.get(round_idx)
@@ -1008,6 +1018,8 @@ class FedSimulator:
         last_good = snap()
         window: List[float] = []
         for round_idx in rounds:
+            if self._round_gate is not None:
+                self._round_gate(round_idx)
             excluded: set = set()  # cohort positions, grows across retries
             attempts = 0
             t0 = time.perf_counter()

@@ -98,6 +98,24 @@ class RoundStateStore:
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
+        self._fsync_dir(d)
+
+    @staticmethod
+    def _fsync_dir(d: str) -> None:
+        """Durably persist the rename itself: fsync on the temp file only
+        covers the data blocks — until the PARENT DIRECTORY entry is synced,
+        a power cut after ``os.replace`` can still resurface the old file
+        (or none). POSIX-only; best-effort elsewhere."""
+        if not hasattr(os, "O_DIRECTORY"):  # e.g. Windows
+            return
+        try:
+            fd = os.open(d, os.O_DIRECTORY | os.O_RDONLY)
+        except OSError:
+            return
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
 
     def load(self, restore_rng: bool = True) -> dict:
         """Returns ``{"round_idx", "params", "rng_state"}``; by default also

@@ -398,3 +398,109 @@ def test_round_state_store_roundtrip_restores_params_and_rng(tmp_path):
     assert float(state["params"]["b"]) == 0.5
     # RNG was re-seated: post-resume draws match the uninterrupted run
     np.testing.assert_array_equal(np.random.rand(4), expected_draw)
+
+
+def test_round_state_store_crash_mid_save_preserves_previous_state(
+        tmp_path, monkeypatch):
+    """A crash between the temp-file write and the atomic rename must leave
+    the previous round's state fully loadable (the whole point of the
+    tmp + os.replace protocol)."""
+    import os
+
+    from fedml_tpu.utils.checkpoint import RoundStateStore
+
+    store = RoundStateStore(str(tmp_path / "round_state.msgpack"))
+    p1 = {"w": np.ones(3, dtype=np.float32)}
+    store.save(1, p1)
+
+    real_replace = os.replace
+
+    def crash_replace(src, dst):
+        raise OSError("simulated power cut before rename")
+
+    monkeypatch.setattr(os, "replace", crash_replace)
+    with pytest.raises(OSError):
+        store.save(2, {"w": np.zeros(3, dtype=np.float32)})
+    monkeypatch.setattr(os, "replace", real_replace)
+
+    state = RoundStateStore(store.path).load(restore_rng=False)
+    assert state["round_idx"] == 1
+    np.testing.assert_array_equal(state["params"]["w"], p1["w"])
+    # and a post-crash save still goes through cleanly over the leftovers
+    store.save(2, {"w": np.full(3, 2.0, dtype=np.float32)})
+    assert RoundStateStore(store.path).load(
+        restore_rng=False)["round_idx"] == 2
+
+
+@pytest.mark.skipif(not hasattr(__import__("os"), "O_DIRECTORY"),
+                    reason="directory fsync is POSIX-only")
+def test_round_state_store_save_fsyncs_parent_directory(tmp_path, monkeypatch):
+    """fsync on the temp file only persists the data blocks; the rename
+    itself lives in the parent directory entry, which needs its own fsync to
+    survive a power cut."""
+    import os
+    import stat
+
+    from fedml_tpu.utils.checkpoint import RoundStateStore
+
+    synced_dirs = []
+    real_fsync = os.fsync
+
+    def recording_fsync(fd):
+        if stat.S_ISDIR(os.fstat(fd).st_mode):
+            synced_dirs.append(True)
+        return real_fsync(fd)
+
+    monkeypatch.setattr(os, "fsync", recording_fsync)
+    store = RoundStateStore(str(tmp_path / "sub" / "round_state.msgpack"))
+    store.save(3, {"w": np.ones(2, dtype=np.float32)})
+    assert synced_dirs, "save() never fsynced the parent directory"
+
+
+def test_concurrent_retry_send_jitter_stays_per_edge_deterministic(
+        monkeypatch):
+    """Two threads retrying on one shared backend must each see exactly the
+    delay sequence the pure per-edge hash jitter prescribes — thread
+    interleaving must not bleed one edge's backoff into the other's."""
+    import fedml_tpu.comm.resilience as res
+
+    policy = RetryPolicy(max_retries=3, base_delay_s=0.001, max_delay_s=0.1)
+    recorded = {}  # thread ident -> [delay, ...]
+    rec_lock = threading.Lock()
+
+    def recording_sleep(dt):
+        with rec_lock:
+            recorded.setdefault(threading.get_ident(), []).append(dt)
+
+    monkeypatch.setattr(res.time, "sleep", recording_sleep)
+    barrier = threading.Barrier(2)
+    idents = {}
+
+    def edge(receiver_id):
+        fails = [0]
+
+        def flaky():
+            barrier.wait(timeout=5.0)  # maximize interleaving pressure
+            if fails[0] < policy.max_retries:
+                fails[0] += 1
+                raise TransientSendError("blip")
+            return "ok"
+
+        idents[receiver_id] = threading.get_ident()
+        retry_send(flaky, policy=policy, backend="shared",
+                   receiver_id=receiver_id)
+
+    threads = [threading.Thread(target=edge, args=(rid,))
+               for rid in (11, 22)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30.0)
+        assert not t.is_alive()
+
+    for rid in (11, 22):
+        oracle = [policy.delay(a, key=f"shared:{rid}")
+                  for a in range(policy.max_retries)]
+        assert recorded[idents[rid]] == oracle
+    # the jitter is per-edge: distinct receivers draw distinct sequences
+    assert recorded[idents[11]] != recorded[idents[22]]
