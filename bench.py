@@ -478,6 +478,112 @@ def cohort_sweep_bench(sizes=(10, 100, 1000, 10000), pool: int = 20000,
     return 0 if ok else 1
 
 
+def model_sweep_bench(model_axes=(1, 2, 4), rounds: int = 3) -> int:
+    """``--model-sweep``: CPU-only memory-scaling sweep of the 2-D federated
+    mesh — the same SCAFFOLD mnist/lr round loop on a fixed client axis (2)
+    while the model axis grows 1 → 2 → 4. Per mesh it reports the per-device
+    peak HBM from ``device.memory_stats()`` when the backend provides it
+    (TPU), falling back to the per-device RESIDENT bytes of the persistent
+    round state (params + server opt-state + client-state arena + EF
+    residuals, summed over ``addressable_shards``) on backends that return
+    None (CPU). Gate: peak per-device footprint must scale ≈1/model_axis
+    (within 25% — small replicated-fallback leaves dilute the ratio)."""
+    import numpy as np
+
+    import jax
+    import fedml_tpu
+    from fedml_tpu.parallel.mesh import (AXIS_CLIENT, AXIS_MODEL, MeshConfig,
+                                         create_mesh)
+    from fedml_tpu.simulation import build_simulator
+
+    devs = jax.devices()
+    results = []
+    for m in model_axes:
+        if 2 * m > len(devs):
+            print(f"model-sweep: skipping model_axis={m} "
+                  f"(needs {2 * m} devices, have {len(devs)})",
+                  file=sys.stderr, flush=True)
+            continue
+        axes = ((AXIS_CLIENT, 2),)
+        if m > 1:
+            axes += ((AXIS_MODEL, m),)
+        mesh = create_mesh(MeshConfig(axes=axes), devices=devs[:2 * m])
+        args = fedml_tpu.init(config=dict(
+            dataset="mnist", model="lr", debug_small_data=True,
+            client_num_in_total=12, client_num_per_round=4,
+            comm_round=rounds, learning_rate=0.1, epochs=1, batch_size=32,
+            frequency_of_the_test=10_000, random_seed=0,
+            federated_optimizer="SCAFFOLD", prefetch=False,
+        ))
+        sim, _ = build_simulator(args, mesh=mesh)
+        t0 = time.perf_counter()
+        sim.run(apply_fn=None, log_fn=None)
+        wall = time.perf_counter() - t0
+        # resident persistent state per device: every leaf the round loop
+        # keeps alive between rounds, attributed to the device holding each
+        # shard — this is the footprint the model axis divides
+        trees = [sim.params, sim.server_state]
+        if sim._arena is not None:
+            trees.append(list(sim._arena._leaves))
+        if sim._codec_arena is not None:
+            trees.append(list(sim._codec_arena._leaves))
+        resident = {}
+        for leaf in jax.tree.leaves(trees):
+            for shd in leaf.addressable_shards:
+                key = str(shd.device)
+                resident[key] = resident.get(key, 0) + int(shd.data.nbytes)
+        peaks, source = {}, "memory_stats.peak_bytes_in_use"
+        for d in mesh.devices.flat:
+            try:
+                stats = d.memory_stats() or {}
+            except Exception:
+                stats = {}
+            pk = stats.get("peak_bytes_in_use")
+            if pk is not None:
+                peaks[str(d)] = int(pk)
+        if not peaks:
+            # CPU backend: memory_stats() is None — fall back to the
+            # resident-state accounting so the sweep stays meaningful
+            peaks, source = dict(resident), "resident_state_bytes"
+        results.append({
+            "model_axis": int(m),
+            "devices": int(2 * m),
+            "rounds_per_sec": round(rounds / wall, 4) if wall else None,
+            "hbm_source": source,
+            "peak_bytes_per_device": {k: peaks[k] for k in sorted(peaks)},
+            "peak_bytes_max": int(max(peaks.values())),
+            "resident_state_bytes_max": int(max(resident.values())),
+        })
+        print(f"model-sweep: model_axis={m} "
+              f"peak_max={results[-1]['peak_bytes_max']}B "
+              f"({source})", file=sys.stderr, flush=True)
+    by_axis = {r["model_axis"]: r for r in results}
+    base = by_axis.get(1)
+    scaling_ok = base is not None
+    for r in results:
+        if base is None or r["model_axis"] == 1:
+            continue
+        want = base["resident_state_bytes_max"] / r["model_axis"]
+        got = r["resident_state_bytes_max"]
+        r["scaling_vs_model_axis_1"] = round(
+            base["resident_state_bytes_max"] / got, 3) if got else None
+        if not (got <= want * 1.25):
+            scaling_ok = False
+    line = {
+        "metric": "model_sweep_peak_hbm_bytes",
+        "unit": ("peak per-device bytes vs model-axis size (client axis 2, "
+                 "SCAFFOLD mnist/lr, arena client-state backend; hbm_source "
+                 "says whether the backend reported memory_stats or the "
+                 "resident-state fallback was used)"),
+        "results": results,
+        "pass_scales_inverse_model_axis": bool(scaling_ok),
+    }
+    print(json.dumps(line), flush=True)
+    print(f"model-sweep: inverse-scaling={'OK' if scaling_ok else 'FAIL'}",
+          file=sys.stderr, flush=True)
+    return 0 if scaling_ok else 1
+
+
 def chaos_bench(seed: int = 7) -> int:
     """``--chaos``: CPU-only robustness gate — a full loopback cross-silo
     deployment under a seeded fault plan (message drops + injected transient
@@ -667,6 +773,15 @@ if __name__ == "__main__":
         # cohort-axis scaling measurement — host + CPU backend only
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         sys.exit(cohort_sweep_bench())
+    if "--model-sweep" in sys.argv:
+        # model-axis memory scaling — CPU backend with virtual devices; the
+        # flag must land before the first backend init to take effect
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8").strip()
+        sys.exit(model_sweep_bench())
     if "--chaos" in sys.argv:
         # protocol-level drill — loopback only, never touches the chip
         os.environ.setdefault("JAX_PLATFORMS", "cpu")

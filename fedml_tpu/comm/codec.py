@@ -588,7 +588,7 @@ def _flatten_with_paths(tree) -> Tuple[List[str], List[Any], Any]:
     return paths, leaves, treedef
 
 
-def build_stacked_roundtrip(spec, seed: int):
+def build_stacked_roundtrip(spec, seed: int, update_shardings=None):
     """Build the simulator-side codec: a jit-safe function applying
     encode+decode per client along the leading cohort axis.
 
@@ -600,8 +600,23 @@ def build_stacked_roundtrip(spec, seed: int):
     round-base deltas with no explicit base, the same semantics as the
     cross-silo uplink. Residual leaves are f32 mirrors of the update leaves;
     leaves too small to compress pass through with residuals untouched.
+
+    ``update_shardings`` (optional, a pytree of shardings matching the
+    update) re-pins the decoded update AND the new residuals to that layout
+    inside a sharded jit: the top-k scatter/argsort are per-row ops, but on
+    a 2-D (client×model) mesh GSPMD needs the constraint to keep the decoded
+    stack and the EF carry from gathering. Numerically a no-op.
     """
     cs = spec if isinstance(spec, CodecSpec) else parse_codec_spec(spec)
+
+    def _pin(tree):
+        import jax
+
+        if update_shardings is None:
+            return tree
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.lax.with_sharding_constraint(x, s),
+            tree, update_shardings)
 
     def roundtrip(update, residuals, cids_u32, round_u32):
         import jax
@@ -649,9 +664,9 @@ def build_stacked_roundtrip(spec, seed: int):
             out_leaves.append(out.reshape(leaf.shape).astype(leaf.dtype))
         decoded = jax.tree_util.tree_unflatten(treedef, out_leaves)
         if cs.topk is None:
-            return decoded, residuals
-        return decoded, jax.tree_util.tree_unflatten(
-            jax.tree_util.tree_structure(residuals), out_res)
+            return _pin(decoded), residuals
+        return _pin(decoded), _pin(jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(residuals), out_res))
 
     return roundtrip
 

@@ -121,7 +121,7 @@ def _masked_median(x: jax.Array, valid, n_valid: int) -> jax.Array:
 
 
 def sanitize_stacked(stacked_updates: PyTree, weights: jax.Array,
-                     z_thresh: float = 6.0, valid=None):
+                     z_thresh: float = 6.0, valid=None, out_shardings=None):
     """Quarantine poisoned rows of a stacked cohort before any aggregation.
 
     Two detectors, both jit-able over the whole cohort at once:
@@ -146,6 +146,12 @@ def sanitize_stacked(stacked_updates: PyTree, weights: jax.Array,
     perfectly plausible "inlier" that would drag both) and are never
     quarantined (their z is 0). ``valid=None`` is byte-identical to the
     pre-padding behavior.
+
+    ``out_shardings`` (optional, a pytree of shardings matching
+    ``stacked_updates``) re-pins the cleaned stack's layout inside a sharded
+    jit — the zeroing ``where`` is elementwise, but on a 2-D (client×model)
+    mesh the constraint keeps GSPMD from gathering the stack before the
+    aggregation that follows. Numerically a no-op.
     """
     leaves = jax.tree_util.tree_leaves(stacked_updates)
     C = leaves[0].shape[0]
@@ -180,6 +186,10 @@ def sanitize_stacked(stacked_updates: PyTree, weights: jax.Array,
             jnp.zeros_like(x), x),
         stacked_updates,
     )
+    if out_shardings is not None:
+        clean = jax.tree_util.tree_map(
+            lambda x, s: jax.lax.with_sharding_constraint(x, s),
+            clean, out_shardings)
     return clean, weights * keep, quarantine, z
 
 

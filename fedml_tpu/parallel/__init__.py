@@ -23,6 +23,10 @@ from .sharding import (
     shard_along,
     shard_leading_axis,
     replicate_tree,
+    auto_partition_specs,
+    tree_shardings,
+    prepend_axis,
+    transformer_param_specs,
 )
 from .collectives import (
     psum_tree,
@@ -38,6 +42,8 @@ __all__ = [
     "AXIS_CLIENT", "AXIS_DATA", "AXIS_MODEL", "AXIS_PIPE", "AXIS_SEQ", "AXIS_EXPERT",
     "MeshConfig", "create_mesh", "get_default_mesh", "set_default_mesh",
     "replicated", "shard_along", "shard_leading_axis", "replicate_tree",
+    "auto_partition_specs", "tree_shardings", "prepend_axis",
+    "transformer_param_specs",
     "psum_tree", "pmean_tree", "weighted_psum_tree", "all_gather_tree",
     "ppermute_tree", "ring_neighbors",
     "PipelineConfig", "PipelinedLMTrainer", "make_pipe_mesh",

@@ -34,6 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.transformer import TransformerLM
 from .mesh import AXIS_DATA, AXIS_MODEL, AXIS_SEQ, MeshConfig, create_mesh
+from .sharding import transformer_param_specs, tree_shardings
 
 PyTree = Any
 
@@ -75,32 +76,10 @@ def make_lm_mesh(cfg: DistTrainConfig, devices=None) -> Mesh:
     )
 
 
-def transformer_param_specs(params: PyTree) -> PyTree:
-    """Megatron-style TP layout by parameter path.
-
-    qkv / mlp-in kernels: column-sharded (output dim over ``model``);
-    proj / mlp-out: row-sharded (input dim); head: vocab-sharded output;
-    embeddings, norms, biases: replicated.
-    """
-
-    def spec_for(path, leaf) -> P:
-        names = [str(getattr(p, "key", p)) for p in path]
-        joined = "/".join(names)
-        if leaf.ndim < 2:
-            return P()
-        if "qkv" in joined and names[-1] == "kernel":
-            return P(None, AXIS_MODEL)
-        if "proj" in joined and names[-1] == "kernel":
-            return P(AXIS_MODEL, None)
-        if "MLPBlock" in joined and "Dense_0" in joined and names[-1] == "kernel":
-            return P(None, AXIS_MODEL)
-        if "MLPBlock" in joined and "Dense_1" in joined and names[-1] == "kernel":
-            return P(AXIS_MODEL, None)
-        if "head" in joined and names[-1] == "kernel":
-            return P(None, AXIS_MODEL)
-        return P()
-
-    return jax.tree_util.tree_map_with_path(spec_for, params)
+# spec logic lives in the shared sharding layer (the federated simulator's
+# 2-D mesh uses the same module); re-exported here for back-compat
+__all__ = ["transformer_param_specs", "DistTrainConfig", "DistributedLMTrainer",
+           "make_lm_mesh"]
 
 
 class DistributedLMTrainer:
@@ -138,10 +117,7 @@ class DistributedLMTrainer:
             jax.random.PRNGKey(seed), jnp.zeros((1, 8 * max(1, cfg.sp)), jnp.int32)
         )
         self.param_specs = transformer_param_specs(variables)
-        self.param_shardings = jax.tree.map(
-            lambda s: NamedSharding(self.mesh, s), self.param_specs,
-            is_leaf=lambda x: isinstance(x, P),
-        )
+        self.param_shardings = tree_shardings(self.mesh, self.param_specs)
         self.params = jax.device_put(variables, self.param_shardings)
         self.opt = optax.adamw(
             cfg.lr, weight_decay=cfg.weight_decay,

@@ -17,7 +17,7 @@ from .. import data as data_mod
 from .. import models as models_mod
 from ..algorithms import LocalTrainConfig, get_algorithm
 from ..algorithms.local_sgd import infer_loss_kind as _infer_loss_kind
-from ..parallel.mesh import AXIS_CLIENT, MeshConfig, create_mesh
+from ..parallel.mesh import AXIS_CLIENT, AXIS_MODEL, MeshConfig, create_mesh
 from .fed_sim import FedSimulator, SimConfig, reference_client_sampling
 from .hierarchical import HierarchicalFedSimulator
 from .decentralized import DecentralizedSimulator
@@ -120,6 +120,14 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
         client_state_spill_dir=getattr(args, "client_state_spill_dir", None),
         client_state_backend=str(getattr(args, "client_state_backend", "arena")),
         cohort_shard_axis=str(getattr(args, "cohort_shard_axis", AXIS_CLIENT)),
+        # "none"/"off" disables model-axis sharding even on a 2-D mesh
+        model_shard_axis=(
+            None
+            if str(getattr(args, "model_shard_axis", AXIS_MODEL) or "").lower()
+            in ("", "none", "off")
+            else str(getattr(args, "model_shard_axis", AXIS_MODEL))
+        ),
+        model_spec_overrides=getattr(args, "model_spec_overrides", None),
         # only an EXPLICIT spec engages the in-sim codec ("auto" resolves
         # per wire backend and the simulator has none; comm_quantize is a
         # cross-silo knob and must not silently change sim numerics)
@@ -257,20 +265,35 @@ class SimulatorSingleProcess:
 
 class SimulatorTPU:
     """Parrot-TPU: clients sharded over the ICI mesh (replaces SimulatorMPI /
-    SimulatorNCCL, simulator.py:54,206)."""
+    SimulatorNCCL, simulator.py:54,206). ``args.model_axis_size > 1`` builds
+    the 2-D ``client`` × ``model`` mesh: the client axis takes the remaining
+    devices and the global model state shards over the model axis."""
 
     def __init__(self, args, device=None, dataset=None, model=None, mesh=None):
         if mesh is None:
             n_dev = len(jax.devices())
+            model_axis = int(getattr(args, "model_axis_size", 1) or 1)
+            if n_dev % model_axis != 0:
+                raise ValueError(
+                    f"model_axis_size={model_axis} must divide the device "
+                    f"count ({n_dev})")
+            n_cli = n_dev // model_axis
             per_round = int(getattr(args, "client_num_per_round", 10))
             # client axis can't exceed cohort size
-            axis = min(n_dev, per_round) if per_round > 0 else n_dev
+            axis = min(n_cli, per_round) if per_round > 0 else n_cli
             while per_round % axis != 0:  # cohort must divide evenly
                 axis -= 1
-            mesh = create_mesh(
-                MeshConfig(axes=((AXIS_CLIENT, axis),)),
-                devices=jax.devices()[:axis],
-            )
+            if model_axis > 1:
+                mesh = create_mesh(
+                    MeshConfig(axes=((AXIS_CLIENT, axis),
+                                     (AXIS_MODEL, model_axis))),
+                    devices=jax.devices()[: axis * model_axis],
+                )
+            else:
+                mesh = create_mesh(
+                    MeshConfig(axes=((AXIS_CLIENT, axis),)),
+                    devices=jax.devices()[:axis],
+                )
         self.mesh = mesh
         self.sim, self.apply_fn = build_simulator(args, dataset, model, mesh=mesh)
 

@@ -70,7 +70,8 @@ class ClientStateArena:
     def __init__(self, proto: PyTree, capacity: int, *,
                  spill_dir: Optional[str] = None,
                  host_capacity: Optional[int] = None,
-                 mesh=None, axis_name: str = "client"):
+                 mesh=None, axis_name: str = "client",
+                 row_specs: Optional[PyTree] = None):
         leaves, treedef = jax.tree_util.tree_flatten(proto)
         if not leaves:
             raise ValueError("client-state proto has no leaves; the arena "
@@ -88,12 +89,27 @@ class ClientStateArena:
         row_sh = None
         self._axis_size = 1
         if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
             from ..parallel.sharding import shard_along
             axis_size = int(mesh.shape[axis_name])
             self._axis_size = axis_size
             # slots shard evenly over the axis
             self.capacity = -(-self.capacity // axis_size) * axis_size
-            row_sh = shard_along(mesh, axis_name, 0)
+            if row_specs is None:
+                row_sh = [shard_along(mesh, axis_name, 0)] * len(leaves)
+            else:
+                # 2-D mesh: trailing dims of each row carry the model-axis
+                # layout from the proto's inferred specs; dim 0 stays the
+                # slot/client axis
+                spec_leaves = jax.tree_util.tree_leaves(
+                    row_specs, is_leaf=lambda x: isinstance(x, P))
+                if len(spec_leaves) != len(leaves):
+                    raise ValueError(
+                        f"row_specs has {len(spec_leaves)} spec leaves for "
+                        f"{len(leaves)} proto leaves")
+                row_sh = [NamedSharding(mesh, P(axis_name, *s))
+                          for s in spec_leaves]
         self._row_sh = row_sh
         self._spill_dir = spill_dir
         self._host_capacity = host_capacity
@@ -107,8 +123,8 @@ class ClientStateArena:
         self._on_disk: set = set()
 
         self._leaves = [
-            self._to_device(np.zeros((self.capacity,) + p.shape, p.dtype))
-            for p in self._proto_rows
+            self._to_device(np.zeros((self.capacity,) + p.shape, p.dtype), i)
+            for i, p in enumerate(self._proto_rows)
         ]
 
         def _take(arena_leaves, slots):
@@ -237,7 +253,7 @@ class ClientStateArena:
                 f"checkpointed arena capacity {leaves[0].shape[0]} != "
                 f"configured {self.capacity}; restore with the same "
                 "client_state_capacity (and mesh axis size) it was saved with")
-        self._leaves = [self._to_device(l) for l in leaves]
+        self._leaves = [self._to_device(l, i) for i, l in enumerate(leaves)]
         self._slot_client = np.asarray(state["slot_client"], np.int64).copy()
         self._last_used = np.asarray(state["last_used"], np.int64).copy()
         self._clock = int(np.asarray(state["clock"]))
@@ -261,9 +277,9 @@ class ClientStateArena:
 
     # ------------------------------------------------------------ internal
 
-    def _to_device(self, arr: np.ndarray):
+    def _to_device(self, arr: np.ndarray, leaf_idx: int = 0):
         if self._row_sh is not None:
-            return jax.device_put(arr, self._row_sh)
+            return jax.device_put(arr, self._row_sh[leaf_idx])
         return jnp.asarray(arr)
 
     def _ensure(self, ids: np.ndarray) -> np.ndarray:

@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
+import math
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -43,8 +44,14 @@ from ..core import telemetry
 from ..core.algframe import FedAlgorithm
 from ..data.federated import FederatedData
 from ..algorithms.local_sgd import make_eval_fn
-from ..parallel.mesh import AXIS_CLIENT
-from ..parallel.sharding import replicated, shard_along
+from ..parallel.mesh import AXIS_CLIENT, AXIS_MODEL
+from ..parallel.sharding import (
+    auto_partition_specs,
+    prepend_axis,
+    replicated,
+    shard_along,
+    tree_shardings,
+)
 from .client_store import ClientStateArena, cohort_local_update
 from .sampling import reference_client_sampling, sample_clients  # noqa: F401 (re-export)
 
@@ -177,6 +184,23 @@ class SimConfig:
     # the stacked update inside aggregation) shards over; cohorts are
     # padded to a multiple of this axis' size (zero-weight rows)
     cohort_shard_axis: str = AXIS_CLIENT
+    # --- 2-D federated mesh (client × model) ---------------------------
+    # mesh axis the GLOBAL model state shards over: per-leaf PartitionSpecs
+    # are inferred by parallel.sharding.auto_partition_specs (largest-
+    # divisible-dim rule, replicated fallback with one warning) and engage
+    # only when the mesh actually carries this axis with size > 1. Global
+    # params, server opt-state, per-client arena rows, codec EF residuals,
+    # and the stacked cohort update all keep the model axis through the
+    # round jit; local training consumes a transient gathered copy (the
+    # lazy weight gather of Xu et al., arXiv:2004.13336), so round history
+    # stays bit-identical to the 1-D client mesh and the unsharded path.
+    # None/absent axis = 1-D behavior, unchanged.
+    model_shard_axis: Optional[str] = AXIS_MODEL
+    # per-leaf spec overrides, {path-substring: dim-index | None}: matched
+    # against jax.tree_util.keystr leaf paths (sorted patterns, first match
+    # wins); an int shards that dim over the model axis, None pins the
+    # leaf replicated
+    model_spec_overrides: Optional[dict] = None
     # --- compressed update plane ---------------------------------------
     # wire-codec spec (comm/codec.py grammar, e.g. "delta|topk:0.01|q8"):
     # apply the cross-silo uplink codec's lossy encode+decode to every
@@ -327,6 +351,52 @@ class FedSimulator:
                 self._y_dev = jnp.asarray(train.y)
         self._axis_size = (
             1 if mesh is None else int(mesh.shape[cfg.cohort_shard_axis]))
+        # --- 2-D mesh: model-axis sharding of the global state -----------
+        # everything below is None on a 1-D/absent mesh, and every use site
+        # falls back to the replicated 1-D behavior in that case
+        self._model_axis: Optional[str] = None
+        self._param_specs = None   # per-leaf P(...) for params-shaped trees
+        self._param_sh = None      # NamedSharding tree for params/aggregate
+        self._server_sh = None     # NamedSharding tree for server opt-state
+        self._state_specs = None   # per-leaf P(...) for one client's state
+        self._state_sh = None      # cohort×model shardings for stacked state
+        self._update_sh = None     # cohort×model shardings for the stack
+        if (mesh is not None and cfg.model_shard_axis
+                and cfg.model_shard_axis in mesh.axis_names
+                and int(mesh.shape[cfg.model_shard_axis]) > 1):
+            maxis = cfg.model_shard_axis
+            msize = int(mesh.shape[maxis])
+            self._model_axis = maxis
+            # the one warning about replicated-fallback leaves comes from
+            # THIS call; server/client-state inference below warns nothing
+            # (their leaves mirror or derive from the params')
+            self._param_specs = auto_partition_specs(
+                init_variables, maxis, msize,
+                overrides=cfg.model_spec_overrides)
+            self._param_sh = tree_shardings(mesh, self._param_specs)
+            self.params = jax.device_put(self.params, self._param_sh)
+            if jax.tree_util.tree_leaves(self.server_state):
+                srv_specs = auto_partition_specs(
+                    self.server_state, maxis, msize,
+                    overrides=cfg.model_spec_overrides, warn=False)
+                self._server_sh = tree_shardings(mesh, srv_specs)
+                self.server_state = jax.device_put(
+                    self.server_state, self._server_sh)
+            if self._client_state_proto != ():
+                self._state_specs = auto_partition_specs(
+                    self._client_state_proto, maxis, msize,
+                    overrides=cfg.model_spec_overrides, warn=False)
+                self._state_sh = tree_shardings(
+                    mesh, prepend_axis(self._state_specs,
+                                       cfg.cohort_shard_axis))
+            # params-shaped update stacks (and the codec's EF residual
+            # rows) mirror params with a leading cohort axis; algorithms
+            # with custom update structures (SCAFFOLD's {delta, delta_c})
+            # get their stack specs inferred at trace time instead
+            if getattr(algorithm, "update_is_params", True):
+                self._update_sh = tree_shardings(
+                    mesh, prepend_axis(self._param_specs,
+                                       cfg.cohort_shard_axis))
         self._batch_counts = {
             c: max(1, -(-len(v) // cfg.batch_size))
             for c, v in fed_data.train_data_local_dict.items()
@@ -365,12 +435,17 @@ class FedSimulator:
                     f"algorithm {type(algorithm).__name__} produces a "
                     "custom update structure")
             self._codec_rt = wire_codec.build_stacked_roundtrip(
-                self._codec_spec, cfg.seed)
+                self._codec_spec, cfg.seed,
+                # 2-D mesh: decoded updates + EF carry stay cohort×model
+                update_shardings=self._update_sh)
             self._codec_record = wire_codec.record_codec
             self._codec_wire = wire_codec.spec_wire_nbytes(
                 self._codec_spec, init_variables)
         force_even = (self._detect or update_transform is not None
-                      or self._codec_spec is not None)
+                      or self._codec_spec is not None
+                      # model-axis sharding pins the stacked update to the
+                      # params' specs — only the even path materializes it
+                      or self._model_axis is not None)
         mean_agg = (
             algorithm.aggregate is None
             and getattr(algorithm, "update_is_params", True)
@@ -391,8 +466,8 @@ class FedSimulator:
             raise ValueError(
                 f"cohort_schedule='{schedule}' is incompatible with the "
                 "update sanitizer / watchdog / injected attacks / "
-                "comm_codec — those need the full stacked cohort "
-                "(use 'even' or 'auto')")
+                "comm_codec / model-axis sharding — those need the full "
+                "stacked cohort (use 'even' or 'auto')")
         if force_even:
             schedule = "even"
         if schedule == "auto":
@@ -450,14 +525,16 @@ class FedSimulator:
                 spill_dir=cfg.client_state_spill_dir,
                 host_capacity=(capacity if cfg.client_state_spill_dir
                                else None),
-                mesh=mesh, axis_name=cfg.cohort_shard_axis)
+                mesh=mesh, axis_name=cfg.cohort_shard_axis,
+                row_specs=self._state_specs)
             if algorithm.prepare_client_state is not None:
                 # same per-client prepare as the dict path, vectorized over
                 # the stacked cohort (pure restructuring — bit-exact); on a
                 # mesh the output must stay on the cohort axis (vmap can
                 # broadcast server-state-derived leaves to replicated, which
                 # the round step's in_shardings would then reject)
-                prep_sh = (shard_along(mesh, cfg.cohort_shard_axis, 0)
+                prep_sh = (self._state_sh if self._state_sh is not None
+                           else shard_along(mesh, cfg.cohort_shard_axis, 0)
                            if mesh is not None else None)
                 self._prepare_fn = jax.jit(
                     jax.vmap(algorithm.prepare_client_state, in_axes=(None, 0)),
@@ -472,7 +549,9 @@ class FedSimulator:
                 lambda p: np.zeros(np.shape(p), np.float32), init_variables)
             self._codec_arena = ClientStateArena(
                 res_proto, capacity, mesh=mesh,
-                axis_name=cfg.cohort_shard_axis)
+                axis_name=cfg.cohort_shard_axis,
+                # EF residual rows are params-shaped: same model layout
+                row_specs=self._param_specs)
         self._round_step = self._build_round_step()
         if self._packed:
             self._packed_step = self._build_packed_step()
@@ -500,26 +579,102 @@ class FedSimulator:
         def _probe(tag, tree):
             if self._sharding_probe is not None:
                 probe = self._sharding_probe
+                leaves = jax.tree_util.tree_leaves(tree)
+                if not leaves:
+                    return
+                # probe the LARGEST leaf: small leaves (biases) legitimately
+                # fall back to replicated under the model axis, so they say
+                # nothing about whether the big tensors stayed sharded
+                big = max(leaves, key=lambda l: math.prod(l.shape))
                 jax.debug.inspect_array_sharding(
-                    jax.tree_util.tree_leaves(tree)[0],
-                    callback=lambda s, tag=tag: probe(tag, s))
+                    big, callback=lambda s, tag=tag: probe(tag, s))
 
         codec_rt = self._codec_rt
         codec_ef = self._codec_arena is not None
+        update_sh = self._update_sh  # per-leaf cohort×model (or None on 1-D)
+        mdl = self._model_axis is not None
+        rep_sh = replicated(mesh) if mesh is not None else None
+        maxis = self._model_axis
+        msize = int(mesh.shape[maxis]) if mdl else 1
+        overrides = self.cfg.model_spec_overrides
+
+        def _pin(tree, sh):
+            """Per-leaf with_sharding_constraint (sh a matching tree)."""
+            return jax.tree.map(
+                lambda u, s: jax.lax.with_sharding_constraint(u, s), tree, sh)
+
+        def _infer_sh(tree, leading_cohort: bool):
+            """Trace-time model-axis shardings for an arbitrary tree (the
+            update/aggregate structure is algorithm-defined, so its specs
+            come from the traced shapes — same largest-divisible-dim rule
+            as the init-time params/opt-state inference, minus the leading
+            cohort dim for stacked trees)."""
+            shapes = jax.tree.map(
+                lambda u: jax.ShapeDtypeStruct(
+                    u.shape[1:] if leading_cohort else u.shape, u.dtype),
+                tree)
+            specs = auto_partition_specs(
+                shapes, maxis, msize, overrides=overrides, warn=False)
+            if leading_cohort:
+                specs = prepend_axis(specs, self.cfg.cohort_shard_axis)
+            return tree_shardings(mesh, specs)
 
         def round_body(params, server_state, cohort, client_states, rng,
                        codec_res=(), cids_u32=None, round_u32=None):
-            outs = _cohort_outputs(alg, params, cohort, client_states, rng)
+            if mdl:
+                _probe("params_in", params)
+                # Xu et al. (arXiv:2004.13336) lazy weight gather: local
+                # training computes on a TRANSIENT replicated view; the
+                # persistent params (donated input, updated output) never
+                # leave the model-axis layout, so per-client math is
+                # bit-identical to the 1-D path while the resident
+                # footprint stays 1/model_axis
+                train_params = jax.tree.map(
+                    lambda p: jax.lax.with_sharding_constraint(p, rep_sh),
+                    params)
+                # same lazy gather for the stacked per-client rows
+                # (SCAFFOLD's broadcast c / c_local): persistent on
+                # cohort×model, consumed through a transient 1-D-layout
+                # view so the local-update math lowers identically to the
+                # 1-D mesh
+                train_client_states = jax.tree.map(
+                    lambda s: jax.lax.with_sharding_constraint(s, cohort_sh),
+                    client_states)
+            else:
+                train_params = params
+                train_client_states = client_states
+            outs = _cohort_outputs(alg, train_params, cohort,
+                                   train_client_states, rng)
             update = outs.update
             w = outs.weight.astype(jnp.float32)
+            upd_sh = None
             if mesh is not None:
-                # pin the stacked update to the cohort axis: everything
-                # below reduces over clients, and without the constraint
-                # GSPMD may all-gather the full stack onto every device
-                # before sanitize/Krum/mean see it
-                update = jax.tree.map(
-                    lambda u: jax.lax.with_sharding_constraint(u, cohort_sh),
-                    update)
+                # pin the stacked update to the cohort axis (and, on a 2-D
+                # mesh, each leaf's trailing dims to their model specs):
+                # everything below reduces over clients, and without the
+                # constraint GSPMD may all-gather the full stack onto
+                # every device before sanitize/Krum/mean see it
+                if mdl:
+                    # TWO pins, deliberately. Pinning straight to the
+                    # cohort×model layout lets GSPMD propagate the model
+                    # axis BACKWARD into local training, re-partitioning
+                    # softmax/contraction reductions and breaking bit
+                    # parity with the 1-D program. The first pin holds the
+                    # stack on the cohort axis only (replicated over model
+                    # — the exact 1-D layout), acting as a propagation
+                    # barrier; the second reshards to cohort×model, which
+                    # is a pure slice with no arithmetic.
+                    update = jax.tree.map(
+                        lambda u: jax.lax.with_sharding_constraint(
+                            u, cohort_sh),
+                        update)
+                    upd_sh = _infer_sh(update, leading_cohort=True)
+                    update = _pin(update, upd_sh)
+                else:
+                    update = jax.tree.map(
+                        lambda u: jax.lax.with_sharding_constraint(
+                            u, cohort_sh),
+                        update)
             if codec_rt is not None:
                 # lossy wire roundtrip FIRST: the attacker corrupts what the
                 # server decodes (cross-silo decompress-then-corrupt order)
@@ -535,11 +690,16 @@ class FedSimulator:
                 from ..core.robust import sanitize_stacked
 
                 update, w, quar, z = sanitize_stacked(
-                    update, w, z_thresh, valid=valid_np)
+                    update, w, z_thresh, valid=valid_np,
+                    out_shardings=upd_sh)
                 # one (2, C) row pair [quarantine flag, robust z] rides back
                 # with the metrics — a single extra host transfer per round
                 qz = jnp.stack([quar.astype(jnp.float32),
                                 jnp.nan_to_num(z, posinf=1e30)])
+            if mdl and (codec_rt is not None or transform is not None):
+                # codec/attack stages are elementwise over rows but carry
+                # no layout promise — re-pin before the reduction
+                update = _pin(update, upd_sh)
             _probe("update", update)
             if alg.aggregate is not None:
                 agg = alg.aggregate(update, w)
@@ -547,8 +707,17 @@ class FedSimulator:
                 from ..core.algframe import weighted_mean
 
                 agg = weighted_mean(update, w)
+            if mdl:
+                # the client-axis reduction leaves each aggregate leaf on
+                # its model layout — pin it so the optimizer apply below
+                # runs sharded (Krum's gather notwithstanding, its RESULT
+                # comes back to the model axis here)
+                agg = _pin(agg, _infer_sh(agg, leading_cohort=False))
             _probe("agg", agg)
             new_params, new_server_state = alg.server_update(params, agg, server_state)
+            if mdl:
+                _probe("params_out", new_params)
+                _probe("opt_state_out", new_server_state)
             # reduce metrics to ONE tiny vector inside the program: each
             # separate host read is a device round trip (expensive over a
             # tunneled chip), so the round's metrics come back in a single
@@ -566,7 +735,17 @@ class FedSimulator:
                 (m["train_correct"].sum()
                  / jnp.maximum(m["train_valid"].sum(), 1.0)).astype(jnp.float32),
             ])
-            ret = (new_params, new_server_state, outs.state, metrics_vec)
+            new_cstate = outs.state
+            if mdl and self._state_sh is not None:
+                # same barrier as the update stack: hold the new client
+                # rows on the 1-D layout first so the model-sharded
+                # out_shardings can't propagate back into training, then
+                # reshard to cohort×model
+                new_cstate = jax.tree.map(
+                    lambda s: jax.lax.with_sharding_constraint(s, cohort_sh),
+                    new_cstate)
+                new_cstate = _pin(new_cstate, self._state_sh)
+            ret = (new_params, new_server_state, new_cstate, metrics_vec)
             if detect:
                 ret += (qz,)
             if codec_ef:
@@ -598,17 +777,27 @@ class FedSimulator:
         n_extra = 2 if self._use_device_data else 0
         if mesh is not None:
             rep = replicated(mesh)
-            in_sh = (rep, rep, cohort_sh, cohort_sh, rep)
+            # 2-D mesh: params/server-state enter and leave on their
+            # model-axis layouts; stacked client state and EF residuals
+            # carry cohort×model. 1-D mesh: everything global replicated,
+            # cohort trees on the client axis — unchanged.
+            p_sh = self._param_sh if mdl else rep
+            s_sh = (self._server_sh if (mdl and self._server_sh is not None)
+                    else rep)
+            st_sh = (self._state_sh if (mdl and self._state_sh is not None)
+                     else cohort_sh)
+            res_sh = update_sh if mdl else cohort_sh
+            in_sh = (p_sh, s_sh, cohort_sh, st_sh, rep)
             if codec_rt is not None:
                 # residual stack + client-id vector ride the cohort axis;
                 # the round scalar is replicated
-                in_sh += (cohort_sh, cohort_sh, rep)
+                in_sh += (res_sh, cohort_sh, rep)
             in_sh += (rep,) * n_extra
-            out_sh = (rep, rep, cohort_sh, rep)
+            out_sh = (p_sh, s_sh, st_sh, rep)
             if detect:
                 out_sh += (rep,)
             if codec_ef:
-                out_sh += (cohort_sh,)
+                out_sh += (res_sh,)
             return jax.jit(
                 round_step,
                 in_shardings=in_sh,
@@ -1197,6 +1386,17 @@ class FedSimulator:
                 # tracked separately — NOT part of the round_time breakdown
                 reg.histogram(
                     "fedml_host_pack_seconds").observe(rec["pack_time"])
+            # per-round HBM watermark (model-sharding headroom signal);
+            # CPU/interpret backends report no memory_stats — skip quietly
+            for d in jax.local_devices():
+                try:
+                    ms = d.memory_stats() or {}
+                except Exception:
+                    ms = {}
+                peak = ms.get("peak_bytes_in_use")
+                if peak is not None:
+                    reg.gauge("fedml_device_hbm_peak_bytes",
+                              device=str(d)).set(float(peak))
         self._post_round(rec, rec["round"], apply_fn, ckpt, log_fn)
 
     def _post_round(self, rec, round_idx, apply_fn, ckpt, log_fn) -> None:
@@ -1212,6 +1412,10 @@ class FedSimulator:
     def _post_round_body(self, rec, round_idx, apply_fn, ckpt, log_fn) -> None:
         if apply_fn is not None and self._should_eval(round_idx):
             t_eval = time.perf_counter()
+            # inner phases stamped during eval (the model-sharded path's
+            # params gather lands on "reshard") are subtracted so eval +
+            # reshard + ... still partition the round
+            n_eval_acc = len(self._phase_acc)
             handled = False
             if self._server_tester is not None:
                 # reference signature (FedAVGAggregator.py:130): the real
@@ -1230,7 +1434,9 @@ class FedSimulator:
                 rec.update(self.evaluate(apply_fn))
                 if self.cfg.local_test_on_all_clients:
                     rec.update(self.local_test_on_all_clients(apply_fn))
-            self._phase_acc.append(("eval", time.perf_counter() - t_eval))
+            t_inner = sum(dt for _, dt in self._phase_acc[n_eval_acc:])
+            self._phase_acc.append(
+                ("eval", time.perf_counter() - t_eval - t_inner))
         self.history.append(rec)
         if ckpt is not None and self._should_checkpoint(round_idx):
             from ..utils.checkpoint import save_simulator_state
@@ -1343,7 +1549,20 @@ class FedSimulator:
         return payload
 
     def _dispatch_even(self, inputs: RoundInputs, step_rng):
-        cohort = {k: jnp.asarray(v) for k, v in inputs.payload.items()}
+        if self.mesh is not None:
+            # explicit placement of the round's host tensors under the
+            # cohort axis, timed as its own phase: on a 2-D mesh the same
+            # stamp also carries the lazy params gather/reshard cost that
+            # GSPMD schedules at dispatch, so round phases keep summing
+            # exactly to round_time instead of hiding layout traffic in
+            # dispatch/host_other
+            t = time.perf_counter()
+            c_sh = shard_along(self.mesh, self.cfg.cohort_shard_axis, 0)
+            cohort = {k: jax.device_put(np.asarray(v), c_sh)
+                      for k, v in inputs.payload.items()}
+            self._phase_acc.append(("reshard", time.perf_counter() - t))
+        else:
+            cohort = {k: jnp.asarray(v) for k, v in inputs.payload.items()}
         ids = inputs.client_ids
         pad = self._cohort_pad
         stateful = self._client_state_proto != ()
@@ -1704,6 +1923,19 @@ class FedSimulator:
             (correct_sum / jnp.maximum(valid_sum, 1.0)).astype(jnp.float32),
         ])
 
+    def _eval_params(self) -> PyTree:
+        """Params view for host-driven eval programs. On a model-sharded
+        mesh this is the lazy gather to replicated (eval jits are compiled
+        over full tensors, and a replicated view keeps their numerics
+        bit-identical to the 1-D path); the gather cost lands on the
+        ``reshard`` phase so eval timing stays honest."""
+        if self._model_axis is None:
+            return self.params
+        t = time.perf_counter()
+        p = jax.device_put(self.params, replicated(self.mesh))
+        self._phase_acc.append(("reshard", time.perf_counter() - t))
+        return p
+
     def evaluate(self, apply_fn) -> Dict[str, float]:
         if self._eval_fn is None:
             self._eval_fn = self._build_eval(apply_fn)
@@ -1713,7 +1945,7 @@ class FedSimulator:
             return {}
         bs = min(self.cfg.eval_batch_size, n)
         xs, ys, ms = self._pad_and_batch(test.x, test.y, bs)
-        l, c, cnt = self._eval_fn(self.params, xs, ys, ms)
+        l, c, cnt = self._eval_fn(self._eval_params(), xs, ys, ms)
         return {
             "test_loss": float(l) / max(float(cnt), 1.0),
             "test_acc": float(c) / max(float(cnt), 1.0),
@@ -1888,6 +2120,7 @@ class FedSimulator:
         ])
         out: Dict[str, Any] = {}
         per_client: Dict[str, List[float]] = {}
+        eval_params = self._eval_params()
         for split, agg_prefix in (("train", "local_train"),
                                   ("test", "local_test")):
             cached = self._local_eval_batches(split)
@@ -1895,10 +2128,10 @@ class FedSimulator:
                 continue
             kind, batched, rep = cached
             if kind == "gather":
-                res = seg_eval_gather(self.params, *batched,
+                res = seg_eval_gather(eval_params, *batched,
                                       self._x_dev, self._y_dev)
             else:
-                res = seg_eval(self.params, *batched)
+                res = seg_eval(eval_params, *batched)
             L, K, N, S = (np.asarray(v) for v in res)
             # fan the representative accumulators out to their group (shared
             # ArrayPairs were evaluated once); rep -1 = client has no data

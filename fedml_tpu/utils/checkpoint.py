@@ -149,9 +149,22 @@ def save_simulator_state(manager: CheckpointManager, sim, round_idx: int) -> Non
 
 def restore_simulator_state(manager: CheckpointManager, sim) -> int:
     """Restore into ``sim``; returns the next round index to run."""
+    import jax
+
     state = manager.restore()
-    sim.params = state["params"]
-    sim.server_state = state["server_state"]
+    params = state["params"]
+    server_state = state["server_state"]
+    # model-sharded simulators: re-place the restored host arrays under the
+    # sim's per-leaf shardings (device_put moves bits, never values — a
+    # resumed run stays bit-exact vs an uninterrupted one)
+    param_sh = getattr(sim, "_param_sh", None)
+    if param_sh is not None:
+        params = jax.device_put(params, param_sh)
+        server_sh = getattr(sim, "_server_sh", None)
+        if server_sh is not None and jax.tree_util.tree_leaves(server_state):
+            server_state = jax.device_put(server_state, server_sh)
+    sim.params = params
+    sim.server_state = server_state
     arena = getattr(sim, "_arena", None)
     if arena is not None and state.get("client_arena") is not None:
         arena.import_state(state["client_arena"])

@@ -44,3 +44,9 @@ def key_time_seed(codec):
 
 def roundtrip_without_seed(spec, codec):
     return codec.build_stacked_roundtrip(spec)
+
+
+def spec_leaf_order(param_paths):
+    # partition-spec inference iterating an unordered set of leaf paths:
+    # two runs could assign specs in different orders
+    return list(set(param_paths))

@@ -33,3 +33,8 @@ def roundtrip_seeded(spec, codec, seed):
 def roundtrip_forwarded(spec, codec, **kw):
     # kwargs splat may carry the seed — not flaggable statically
     return codec.build_stacked_roundtrip(spec, **kw)
+
+
+def spec_leaf_order(param_paths):
+    distinct = set(param_paths)
+    return sorted(distinct)

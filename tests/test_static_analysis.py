@@ -100,6 +100,7 @@ def test_determinism_fires_on_bad_fixture():
     assert "time_seeded:time-seed:default_rng" in keys
     assert "reseed_global:global-seed" in keys
     assert "cohort_order:set-order" in keys
+    assert "spec_leaf_order:set-order" in keys
     assert "quantize_without_seed:stochastic-unseeded:stochastic_quantize" in keys
     assert "quantize_none_seed:stochastic-unseeded:stochastic_quantize" in keys
     assert "key_time_seed:time-seed:stochastic_key" in keys
