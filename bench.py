@@ -334,7 +334,7 @@ def telemetry_overhead_bench(rounds: int = 20, trials: int = 3,
     the noise-robust estimator for a lower-bounded cost. Also asserts the
     per-round phase breakdown covers round_time within 5%."""
     import fedml_tpu
-    from fedml_tpu.core import telemetry
+    from fedml_tpu.core import telemetry, trace_plane
     from fedml_tpu.simulation import build_simulator
 
     args = fedml_tpu.init(config=dict(
@@ -348,6 +348,10 @@ def telemetry_overhead_bench(rounds: int = 20, trials: int = 3,
 
     def _block(enabled: bool) -> float:
         telemetry.configure(enabled=enabled)
+        # the <1% budget must hold with the full trace plane armed, not
+        # just the PR 2 metrics layer (ISSUE 10 acceptance)
+        trace_plane.configure(ship_spans=enabled, anomaly_detection=enabled,
+                              flight_recorder=enabled)
         sim.history.clear()
         t0 = time.perf_counter()
         sim.run(apply_fn=None, log_fn=None)
@@ -361,8 +365,11 @@ def telemetry_overhead_bench(rounds: int = 20, trials: int = 3,
     overhead = (on - off) / off if off > 0 else 0.0
     # phase coverage from the last ENABLED block's history
     telemetry.configure(enabled=True)
+    trace_plane.configure(ship_spans=True, anomaly_detection=True,
+                          flight_recorder=True)
     sim.history.clear()
     sim.run(apply_fn=None, log_fn=None)
+    trace_plane.reset()
     phases = _phase_stats(sim.history)
     cov = phases.get("coverage_frac") or 0.0
     cov_ok = abs(cov - 1.0) <= 0.05

@@ -17,18 +17,40 @@ generates ``docs/config_reference.md``):
 - **read-but-undocumented** — a key the code reads that the committed doc
   doesn't list (same staleness, from the other side; both disappear when
   ``scripts/gen_config_reference.py`` is re-run).
+- **phase-name drift** — every phase string the simulator accumulates via
+  ``_phase_acc.append(("<name>", dt))`` must appear in
+  ``docs/observability.md``; dashboards and the anomaly detector key on
+  these names, so an undocumented phase is an invisible one.
 """
 
 from __future__ import annotations
 
+import ast
 import os
 import re
-from typing import Dict, Iterable, List
+from typing import Dict, Iterable, List, Tuple
 
 from .config_scan import KeyRecord, merge_read, scan_tree
 from .core import Checker, Finding, Module
 
 _DOC_KEY_RE = re.compile(r"^\|\s*`([a-z_][a-z0-9_]*)`\s*\|")
+
+
+def _phase_appends(tree: ast.AST) -> Iterable[Tuple[str, int]]:
+    """Yield ``(phase_name, lineno)`` for ``*._phase_acc.append(("x", dt))``."""
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "append"
+                and isinstance(node.func.value, ast.Attribute)
+                and node.func.value.attr == "_phase_acc"
+                and node.args):
+            continue
+        arg = node.args[0]
+        if (isinstance(arg, ast.Tuple) and arg.elts
+                and isinstance(arg.elts[0], ast.Constant)
+                and isinstance(arg.elts[0].value, str)):
+            yield arg.elts[0].value, node.lineno
 
 
 def _literal(text: str):
@@ -53,6 +75,7 @@ class ConfigDriftChecker(Checker):
     def __init__(self, ctx):
         super().__init__(ctx)
         self._records: Dict[str, KeyRecord] = {}
+        self._phases: Dict[str, Tuple[str, int]] = {}
 
     def visit_module(self, module: Module) -> Iterable[Finding]:
         for read in scan_tree(module.tree, module.relpath):
@@ -63,12 +86,18 @@ class ConfigDriftChecker(Checker):
             if "*" in ids or self.id in ids:
                 continue
             merge_read(self._records, read)
+        for name, lineno in _phase_appends(module.tree):
+            ids = module.suppressions.get(lineno, ())
+            if "*" in ids or self.id in ids:
+                continue
+            self._phases.setdefault(name, (module.relpath, lineno))
         return ()
 
     def finalize(self) -> Iterable[Finding]:
         findings: List[Finding] = []
         findings.extend(self._conflicting_defaults())
         findings.extend(self._doc_drift())
+        findings.extend(self._phase_drift())
         return findings
 
     def _conflicting_defaults(self) -> List[Finding]:
@@ -130,4 +159,23 @@ class ConfigDriftChecker(Checker):
                     message=(f"key '{key}' is read here but missing from "
                              f"{doc_rel} — re-run scripts/gen_config_reference.py"),
                     key=f"undocumented:{key}"))
+        return findings
+
+    def _phase_drift(self) -> List[Finding]:
+        doc_path = os.path.join(self.ctx.repo_root, "docs", "observability.md")
+        doc_rel = "docs/observability.md"
+        if not os.path.exists(doc_path):
+            return []
+        with open(doc_path, encoding="utf-8") as f:
+            doc_text = f.read()
+        findings: List[Finding] = []
+        for name, (relpath, lineno) in sorted(self._phases.items()):
+            if re.search(rf"\b{re.escape(name)}\b", doc_text):
+                continue
+            findings.append(Finding(
+                checker=self.id, path=relpath, line=lineno,
+                message=(f"phase '{name}' is emitted here but never mentioned "
+                         f"in {doc_rel} — dashboards and the phase-anomaly "
+                         "detector key on phase names; document it"),
+                key=f"phase-undocumented:{name}"))
         return findings

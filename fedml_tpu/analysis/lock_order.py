@@ -40,6 +40,7 @@ SCOPE_FILES = (
     "fedml_tpu/core/telemetry.py",
     "fedml_tpu/core/mlops.py",
     "fedml_tpu/core/tenancy.py",
+    "fedml_tpu/core/trace_plane.py",
     "fedml_tpu/cli/runner.py",
     "fedml_tpu/simulation/prefetch.py",
     "fedml_tpu/simulation/multi_run.py",

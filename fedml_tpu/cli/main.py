@@ -222,7 +222,11 @@ def analyze(graftcheck_args):
 @cli.command("run", help="Run a simulation from a YAML config.")
 @click.option("--cf", "config_file", required=True, type=click.Path(exists=True))
 @click.option("--backend", default=None, help="sp | TPU (overrides YAML)")
-def run(config_file, backend):
+@click.option("--flight-record", is_flag=True,
+              help="Arm the flight recorder for this run (equivalent to "
+                   "flight_recorder: true in the YAML): crashes, rollbacks "
+                   "and SIGTERM dump a black-box bundle under flight_dir.")
+def run(config_file, backend, flight_record):
     import fedml_tpu
     from fedml_tpu.arguments import load_arguments
 
@@ -230,6 +234,8 @@ def run(config_file, backend):
     args = load_arguments(args_list=args_list)
     if backend:
         args.backend = backend
+    if flight_record:
+        args.flight_recorder = True
     fedml_tpu.init(args=args)
     with open(_state_path("status.json"), "w") as f:
         json.dump({"status": "RUNNING", "time": time.time()}, f)
@@ -277,13 +283,19 @@ def run(config_file, backend):
 @click.option("--tenant", default=None,
               help="Scope the drill's telemetry accounting to this tenant "
                    "(counters land tenant-labeled; deltas filter to them).")
+@click.option("--flight-record", is_flag=True,
+              help="Arm the flight recorder + span shipping for the drill; "
+                   "crashes and rollbacks dump a black-box bundle, and one "
+                   "manual bundle is written when the drill ends.")
+@click.option("--flight-dir", default="flight_records", type=click.Path(),
+              help="Directory flight bundles land in (with --flight-record).")
 @click.option("--json", "as_json", is_flag=True,
               help="Emit the drill outcome as one JSON line (the same "
                    "reporter bench.py --chaos uses) instead of the summary.")
 def chaos_drill(seed, rounds, clients, drop_rate, duplicate_rate,
                 fail_send_rate, crash_rank, crash_at_round, byzantine_kind,
                 byzantine_rate, byzantine_scale, defend, codec, timeout,
-                tenant, as_json):
+                tenant, flight_record, flight_dir, as_json):
     """Stand up a full cross-silo deployment (server + clients, real codec,
     real round FSM) under the given fault plan and verify every round still
     closes. Exits 1 if the run hangs or loses rounds — the same check
@@ -314,10 +326,23 @@ def chaos_drill(seed, rounds, clients, drop_rate, duplicate_rate,
         parse_codec_spec(codec)
         kw.update(comm_codec=codec)
     from ..core import telemetry
-    if (codec is not None or tenant is not None) and not telemetry.enabled():
+    if (codec is not None or tenant is not None or flight_record) \
+            and not telemetry.enabled():
         # the codec verdict and tenant scoping read counter deltas
         telemetry.configure(enabled=True)
+    if flight_record:
+        # through the drill's config (not configure() here): the drill's
+        # fedml_tpu.init re-reads the trace-plane family from its args and
+        # would reset a pre-set flight_dir back to the default
+        kw.update(flight_recorder=True, flight_dir=flight_dir,
+                  trace_ship_spans=True)
     result = run_chaos_drill(join_timeout_s=timeout, tenant=tenant, **kw)
+    if flight_record:
+        from ..core import trace_plane
+
+        bundle = trace_plane.flight_dump("manual", force=True)
+        if bundle:
+            click.echo(f"flight bundle: {bundle}")
     click.echo(json.dumps(result.json_record()) if as_json
                else result.summary())
     if not result.ok:
@@ -459,7 +484,12 @@ def telemetry_group():
 @telemetry_group.command(
     "summary", help="Summarize a telemetry JSONL file (spans + registry).")
 @click.argument("jsonl_path", type=click.Path(exists=True))
-def telemetry_summary(jsonl_path):
+@click.option("--tenant", default=None,
+              help="Restrict to one tenant's spans and series (multi-run "
+                   "JSONL files interleave every tenant's records).")
+def telemetry_summary(jsonl_path, tenant):
+    from ..core import telemetry as _telemetry
+
     spans = {}
     snapshot = None
     skipped = 0
@@ -475,6 +505,8 @@ def telemetry_summary(jsonl_path):
                 continue
             kind = rec.get("kind")
             if kind == "span":
+                if tenant is not None and rec.get("tenant") != tenant:
+                    continue
                 s = spans.setdefault(
                     rec.get("name", "?"), {"durations": [], "traces": set()})
                 s["durations"].append(float(rec.get("duration", 0.0)))
@@ -482,6 +514,9 @@ def telemetry_summary(jsonl_path):
                     s["traces"].add(rec["trace_id"])
             elif kind == "registry_snapshot":
                 snapshot = rec.get("registry")  # keep the LAST one
+    if snapshot is not None and tenant is not None:
+        # the same filtering TenantRegistry.snapshot applies in-process
+        snapshot = _telemetry.filter_snapshot(snapshot, tenant)
     if spans:
         click.echo("spans:")
         click.echo(f"  {'name':<28}{'count':>7}{'total_s':>10}"
@@ -495,6 +530,11 @@ def telemetry_summary(jsonl_path):
                        f"{len(spans[name]['traces']):>8}")
     if snapshot:
         counters = snapshot.get("counters", {})
+        dropped = sum(v for k, v in counters.items()
+                      if k.startswith("fedml_spans_dropped_total"))
+        if dropped:
+            click.echo(f"spans dropped (ring evictions): {dropped:g} — "
+                       "raise telemetry_span_buffer to keep them")
         if counters:
             click.echo("counters:")
             for key in sorted(counters):
@@ -520,6 +560,39 @@ def telemetry_summary(jsonl_path):
         click.echo("no span or registry_snapshot records found")
     if skipped:
         click.echo(f"({skipped} unparseable lines skipped)")
+
+
+@telemetry_group.command(
+    "trace",
+    help="Render a telemetry JSONL file or flight-recorder bundle as Chrome "
+         "trace-event JSON (open in Perfetto / chrome://tracing): one "
+         "process per tenant, one track per rank, phase slices, comm spans, "
+         "and instant events, skew-corrected from the handshake exchange.")
+@click.argument("source", type=click.Path(exists=True))
+@click.option("--out", "out_path", required=True, type=click.Path(),
+              help="Output trace file, e.g. round.trace.json.")
+@click.option("--tenant", default=None,
+              help="Keep only this tenant's records.")
+@click.option("--round", "round_idx", default=None, type=int,
+              help="Keep only this round's spans/phases/instants.")
+def telemetry_trace(source, out_path, tenant, round_idx):
+    from ..core import trace_plane
+
+    records = trace_plane.load_records(source)
+    doc = trace_plane.export_chrome_trace(
+        records, out_path=out_path, tenant=tenant, round_idx=round_idx)
+    events = doc["traceEvents"]
+    slices = [e for e in events if e.get("ph") == "X"]
+    if not slices:
+        click.echo(f"no matching trace events in {source} "
+                   f"(tenant={tenant!r}, round={round_idx!r}) — wrote an "
+                   "empty trace")
+    pids = {e["pid"] for e in slices}
+    tids = {(e["pid"], e["tid"]) for e in slices}
+    instants = sum(1 for e in events if e.get("ph") == "i")
+    click.echo(f"wrote {out_path}: {len(slices)} slices, {instants} "
+               f"instants across {len(pids)} process(es) / {len(tids)} "
+               "track(s)")
 
 
 def main():

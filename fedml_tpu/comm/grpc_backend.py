@@ -23,7 +23,7 @@ from typing import Dict, List, Optional, Union
 
 import grpc
 
-from ..core import telemetry
+from ..core import telemetry, trace_plane
 from .base import BaseCommunicationManager, Observer, dispatch_to_observers
 from .message import Message
 from .resilience import retry_send
@@ -206,25 +206,28 @@ class GRPCCommManager(BaseCommunicationManager):
         )
 
     def send_message(self, msg: Message) -> None:
-        telemetry.inject_trace(msg)
-        t0 = time.perf_counter()
-        data = msg.to_bytes()
-        telemetry.record_send("grpc", len(data), time.perf_counter() - t0)
-        receiver = msg.get_receiver_id()
-        # wait_for_ready rides out transient reconnects, but the deadline
-        # bounds PERSISTENT failures (e.g. a TLS handshake that can never
-        # succeed) — without it a misconfigured peer stalls the run silently.
-        # Retryable RpcError codes (UNAVAILABLE/DEADLINE_EXCEEDED/...) back
-        # off and retry; the terminal SendFailure names the sending rank and
-        # dialed address so a dead-peer failure is diagnosable from the log.
-        retry_send(
-            lambda: self._stub(receiver)(
-                data, wait_for_ready=True, timeout=self.send_timeout),
-            policy=self.retry_policy,
-            backend="grpc",
-            receiver_id=receiver,
-            describe=f"rank {self.rank} -> {self._target(receiver)}",
-        )
+        # no-op context unless span shipping is on and a round is active
+        with trace_plane.comm_send_span("grpc", msg, self.rank):
+            telemetry.inject_trace(msg)
+            t0 = time.perf_counter()
+            data = msg.to_bytes()
+            telemetry.record_send("grpc", len(data), time.perf_counter() - t0)
+            receiver = msg.get_receiver_id()
+            # wait_for_ready rides out transient reconnects, but the deadline
+            # bounds PERSISTENT failures (e.g. a TLS handshake that can never
+            # succeed) — without it a misconfigured peer stalls the run
+            # silently. Retryable RpcError codes
+            # (UNAVAILABLE/DEADLINE_EXCEEDED/...) back off and retry; the
+            # terminal SendFailure names the sending rank and dialed address
+            # so a dead-peer failure is diagnosable from the log.
+            retry_send(
+                lambda: self._stub(receiver)(
+                    data, wait_for_ready=True, timeout=self.send_timeout),
+                policy=self.retry_policy,
+                backend="grpc",
+                receiver_id=receiver,
+                describe=f"rank {self.rank} -> {self._target(receiver)}",
+            )
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)

@@ -14,7 +14,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from ..core import telemetry
+from ..core import telemetry, trace_plane
 from .base import BaseCommunicationManager, Observer, dispatch_to_observers
 from .message import Message
 from .resilience import retry_send
@@ -67,17 +67,20 @@ class LoopbackCommManager(BaseCommunicationManager):
         self._running = False
 
     def send_message(self, msg: Message) -> None:
-        telemetry.inject_trace(msg)
-        t0 = time.perf_counter()
-        data = msg.to_bytes()
-        telemetry.record_send("loopback", len(data),
-                              time.perf_counter() - t0)
-        # in-process queues cannot fail transiently; the retry wrapper exists
-        # so the full taxonomy (incl. SendFailure context) is uniform across
-        # backends and chaos plans can exercise it over loopback
-        retry_send(lambda: self.hub.post(msg.get_receiver_id(), data),
-                   policy=self.retry_policy, backend="loopback",
-                   receiver_id=msg.get_receiver_id())
+        # no-op context unless span shipping is on and a round is active
+        with trace_plane.comm_send_span("loopback", msg, self.rank):
+            telemetry.inject_trace(msg)
+            t0 = time.perf_counter()
+            data = msg.to_bytes()
+            telemetry.record_send("loopback", len(data),
+                                  time.perf_counter() - t0)
+            # in-process queues cannot fail transiently; the retry wrapper
+            # exists so the full taxonomy (incl. SendFailure context) is
+            # uniform across backends and chaos plans can exercise it over
+            # loopback
+            retry_send(lambda: self.hub.post(msg.get_receiver_id(), data),
+                       policy=self.retry_policy, backend="loopback",
+                       receiver_id=msg.get_receiver_id())
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)

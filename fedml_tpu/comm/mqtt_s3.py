@@ -22,7 +22,7 @@ import time
 import uuid
 from typing import List, Optional
 
-from ..core import telemetry
+from ..core import telemetry, trace_plane
 from .base import BaseCommunicationManager, Observer, dispatch_to_observers
 from .message import Message
 from .pubsub import PubSubBroker
@@ -135,27 +135,30 @@ class MqttS3CommManager(BaseCommunicationManager):
             raise
 
     def send_message(self, msg: Message) -> None:
-        telemetry.inject_trace(msg)
-        t0 = time.perf_counter()
-        topic = self._topic_for(msg)
-        receiver = msg.get_receiver_id()
-        params = msg.get_params()
-        model_params = params.get(Message.MSG_ARG_KEY_MODEL_PARAMS)
-        if model_params is not None:
-            from .message import pack_payload
+        # no-op context unless span shipping is on and a round is active
+        with trace_plane.comm_send_span("mqtt_s3", msg, self.rank):
+            telemetry.inject_trace(msg)
+            t0 = time.perf_counter()
+            topic = self._topic_for(msg)
+            receiver = msg.get_receiver_id()
+            params = msg.get_params()
+            model_params = params.get(Message.MSG_ARG_KEY_MODEL_PARAMS)
+            if model_params is not None:
+                from .message import pack_payload
 
-            blob = pack_payload(model_params)
-            if len(blob) > INLINE_PAYLOAD_MAX_BYTES:
-                self._offload_and_publish(
-                    topic, params, blob, Message.MSG_ARG_KEY_MODEL_PARAMS,
-                    receiver_id=receiver)
-                return
-        data = msg.to_bytes()
-        telemetry.record_send("mqtt_s3", len(data), time.perf_counter() - t0)
-        retry_send(
-            lambda: self.broker.publish(topic, data),
-            policy=self.retry_policy, backend="mqtt_s3",
-            receiver_id=receiver, describe=f"publish topic {topic}")
+                blob = pack_payload(model_params)
+                if len(blob) > INLINE_PAYLOAD_MAX_BYTES:
+                    self._offload_and_publish(
+                        topic, params, blob, Message.MSG_ARG_KEY_MODEL_PARAMS,
+                        receiver_id=receiver)
+                    return
+            data = msg.to_bytes()
+            telemetry.record_send("mqtt_s3", len(data),
+                                  time.perf_counter() - t0)
+            retry_send(
+                lambda: self.broker.publish(topic, data),
+                policy=self.retry_policy, backend="mqtt_s3",
+                receiver_id=receiver, describe=f"publish topic {topic}")
 
     # --- BaseCommunicationManager contract ----------------------------------
     def add_observer(self, observer: Observer) -> None:

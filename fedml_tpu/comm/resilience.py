@@ -188,6 +188,12 @@ def retry_send(
                     attempts=attempt + 1,
                 ) from exc
             telemetry.record_send_retry(backend)
+            from ..core import trace_plane
+
+            trace_plane.record_instant(
+                "send_retry", attrs={"backend": backend,
+                                     "receiver": receiver_id,
+                                     "attempt": attempt + 1})
             logging.warning(
                 "%s send to rank %s attempt %d failed (%r) — backing off",
                 backend, receiver_id, attempt + 1, exc)
@@ -489,6 +495,11 @@ class FaultyCommManager(BaseCommunicationManager, Observer):
             return
         self._dead.set()
         telemetry.record_fault("crash")
+        from ..core import trace_plane
+
+        trace_plane.record_instant(
+            "crash", rank=self.rank, attrs={"where": where})
+        trace_plane.flight_dump("chaos_crash")
         logging.warning("fault: rank %d crashing at %s (plan: crash rank %s "
                         "at round %s)", self.rank, where,
                         self.plan.crash_rank, self.plan.crash_at_round)

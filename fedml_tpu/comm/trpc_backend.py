@@ -38,7 +38,7 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 import msgpack
 import numpy as np
 
-from ..core import telemetry
+from ..core import telemetry, trace_plane
 from .base import BaseCommunicationManager, Observer, dispatch_to_observers
 from .grpc_backend import build_ip_table
 from .message import Message, _dtype_token, _resolve_dtype
@@ -318,33 +318,36 @@ class TRPCCommManager(BaseCommunicationManager):
                 pass
 
     def send_message(self, msg: Message) -> None:
-        telemetry.inject_trace(msg)
-        receiver = msg.get_receiver_id()
-        t0 = time.perf_counter()
-        chunks = encode_frames(msg.get_params())
-        telemetry.record_send("trpc", sum(len(c) for c in chunks),
-                              time.perf_counter() - t0)
+        # no-op context unless span shipping is on and a round is active
+        with trace_plane.comm_send_span("trpc", msg, self.rank):
+            telemetry.inject_trace(msg)
+            receiver = msg.get_receiver_id()
+            t0 = time.perf_counter()
+            chunks = encode_frames(msg.get_params())
+            telemetry.record_send("trpc", sum(len(c) for c in chunks),
+                                  time.perf_counter() - t0)
 
-        def _once() -> None:
-            # (re)dial lazily per attempt: the peer may have restarted
-            # between rounds, or mid-backoff
-            sock = self._pipe(receiver)
-            with self._send_locks[receiver]:
-                # scatter-gather send: tensor buffers go to the kernel as-is
-                try:
-                    sendmsg_all(sock, chunks)
-                except OSError:
-                    # a partially-written frame poisons the pipe — drop it so
-                    # the retry dials fresh and never interleaves frames
-                    self._drop_pipe(receiver)
-                    raise
+            def _once() -> None:
+                # (re)dial lazily per attempt: the peer may have restarted
+                # between rounds, or mid-backoff
+                sock = self._pipe(receiver)
+                with self._send_locks[receiver]:
+                    # scatter-gather send: tensor buffers go to the kernel
+                    # as-is
+                    try:
+                        sendmsg_all(sock, chunks)
+                    except OSError:
+                        # a partially-written frame poisons the pipe — drop it
+                        # so the retry dials fresh and never interleaves frames
+                        self._drop_pipe(receiver)
+                        raise
 
-        retry_send(
-            _once, policy=self.retry_policy, backend="trpc",
-            receiver_id=receiver,
-            describe=f"rank {self.rank} -> "
-                     f"{self.ip_table.get(receiver, '<no ip-table entry>')}",
-        )
+            retry_send(
+                _once, policy=self.retry_policy, backend="trpc",
+                receiver_id=receiver,
+                describe=f"rank {self.rank} -> "
+                         f"{self.ip_table.get(receiver, '<no ip-table entry>')}",
+            )
 
     def add_observer(self, observer: Observer) -> None:
         self._observers.append(observer)

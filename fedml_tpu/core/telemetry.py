@@ -240,6 +240,16 @@ class MetricsRegistry:
         with self._lock:
             self._metrics.clear()
 
+    def counter_total(self, name: str) -> float:
+        """Sum of one counter family across every label set. Cheap — no
+        histogram bucket copies — so per-round pollers (the trace plane's
+        recompile detector) can afford it."""
+        with self._lock:
+            items = list(self._metrics.items())
+        return sum(m.value for key, (kind, _labels, m) in items
+                   if kind == "counter"
+                   and (key == name or key.startswith(name + "{")))
+
     def snapshot(self) -> Dict[str, Any]:
         """Plain-dict dump, stable across processes and mergeable."""
         out: Dict[str, Any] = {"counters": {}, "gauges": {}, "histograms": {}}
@@ -323,14 +333,21 @@ class TenantRegistry:
 
     def snapshot(self) -> Dict[str, Any]:
         """The underlying snapshot restricted to this tenant's series."""
-        full = self._reg.snapshot()
-        out: Dict[str, Any] = {}
-        for kind, series in full.items():
-            out[kind] = {
-                k: v for k, v in series.items()
-                if _parse_key(k)[1].get("tenant") == self.tenant
-            }
-        return out
+        return filter_snapshot(self._reg.snapshot(), self.tenant)
+
+
+def filter_snapshot(snap: Dict[str, Any], tenant: str) -> Dict[str, Any]:
+    """Restrict a registry snapshot to one tenant's series — the filtering
+    :class:`TenantRegistry` applies, shared so offline consumers (the CLI
+    ``telemetry summary --tenant``) match it exactly."""
+    tenant = str(tenant)
+    out: Dict[str, Any] = {}
+    for kind, series in snap.items():
+        out[kind] = {
+            k: v for k, v in series.items()
+            if _parse_key(k)[1].get("tenant") == tenant
+        }
+    return out
 
 
 def scoped_registry(tenant: str,
@@ -437,6 +454,9 @@ class Tracer:
         self.registry = registry
         self._finished: "deque[Dict[str, Any]]" = deque(maxlen=buffer)
         self.sink = None  # optional MetricsSink
+        # oldest-span evictions from the ring (mirrors
+        # MetricsSink.dropped_records — a silent discard is a lie in the data)
+        self.dropped = 0
 
     @contextlib.contextmanager
     def span(self, name: str, round_idx: Optional[int] = None, **attrs):
@@ -474,6 +494,12 @@ class Tracer:
             }
             if attrs:
                 rec.update(attrs)
+            tenant = _tenant_var.get()
+            if tenant is not None:
+                rec["tenant"] = tenant
+            if len(self._finished) == self._finished.maxlen:
+                self.dropped += 1
+                self.registry.counter("fedml_spans_dropped_total").inc()
             self._finished.append(rec)
             if self.sink is not None:
                 try:
@@ -488,6 +514,7 @@ class Tracer:
 
     def clear(self) -> None:
         self._finished.clear()
+        self.dropped = 0
 
 
 # --- global state / configuration -------------------------------------------
@@ -532,6 +559,9 @@ def configure(enabled: bool = True,
     if reset:
         _state.registry.reset()
         _state.tracer.clear()
+        from . import trace_plane
+
+        trace_plane.reset()
     if _state.tracer._finished.maxlen != span_buffer:
         old = list(_state.tracer._finished)
         _state.tracer._finished = deque(old, maxlen=int(span_buffer))
@@ -570,6 +600,9 @@ def configure_from_args(args) -> None:
             getattr(args, "telemetry_sysstats_interval_s", 0.0) or 0.0),
         span_buffer=int(getattr(args, "telemetry_span_buffer", 4096)),
     )
+    from . import trace_plane
+
+    trace_plane.configure_from_args(args)
 
 
 def flush() -> None:
@@ -588,6 +621,18 @@ def flush() -> None:
             "timestamp": time.time(),
             "registry": _state.registry.snapshot(),
         })
+
+
+def emit_record(rec: Dict[str, Any]) -> None:
+    """Write one record to the JSONL sink, if configured. The trace plane
+    uses this for its ``phase_record`` / ``instant`` / ``clock_offset`` /
+    shipped-span kinds; a full disk never fails the emitting operation."""
+    if not _state.enabled or _state.jsonl_sink is None:
+        return
+    try:
+        _state.jsonl_sink.emit(rec)
+    except Exception:
+        logging.exception("telemetry: record emit failed")
 
 
 # --- comm-plane helpers (hot path: one guard + dict lookup per message) -----

@@ -41,7 +41,7 @@ import threading
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Sequence
 
-from . import telemetry
+from . import telemetry, trace_plane
 
 # Decision values a JobRegistry can return.
 ADMIT = "admit"
@@ -455,6 +455,9 @@ class CheckinQueue:
             labels = {} if tenant is None else {"tenant": str(tenant)}
             if shed is not None:
                 reg.counter("fedml_checkins_shed_total", **labels).inc()
+                if trace_plane.active():
+                    trace_plane.record_instant(
+                        "shed", attrs={"tenant": tenant, "shed_total": shed})
             else:
                 reg.counter("fedml_checkins_accepted_total", **labels).inc()
             reg.gauge("fedml_checkin_queue_depth").set(depth)

@@ -20,7 +20,7 @@ from ..comm import codec as comm_codec
 from ..comm.message import decompress_tree, is_compressed
 from ..comm.resilience import SendFailure
 from ..comm.utils import log_communication_tick, log_communication_tock
-from ..core import telemetry
+from ..core import telemetry, trace_plane
 from .message_define import MyMessage
 
 
@@ -60,6 +60,8 @@ class FedMLClientManager(ClientManager):
     def _on_check_status(self, msg: Message) -> None:
         reply = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, msg.get_sender_id())
         reply.add_params(MyMessage.MSG_ARG_KEY_CLIENT_STATUS, MyMessage.MSG_CLIENT_STATUS_IDLE)
+        # wall-clock stamp so the server can skew-correct this rank's spans
+        trace_plane.attach_clock(reply)
         self.send_message(reply)
 
     def announce(self) -> None:
@@ -69,6 +71,7 @@ class FedMLClientManager(ClientManager):
         client re-enters the round instead of idling until FINISH."""
         reply = Message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, self.rank, 0)
         reply.add_params(MyMessage.MSG_ARG_KEY_CLIENT_STATUS, MyMessage.MSG_CLIENT_STATUS_IDLE)
+        trace_plane.attach_clock(reply)
         self.send_message(reply)
 
     def _maybe_decode(self, params):
@@ -134,6 +137,9 @@ class FedMLClientManager(ClientManager):
         msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, update)
         msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, local_sample_num)
         msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
+        # ship this rank's finished spans for the round with the upload —
+        # the server assembles the cross-rank round timeline from them
+        trace_plane.attach_spans(msg, self.round_idx, self.rank)
         # greppable comm benchmark markers around the model upload
         # (reference communication/utils.py tick/tock role)
         log_communication_tick(self.rank, 0)
@@ -146,4 +152,5 @@ class FedMLClientManager(ClientManager):
             # probe) pulls this client back in
             logging.error("client %d: round %d upload failed terminally (%s)",
                           self.rank, self.round_idx, exc)
+            trace_plane.flight_dump("send_failure")
         log_communication_tock(self.rank, 0)

@@ -25,7 +25,7 @@ from ..comm import Message, ServerManager
 from ..comm import codec as comm_codec
 from ..comm.resilience import SendFailure
 from ..comm.utils import log_round_end, log_round_start
-from ..core import telemetry
+from ..core import telemetry, trace_plane
 from ..utils.checkpoint import RoundStateStore
 from .message_define import MyMessage
 
@@ -308,6 +308,9 @@ class FedMLServerManager(ServerManager):
 
     def _on_client_status(self, msg: Message) -> None:
         sender = msg.get_sender_id()
+        # the status reply doubles as the clock-skew exchange: the client
+        # stamped its wall clock when span shipping is on
+        trace_plane.note_client_clock(sender, msg.get(trace_plane.CLOCK_KEY))
         if msg.get(MyMessage.MSG_ARG_KEY_CLIENT_STATUS) == MyMessage.MSG_CLIENT_STATUS_IDLE:
             self.client_online_mapping[sender] = True
         start_init = False
@@ -375,6 +378,11 @@ class FedMLServerManager(ServerManager):
     def _on_model_from_client(self, msg: Message) -> None:
         model_params = msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS)
         local_sample_num = msg.get(MyMessage.MSG_ARG_KEY_NUM_SAMPLES)
+        shipped_spans = msg.get(trace_plane.SPANS_KEY)
+        if shipped_spans is not None:
+            # fold the client's round spans into the assembled timeline
+            # before the FSM lock — decode never belongs under it
+            trace_plane.ingest_shipped(shipped_spans, msg.get_sender_id())
         sent_at = self._client_send_ts.get(msg.get_sender_id())
         if sent_at is not None:
             # broadcast -> model receipt: wire + local training + wire, per
@@ -482,6 +490,7 @@ class FedMLServerManager(ServerManager):
         this round's upload barrier (it rejoins by re-announcing ONLINE, or
         implicitly if an upload still arrives). Returns a round-end outcome
         when removing it completes the round, else None."""
+        trace_plane.flight_dump("send_failure")
         with self._round_lock:
             if gen != self._round_gen or client_id in self._dead_clients:
                 return None
@@ -544,6 +553,11 @@ class FedMLServerManager(ServerManager):
         self._rollbacks_this_round = 0
         self._excluded_this_round = set()
         self.history.append(record)
+        if record.get("quarantined"):
+            trace_plane.record_instant(
+                "quarantine", round_idx=self.round_idx,
+                attrs={"clients": record["quarantined"]})
+        trace_plane.on_round_record(record, rank=self.rank)
         log_round_end(self.rank, self.round_idx)
 
         self.round_idx += 1
@@ -650,6 +664,11 @@ class FedMLServerManager(ServerManager):
         reg = telemetry.get_registry()
         if reg.enabled:
             reg.counter("fedml_rollbacks_total").inc()
+        trace_plane.record_instant(
+            "rollback", round_idx=self.round_idx, rank=self.rank,
+            attrs={"excluded": sorted(cand),
+                   "cause": "loss_spike" if spike else "non_finite"})
+        trace_plane.flight_dump("watchdog_rollback")
         pairing = dict(zip(cohort, self.data_silo_index_list))
         self.client_id_list_in_this_round = survivors
         self.data_silo_index_list = [pairing[c] for c in survivors]

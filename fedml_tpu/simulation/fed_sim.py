@@ -40,7 +40,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..core import telemetry
+from ..core import telemetry, trace_plane
 from ..core.algframe import FedAlgorithm
 from ..data.federated import FederatedData
 from ..algorithms.local_sgd import make_eval_fn
@@ -1259,6 +1259,12 @@ class FedSimulator:
                 restore(last_good if start_suspect else start_state)
                 if reg.enabled:
                     reg.counter("fedml_rollbacks_total").inc()
+                trace_plane.record_instant(
+                    "rollback", round_idx=round_idx,
+                    attrs={"attempt": attempts,
+                           "excluded": sorted(
+                               int(inputs.client_ids[p]) for p in excluded)})
+                trace_plane.flight_dump("watchdog_rollback")
                 if log_fn:
                     ids = sorted(int(inputs.client_ids[p]) for p in excluded)
                     log_fn(f"[watchdog] round {round_idx}: rollback "
@@ -1363,6 +1369,9 @@ class FedSimulator:
                 if reg0.enabled:
                     reg0.counter("fedml_quarantined_total").inc(
                         len(quarantined))
+                trace_plane.record_instant(
+                    "quarantine", round_idx=rec["round"],
+                    attrs={"clients": quarantined})
         # drain the interval accumulator: everything the host did between the
         # previous completion stamp and this one, keyed by phase; the
         # remainder (logging, bookkeeping, deferred eval of earlier rounds'
@@ -1397,6 +1406,9 @@ class FedSimulator:
                 if peak is not None:
                     reg.gauge("fedml_device_hbm_peak_bytes",
                               device=str(d)).set(float(peak))
+        # trace plane: phase record for the Chrome export + flight ring,
+        # anomaly/recompile detection annotating rec (= history[i]) in place
+        trace_plane.on_round_record(rec)
         self._post_round(rec, rec["round"], apply_fn, ckpt, log_fn)
 
     def _post_round(self, rec, round_idx, apply_fn, ckpt, log_fn) -> None:

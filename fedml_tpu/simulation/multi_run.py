@@ -33,7 +33,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
-from ..core import telemetry
+from ..core import telemetry, trace_plane
 from ..core.tenancy import (
     AdmissionVerdict,
     DeficitRoundRobinScheduler,
@@ -223,6 +223,9 @@ class MultiTenantSimDriver:
         for job in self.jobs:
             sim, apply_fn, env = self._build(job)
             verdict = self.registry.admit(env)
+            trace_plane.record_instant(
+                "admission", attrs={"tenant": job.tenant,
+                                    "decision": verdict.decision})
             self._results[job.tenant] = TenantRunResult(
                 tenant=job.tenant, verdict=verdict,
                 rounds_expected=int(sim.cfg.comm_round))
@@ -287,6 +290,10 @@ class MultiTenantSimDriver:
         self.scheduler.unregister(tenant)
         for verdict in self.registry.release(tenant):
             promoted = verdict.tenant
+            trace_plane.record_instant(
+                "admission", attrs={"tenant": promoted,
+                                    "decision": verdict.decision,
+                                    "promoted_after": tenant})
             self._results[promoted].verdict = verdict
             if self._log:
                 self._log(verdict.summary())

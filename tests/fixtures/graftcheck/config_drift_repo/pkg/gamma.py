@@ -1,0 +1,21 @@
+"""Fixture package: phase emission sites for the phase-name drift rule.
+
+``warp`` is documented in docs/observability.md (clean); ``mystery_phase``
+is not (fires ``phase-undocumented:mystery_phase``).
+"""
+
+import time
+
+
+class Sim:
+    def __init__(self):
+        self._phase_acc = []
+
+    def step(self):
+        t = time.perf_counter()
+        self._phase_acc.append(("warp", time.perf_counter() - t))
+        self._phase_acc.append(("mystery_phase", time.perf_counter() - t))
+        # non-tuple / non-constant appends are ignored by the rule
+        self._phase_acc.append("not_a_tuple")
+        name = "dynamic"
+        self._phase_acc.append((name, 0.0))

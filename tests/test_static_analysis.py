@@ -152,6 +152,9 @@ def test_config_drift_fires_on_fixture_repo():
     # None probes and fallback-chain inner defaults never conflict
     assert "conflicting-default:learning_rate" not in keys
     assert "conflicting-default:retry_window" not in keys
+    # phase-name drift: emitted-but-undocumented fires, documented is clean
+    assert "phase-undocumented:mystery_phase" in keys
+    assert "phase-undocumented:warp" not in keys
 
 
 # -------------------------------------------------------------- no-print
