@@ -1,6 +1,6 @@
 """graftcheck: fedml_tpu's first-party static-analysis suite.
 
-Five AST checkers over one shared parse of the package, with per-line
+Ten AST checkers over one shared parse of the package, with per-line
 suppressions and a committed baseline (see docs/static_analysis.md):
 
 - ``jit-purity`` — impure calls reachable from jit/pjit/shard_map/lax bodies
@@ -8,8 +8,15 @@ suppressions and a committed baseline (see docs/static_analysis.md):
 - ``lock-order`` — lock acquisition cycles + blocking work under locks
 - ``config-drift`` — conflicting config defaults + doc/code drift
 - ``no-print`` — bare print() in library code
+- ``donation-safety`` — buffers read again after donate_argnums donation
+- ``sharding-consistency`` — PartitionSpec axes no mesh declares; literal
+  spec pytrees bypassing auto_partition_specs
+- ``host-sync`` — implicit device syncs on round-loop hot paths
+- ``collective-deadlock`` — collectives under process_index/rank/tenant guards
+- ``thread-hazard`` — cross-thread attribute access without a common lock
 
-Entry points: ``python -m fedml_tpu.cli analyze`` and ``scripts/graftcheck.py``.
+Entry points: ``python -m fedml_tpu.cli analyze`` and ``scripts/graftcheck.py``
+(``--changed-only`` for the dev loop, ``--format sarif`` for CI annotation).
 """
 
 from .core import (  # noqa: F401

@@ -1,0 +1,120 @@
+"""collective-deadlock checker: collectives guarded by per-process conditionals.
+
+A JAX collective (``psum``/``all_gather``/``ppermute``/…) and the comm-plane
+broadcast helpers are *global* operations: every participant must reach the
+same call in the same order or the whole mesh hangs. The classic multi-host
+bug is wrapping one in a condition that evaluates differently on different
+processes — ``if jax.process_index() == 0: psum(...)`` compiles, passes every
+single-process test, and deadlocks the first time ``jax.distributed`` brings
+up a second host (exactly the topology the ROADMAP's DCN item introduces).
+
+The checker flags any collective call lexically nested under an ``if``/
+``while``/ternary whose test reads per-process state: ``process_index()``/
+``process_id()``, anything named ``*rank*``, or tenant identity (tenant
+workers share one device mesh, so a tenant-guarded collective diverges the
+same way). Uniform guards — ``process_count() > 1``, config flags, ``self.x
+is not None`` — are the same on every participant and stay silent.
+
+Suppress a deliberately divergent site (e.g. a collective inside a
+single-participant subtree) with ``# graftcheck: disable=collective-deadlock``
+and say why in the comment.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Optional, Set, Tuple
+
+from .core import Checker, Finding, Module, dotted_name
+
+# call names (last dotted segment) that are mesh-global operations
+COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "ppermute",
+    "pshuffle", "psum_scatter", "all_to_all", "collective_permute",
+    # first-party tree wrappers (parallel/collectives.py)
+    "psum_tree", "pmean_tree", "weighted_psum_tree", "all_gather_tree",
+    "ppermute_tree", "reduce_scatter_tree",
+    # multihost / comm-plane broadcast-to-all helpers
+    "broadcast_one_to_all", "process_allgather", "sync_global_devices",
+}
+
+# callables whose result differs per process — a guard built on them diverges
+DIVERGENT_CALLS = {"process_index", "process_id", "host_id"}
+
+
+def _divergent_reason(test: ast.AST) -> Optional[str]:
+    """Why this guard expression evaluates differently across participants,
+    or None if it looks uniform."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name is not None and name.split(".")[-1] in DIVERGENT_CALLS:
+                return f"{name}()"
+        name = dotted_name(node)
+        if name is None:
+            continue
+        last = name.split(".")[-1].lower()
+        if "rank" in last:
+            return name
+        if last == "tenant" or "tenant_id" in last:
+            return name
+    return None
+
+
+class CollectiveDeadlockChecker(Checker):
+    id = "collective-deadlock"
+    description = ("collectives (psum/all_gather/ppermute/broadcast-to-all) "
+                   "guarded by process_index/rank/tenant conditionals — "
+                   "divergent control flow deadlocks the mesh")
+
+    def visit_module(self, module: Module) -> Iterable[Finding]:
+        findings: List[Finding] = []
+        seen: Set[str] = set()
+
+        def add(call: ast.Call, op: str, guard: str, qual: str) -> None:
+            key = f"{qual}:guarded:{op}"
+            if key in seen:
+                return
+            seen.add(key)
+            findings.append(Finding(
+                checker=self.id, path=module.relpath, line=call.lineno,
+                message=(f"collective {op}(...) guarded by per-process "
+                         f"condition on {guard} in {qual} — participants that "
+                         "skip the branch never join, hanging the mesh"),
+                key=key))
+
+        def visit(node: ast.AST, guards: Tuple[Tuple[str, int], ...],
+                  stack: List[str]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                # a nested def is a new call boundary: its body does not run
+                # under the enclosing guard (deferred execution), but an If
+                # *inside* it guards whatever it contains
+                for child in ast.iter_child_nodes(node):
+                    visit(child, (), stack + [node.name])
+                return
+            if isinstance(node, (ast.If, ast.While)):
+                reason = _divergent_reason(node.test)
+                inner = guards + (((reason, node.lineno),) if reason else ())
+                for child in node.body:
+                    visit(child, inner, stack)
+                for child in node.orelse:
+                    # the else arm of a divergent test diverges too
+                    visit(child, inner, stack)
+                return
+            if isinstance(node, ast.IfExp):
+                reason = _divergent_reason(node.test)
+                inner = guards + (((reason, node.lineno),) if reason else ())
+                visit(node.test, guards, stack)
+                visit(node.body, inner, stack)
+                visit(node.orelse, inner, stack)
+                return
+            if isinstance(node, ast.Call) and guards:
+                name = dotted_name(node.func)
+                if name is not None and name.split(".")[-1] in COLLECTIVES:
+                    add(node, name, guards[-1][0], ".".join(stack) or "<module>")
+            for child in ast.iter_child_nodes(node):
+                visit(child, guards, stack)
+
+        visit(module.tree, (), [])
+        return findings

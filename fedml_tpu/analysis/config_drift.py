@@ -71,6 +71,9 @@ class ConfigDriftChecker(Checker):
     id = "config-drift"
     description = ("config keys with conflicting defaults across read sites, "
                    "plus doc/code drift against docs/config_reference.md")
+    # cross-file by construction: a subset scan would report every key
+    # whose read sites didn't change as doc-only drift
+    whole_package_only = True
 
     def __init__(self, ctx):
         super().__init__(ctx)

@@ -160,6 +160,10 @@ class Checker:
 
     id: str = ""
     description: str = ""
+    # True for checkers whose findings are only meaningful over the full
+    # package (cross-file aggregation that would false-positive on a
+    # subset); --changed-only skips them
+    whole_package_only: bool = False
 
     def __init__(self, ctx: Context):
         self.ctx = ctx
@@ -186,13 +190,20 @@ def run_checkers(
     checker_classes: Sequence[type],
     package_dir: str,
     repo_root: str,
+    only: Optional[Sequence[str]] = None,
 ) -> List[Finding]:
     """Parse every file once, feed all checkers, drop suppressed findings.
 
-    Returns findings sorted by (path, line, checker) — baseline filtering is
-    the caller's concern (see :func:`apply_baseline`)."""
+    ``only`` (absolute paths) restricts the scan to that subset of the
+    package — the ``--changed-only`` dev loop. Returns findings sorted by
+    (path, line, checker) — baseline filtering is the caller's concern
+    (see :func:`apply_baseline`)."""
     ctx = Context(repo_root=repo_root, package_dir=package_dir)
-    modules = [load_module(p, repo_root) for p in iter_source_files(package_dir)]
+    paths = iter_source_files(package_dir)
+    if only is not None:
+        allowed = {os.path.abspath(p) for p in only}
+        paths = [p for p in paths if os.path.abspath(p) in allowed]
+    modules = [load_module(p, repo_root) for p in paths]
     by_rel = {m.relpath: m for m in modules}
     findings: List[Finding] = []
     for cls in checker_classes:
@@ -254,7 +265,18 @@ def default_baseline_path(repo_root: str) -> str:
 
 def checker_registry() -> Dict[str, type]:
     """Imported lazily so ``core`` stays importable from the checkers."""
-    from . import config_drift, determinism, jit_purity, lock_order, no_print
+    from . import (
+        collective_deadlock,
+        config_drift,
+        determinism,
+        donation,
+        host_sync,
+        jit_purity,
+        lock_order,
+        no_print,
+        sharding_consistency,
+        thread_hazard,
+    )
 
     checkers = (
         jit_purity.JitPurityChecker,
@@ -262,8 +284,72 @@ def checker_registry() -> Dict[str, type]:
         lock_order.LockOrderChecker,
         config_drift.ConfigDriftChecker,
         no_print.NoPrintChecker,
+        donation.DonationSafetyChecker,
+        sharding_consistency.ShardingConsistencyChecker,
+        host_sync.HostSyncChecker,
+        collective_deadlock.CollectiveDeadlockChecker,
+        thread_hazard.ThreadHazardChecker,
     )
     return {c.id: c for c in checkers}
+
+
+def changed_files(repo_root: str, ref: str) -> List[str]:
+    """Absolute paths of .py files changed vs ``ref`` (tracked diff plus
+    untracked files) — the ``--changed-only`` dev-loop filter."""
+    import subprocess
+
+    out: List[str] = []
+    for cmd in (["git", "diff", "--name-only", ref, "--"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            proc = subprocess.run(
+                cmd, cwd=repo_root, capture_output=True, text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+        if proc.returncode != 0:
+            continue
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line.endswith(".py"):
+                out.append(os.path.join(repo_root, line))
+    return sorted(set(p for p in out if os.path.exists(p)))
+
+
+def to_sarif(findings: Sequence[Finding], registry: Dict[str, type]) -> dict:
+    """SARIF 2.1.0 document for CI PR annotation (one run, one result per
+    finding; the baseline fingerprint rides in partialFingerprints)."""
+    rules = [
+        {"id": cid, "shortDescription": {"text": registry[cid].description}}
+        for cid in sorted(registry)
+    ]
+    results = [
+        {
+            "ruleId": f.checker,
+            "level": f.severity,
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": f.line},
+                },
+            }],
+            "partialFingerprints": {"graftcheck/v1": f.fingerprint},
+        }
+        for f in findings
+    ]
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                    "master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftcheck",
+                "informationUri": "docs/static_analysis.md",
+                "rules": rules,
+            }},
+            "results": results,
+        }],
+    }
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -285,13 +371,41 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="rewrite the baseline from the current findings and exit 0")
     parser.add_argument("--root", default=None, metavar="DIR",
                         help="scan this directory/file instead of fedml_tpu/")
+    parser.add_argument("--changed-only", nargs="?", const="HEAD",
+                        default=None, metavar="REF",
+                        help="only scan files changed vs the given git ref "
+                             "(default HEAD) — the <5s pre-commit loop; CI "
+                             "keeps the full run")
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
+                        default=None,
+                        help="output format (--json is shorthand for "
+                             "--format json; sarif emits SARIF 2.1.0 for "
+                             "CI PR annotation)")
     ns = parser.parse_args(argv)
 
     repo_root = default_repo_root()
     package_dir = ns.root or os.path.join(repo_root, "fedml_tpu")
     baseline_path = ns.baseline or default_baseline_path(repo_root)
     ids = ns.checker or sorted(registry)
-    findings = run_checkers([registry[i] for i in ids], package_dir, repo_root)
+    only = None
+    if ns.changed_only is not None:
+        only = changed_files(repo_root, ns.changed_only)
+        if not only:
+            sys.stdout.write(
+                f"graftcheck: no .py files changed vs {ns.changed_only}\n")
+            return 0
+        # cross-file checkers false-positive on a partial scan (e.g.
+        # config-drift would report every key whose read sites didn't
+        # change as doc-only); the full run in CI keeps covering them
+        skipped = [i for i in ids if registry[i].whole_package_only]
+        ids = [i for i in ids if not registry[i].whole_package_only]
+        if skipped and ns.format != "sarif" and not (
+                ns.as_json or ns.format == "json"):
+            sys.stdout.write(
+                "graftcheck: skipping whole-package checker(s) in "
+                f"--changed-only mode: {', '.join(skipped)}\n")
+    findings = run_checkers(
+        [registry[i] for i in ids], package_dir, repo_root, only=only)
 
     if ns.write_baseline:
         write_baseline(findings, baseline_path)
@@ -303,7 +417,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     baseline = [] if ns.no_baseline else load_baseline(baseline_path)
     new, grandfathered, stale = apply_baseline(findings, baseline)
 
-    if ns.as_json:
+    if ns.format == "sarif":
+        json.dump(to_sarif(new, registry), sys.stdout, indent=2)
+        sys.stdout.write("\n")
+        return 1 if new else 0
+
+    if ns.as_json or ns.format == "json":
         json.dump({
             "checkers": ids,
             "new": [f.to_dict() for f in new],

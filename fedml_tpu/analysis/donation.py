@@ -1,0 +1,248 @@
+"""donation-safety checker: use of a buffer after it was donated to a jit.
+
+``jax.jit(f, donate_argnums=...)`` lets XLA reuse the donated argument's
+device memory for the output — and invalidates the caller's array. Reading
+it afterwards returns garbage or raises, depending on backend, and never
+fails on CPU test runs where donation is a no-op: the canonical bug that
+ships green and corrupts state on the TPU. The engine leans on donation
+hard (the arena round step, the packed step, the finalize step, the client
+store put), so every new call site is a chance to re-read a dead buffer.
+
+Per module the checker resolves which callables are donation-enabled:
+
+- direct bindings — ``self._f = jax.jit(g, donate_argnums=(0,))`` or
+  ``f = pjit(g, donate_argnums=...)``;
+- builder functions that *return* a donated jit (the engine's
+  ``_build_round_step`` pattern): a same-module/same-class call
+  ``self._step = self._build_round_step()`` marks ``self._step`` donated
+  with the builder's donate positions — this is the call-graph hop that
+  plain def-use analysis misses;
+- functions decorated ``@partial(jax.jit, donate_argnums=...)``, called by
+  name;
+- inline ``jax.jit(g, donate_argnums=...)(x)`` calls.
+
+At each call site of a donated callable, the donated positional args that
+are plain names or ``self.*`` attribute paths are tracked through the rest
+of the enclosing function body: a later read without an intervening
+rebinding of that exact path is flagged. Rebinding in the same statement
+(``self.params, self.opt = self._step(self.params, self.opt, ...)``) is the
+idiomatic safe shape and stays silent. The walk is lexical (source order)
+within one function — a read physically above the call that re-executes in
+a loop is out of scope.
+
+Suppress with ``# graftcheck: disable=donation-safety`` plus a rationale
+(e.g. the read is reached only when the jit raised and never donated).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import Checker, Finding, Module, dotted_name
+from .jit_purity import _collect_functions, _walk_own_body
+
+DONATING_WRAPPERS = {"jit", "pjit"}
+
+
+def _donate_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """Donated positional indices if ``call`` is jit/pjit with donation (or
+    ``partial(jax.jit, donate_argnums=...)``), else None."""
+    name = dotted_name(call.func)
+    last = name.split(".")[-1] if name else ""
+    if last == "partial":
+        for arg in call.args:
+            if (dotted_name(arg) or "").split(".")[-1] in DONATING_WRAPPERS:
+                return _extract_argnums(call)
+        return None
+    if last not in DONATING_WRAPPERS:
+        return None
+    return _extract_argnums(call)
+
+
+def _extract_argnums(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = tuple(e.value for e in v.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, int))
+                return out or None
+        elif kw.arg == "donate_argnames":
+            # positions unknown statically without the signature; treat all
+            # positional args at the call site as potentially donated
+            return ()
+    return None
+
+
+def _store_paths(target: ast.AST) -> Set[str]:
+    """Dotted paths assigned by one assignment target (tuple targets fan
+    out; ``self.x[i] = ...`` rebinds nothing)."""
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for elt in target.elts:
+            out |= _store_paths(elt)
+        return out
+    path = dotted_name(target)
+    return {path} if path else set()
+
+
+class DonationSafetyChecker(Checker):
+    id = "donation-safety"
+    description = ("arguments read again after being donated to a "
+                   "jit/pjit with donate_argnums — the buffer is dead "
+                   "after the call on real devices")
+
+    def visit_module(self, module: Module) -> Iterable[Finding]:
+        funcs = _collect_functions(module.tree)
+        donated = self._donated_callables(module.tree, funcs)
+        findings: List[Finding] = []
+        for info in funcs:
+            findings.extend(self._scan_function(module, info, donated))
+        return findings
+
+    # ------------------------------------------------- donated callables
+
+    def _donated_callables(self, tree: ast.AST, funcs) -> Dict[str, Tuple[int, ...]]:
+        """Map of callable paths ('self._step', 'step_fn', 'Cls.method' via
+        simple name) to donated positional indices."""
+        # builders: function whose return value is a donating jit call
+        builder_pos: Dict[str, Tuple[int, ...]] = {}
+        for info in funcs:
+            for node in _walk_own_body(info.node):
+                if isinstance(node, ast.Return) and isinstance(node.value, ast.Call):
+                    pos = _donate_positions(node.value)
+                    if pos is not None:
+                        builder_pos[info.simple] = pos
+
+        donated: Dict[str, Tuple[int, ...]] = {}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            pos: Optional[Tuple[int, ...]] = None
+            if isinstance(node.value, ast.Call):
+                pos = _donate_positions(node.value)
+                if pos is None:
+                    # self._step = self._build_round_step() — one call-graph
+                    # hop into the builder
+                    callee = dotted_name(node.value.func) or ""
+                    pos = builder_pos.get(callee.split(".")[-1])
+            if pos is None:
+                continue
+            for t in node.targets:
+                path = dotted_name(t)
+                if path:
+                    donated[path] = pos
+
+        # decorated defs, callable by simple name
+        for info in funcs:
+            for deco in getattr(info.node, "decorator_list", ()):
+                if isinstance(deco, ast.Call):
+                    pos = _donate_positions(deco)
+                    if pos is not None:
+                        donated[info.simple] = pos
+                        donated[f"self.{info.simple}"] = pos
+        return donated
+
+    # -------------------------------------------------------- call sites
+
+    def _scan_function(self, module: Module, info,
+                       donated: Dict[str, Tuple[int, ...]]) -> List[Finding]:
+        findings: List[Finding] = []
+        body = list(_walk_own_body(info.node))
+
+        # every (lineno, stored-path) rebinding in this function body
+        stores: List[Tuple[int, str]] = []
+        for node in body:
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    for path in _store_paths(t):
+                        stores.append((node.lineno, path))
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                path = dotted_name(node.target)
+                if path:
+                    stores.append((node.lineno, path))
+
+        # every (lineno, loaded-path) read in this function body
+        loads: List[Tuple[int, str, ast.AST]] = []
+        for node in body:
+            if isinstance(node, (ast.Attribute, ast.Name)) and \
+                    isinstance(getattr(node, "ctx", None), ast.Load):
+                path = dotted_name(node)
+                if path:
+                    loads.append((node.lineno, path, node))
+
+        for node in body:
+            if not isinstance(node, ast.Call):
+                continue
+            callee, pos = self._donated_call(node, donated)
+            if callee is None:
+                continue
+            arg_paths = self._donated_arg_paths(node, pos)
+            if not arg_paths:
+                continue
+            rebound_here = self._same_statement_stores(info.node, node)
+            for path in arg_paths:
+                if path in rebound_here:
+                    continue  # x = step(x, ...) — idiomatic rebinding
+                # first rebinding strictly after the call closes the window
+                later_stores = [ln for ln, p in stores
+                                if p == path and ln > node.lineno]
+                horizon = min(later_stores) if later_stores else None
+                for ln, p, load_node in loads:
+                    if p != path or ln <= node.lineno:
+                        continue
+                    if load_node in node.args:
+                        continue
+                    if horizon is not None and ln >= horizon:
+                        continue
+                    findings.append(Finding(
+                        checker=self.id, path=module.relpath, line=ln,
+                        message=(f"'{path}' read after being donated to "
+                                 f"{callee}(...) at line {node.lineno} in "
+                                 f"{info.qualname} — the buffer is "
+                                 "invalidated by donation on device backends"),
+                        key=f"{info.qualname}:use-after-donate:{path}:{callee}"))
+                    break  # one finding per (call, path)
+        return findings
+
+    def _donated_call(self, call: ast.Call,
+                      donated: Dict[str, Tuple[int, ...]]):
+        """(callee-path, donated positions) if this call invokes a donated
+        callable, else (None, None)."""
+        path = dotted_name(call.func)
+        if path is not None and path in donated:
+            return path, donated[path]
+        if isinstance(call.func, ast.Call):
+            pos = _donate_positions(call.func)
+            if pos is not None:
+                name = dotted_name(call.func.func) or "jit"
+                return name, pos
+        return None, None
+
+    def _donated_arg_paths(self, call: ast.Call,
+                           pos: Tuple[int, ...]) -> Set[str]:
+        idxs = range(len(call.args)) if pos == () else pos
+        out: Set[str] = set()
+        for i in idxs:
+            if i < len(call.args):
+                path = dotted_name(call.args[i])
+                if path and path != "self":
+                    out.add(path)
+        return out
+
+    def _same_statement_stores(self, func_node: ast.AST,
+                               call: ast.Call) -> Set[str]:
+        """Paths stored by the Assign statement whose value contains this
+        call (if any) — those rebind the donated name at the call itself."""
+        for node in _walk_own_body(func_node):
+            if isinstance(node, ast.Assign) and \
+                    any(sub is call for sub in ast.walk(node.value)):
+                out: Set[str] = set()
+                for t in node.targets:
+                    out |= _store_paths(t)
+                return out
+        return set()

@@ -1,0 +1,218 @@
+"""host-sync checker: implicit device syncs on engine hot paths.
+
+Every ``.block_until_ready()``, ``np.asarray(device_array)``, ``.item()``,
+``float()/int()`` on a traced value, or ``jax.device_get`` stalls the host
+until the device drains — on the round loop that's a serialization point
+that caps round rate no matter how fast the chips are (FedJAX's core
+lesson: keep the round step device-resident, read back only at phase
+boundaries). These calls are invisible to correctness tests; they only
+show up as a flat profile on real hardware.
+
+The checker walks the same-module call graph (jit_purity's BFS: plain-name
+and ``self.method()`` edges, nested defs traced with their parent) from
+the engine entry points — the simulation round loops
+(``fed_sim.run``/``_run_selfheal``/dispatch/deferred-readback planes),
+the multi-tenant driver (``multi_run.run``/``_worker``), and the
+cross-silo round handlers (``aggregate``, ``train``, the ``_on_*``
+message callbacks) — and flags sync sites reachable from them.
+
+The walk deliberately does NOT descend into phase-boundary planes, where
+readback is the point: input-building/packing (``build_round_inputs``,
+``_build_*``), eval/test, checkpoint/snapshot/restore/export, and
+reporting helpers. Known-deliberate syncs inside hot functions (the
+self-heal verdict that gates the round, the deferred metrics readback)
+carry inline ``# graftcheck: disable=host-sync`` suppressions with their
+rationale — new ones should be argued for the same way.
+
+``np.asarray`` is only a sync when its argument is a device array;
+host-side uses are common, so the checker skips calls nested inside
+placement expressions (``jax.device_put(np.asarray(v), ...)``,
+``make_array_from_callback``) and only flags plain name/attribute
+arguments (``np.asarray(metrics)``), not subscripts of host containers.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .core import Checker, Finding, Module, dotted_name
+from .jit_purity import _collect_functions, _is_ancestor, _walk_own_body
+
+# entry points per file; cross_silo/ additionally treats _on_* handlers
+# and the listed names as hot
+HOT_ENTRIES: Dict[str, Set[str]] = {
+    "fedml_tpu/simulation/fed_sim.py": {
+        "run", "_run_selfheal", "_dispatch_even", "_dispatch_bucketed",
+        "_dispatch_packed", "_defer_rec", "_finalize_rec",
+    },
+    "fedml_tpu/simulation/multi_run.py": {"run", "_worker"},
+}
+CROSS_SILO_PREFIX = "fedml_tpu/cross_silo/"
+CROSS_SILO_ENTRIES = {"aggregate", "add_local_trained_result", "train",
+                      "broadcast_round", "await_round"}
+
+# functions the BFS never enters: phase-boundary planes where host readback
+# or host-side packing is the point
+_COLD_PREFIXES = ("build_", "_build", "eval", "_eval", "test_", "_test",
+                  "checkpoint", "_checkpoint", "save", "_save", "restore",
+                  "_restore", "snapshot", "_snapshot", "export", "_export",
+                  "report", "_report", "_post_round", "_local_test",
+                  "_pad_and_batch", "summar", "_summar")
+
+# callables whose arguments are host->device placement, not readback
+_PLACEMENT = {"device_put", "device_put_sharded", "device_put_replicated",
+              "make_array_from_callback", "make_array_from_single_device_arrays"}
+
+_REDUCTIONS = {"mean", "sum", "max", "min", "prod"}
+
+
+def _is_cold(name: str) -> bool:
+    return name.startswith(_COLD_PREFIXES)
+
+
+class HostSyncChecker(Checker):
+    id = "host-sync"
+    description = ("implicit device syncs (block_until_ready/np.asarray/"
+                   ".item()/float()/device_get) reachable from engine "
+                   "round-loop entry points")
+
+    def interested(self, relpath: str) -> bool:
+        return relpath in HOT_ENTRIES or relpath.startswith(CROSS_SILO_PREFIX)
+
+    def visit_module(self, module: Module) -> Iterable[Finding]:
+        entries = HOT_ENTRIES.get(module.relpath)
+        is_cross_silo = module.relpath.startswith(CROSS_SILO_PREFIX)
+        funcs = _collect_functions(module.tree)
+        by_simple: Dict[str, List] = {}
+        for f in funcs:
+            by_simple.setdefault(f.simple, []).append(f)
+
+        roots = []
+        for f in funcs:
+            if entries is not None and f.simple in entries:
+                roots.append(f)
+            elif is_cross_silo and (f.simple in CROSS_SILO_ENTRIES
+                                    or f.simple.startswith("_on_")):
+                roots.append(f)
+        if not roots:
+            return []
+
+        reachable = self._reach(funcs, by_simple, roots)
+        findings: List[Finding] = []
+        for info, why in reachable.items():
+            findings.extend(self._scan(module, info, why))
+        return findings
+
+    # ------------------------------------------------------ reachability
+
+    def _reach(self, funcs, by_simple, roots) -> Dict[object, str]:
+        """jit_purity's BFS with a cold-plane cut: calls into
+        eval/checkpoint/build_* helpers are not followed."""
+        reachable: Dict[object, str] = {f: f"entry point {f.qualname}"
+                                        for f in roots}
+        nested_of: Dict[object, List] = {}
+        for f in funcs:
+            for g in funcs:
+                if g is not f and _is_ancestor(f.node, g.node):
+                    nested_of.setdefault(f, []).append(g)
+        work = list(roots)
+        while work:
+            cur = work.pop()
+            why = reachable[cur]
+            for child in nested_of.get(cur, ()):
+                if child not in reachable and not _is_cold(child.simple):
+                    reachable[child] = f"defined inside {cur.qualname}"
+                    work.append(child)
+            for node in _walk_own_body(cur.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = None
+                if isinstance(node.func, ast.Name):
+                    name = node.func.id
+                elif isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id == "self":
+                    name = node.func.attr
+                if name is None or _is_cold(name):
+                    continue
+                for cand in by_simple.get(name, ()):
+                    if cand.cls is not None and cur.cls is not None \
+                            and cand.cls != cur.cls:
+                        continue
+                    if cand not in reachable:
+                        reachable[cand] = f"called from {cur.qualname}"
+                        work.append(cand)
+        return reachable
+
+    # ------------------------------------------------------------- sinks
+
+    def _scan(self, module: Module, info, why: str) -> List[Finding]:
+        findings: List[Finding] = []
+        seen: Set[str] = set()
+
+        def add(node: ast.AST, op: str, detail: str) -> None:
+            key = f"{info.qualname}:{op}"
+            if key in seen:
+                return
+            seen.add(key)
+            findings.append(Finding(
+                checker=self.id, path=module.relpath,
+                line=getattr(node, "lineno", 1),
+                message=(f"{detail} on the hot path ({why}) — stalls the "
+                         "host until the device drains; move it to a phase "
+                         "boundary or defer the readback"),
+                key=key))
+
+        def visit(node: ast.AST, in_placement: bool) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                return  # nested defs scanned via their own reachability
+            if isinstance(node, ast.Call):
+                self._check_call(node, add, in_placement)
+                name = dotted_name(node.func) or ""
+                if name.split(".")[-1] in _PLACEMENT:
+                    in_placement = True
+            for child in ast.iter_child_nodes(node):
+                visit(child, in_placement)
+
+        for child in ast.iter_child_nodes(info.node):
+            visit(child, False)
+        return findings
+
+    def _check_call(self, node: ast.Call, add, in_placement: bool) -> None:
+        fname = dotted_name(node.func) or ""
+        last = fname.split(".")[-1]
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "block_until_ready":
+            add(node, "block_until_ready", "explicit .block_until_ready() sync")
+        elif last == "block_until_ready":
+            add(node, "block_until_ready", "explicit jax.block_until_ready() sync")
+        elif last == "device_get":
+            add(node, "device_get", "jax.device_get() readback")
+        elif isinstance(node.func, ast.Attribute) and node.func.attr == "item" \
+                and not node.args and not node.keywords:
+            add(node, f"item:{dotted_name(node.func.value) or 'expr'}",
+                ".item() scalar readback")
+        elif last == "asarray" and fname.split(".")[0] in ("np", "numpy") \
+                and not in_placement and node.args:
+            path = dotted_name(node.args[0])
+            if path is not None:
+                add(node, f"np.asarray:{path}",
+                    f"np.asarray({path}) device->host copy")
+        elif isinstance(node.func, ast.Name) and node.func.id in ("float", "int") \
+                and node.args and self._traced_like(node.args[0]):
+            add(node, f"{node.func.id}()",
+                f"{node.func.id}() on a device value")
+
+    def _traced_like(self, arg: ast.AST) -> bool:
+        """Heuristic: the argument is plausibly a device array — it calls a
+        reduction (.mean()/.sum()/...) or references jnp/jax directly."""
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in _REDUCTIONS:
+                return True
+            name = dotted_name(sub)
+            if name is not None and name.split(".")[0] in ("jnp", "jax"):
+                return True
+        return False

@@ -1,0 +1,169 @@
+"""sharding-consistency checker: PartitionSpec axis names must exist.
+
+A ``PartitionSpec`` naming an axis the mesh doesn't declare fails only at
+runtime — and only on the code path that actually places an array with it,
+which for rarely-exercised specs (checkpoint resharding, the model-axis
+paths) can be long after the typo landed. The checker cross-references every
+string-literal axis name used in a ``PartitionSpec``/``P(...)`` (including
+inside ``NamedSharding``/``with_sharding_constraint``/``shard_map`` specs)
+against the axes that are actually declared:
+
+- the canonical axis vocabulary scraped from ``fedml_tpu/parallel/mesh.py``
+  (``AXIS_CLIENT = "client"`` etc.) — the one source of truth every mesh in
+  the framework builds from;
+- plus any string literal passed to a mesh constructor visible in the same
+  module (``Mesh(devs, ("x", "y"))``, ``create_mesh``, ``MeshConfig``) so
+  tests and experiments with local ad-hoc meshes stay legal.
+
+Axis names referenced through the ``AXIS_*`` constants are by construction
+consistent and are not checked.
+
+A second, WARNING-level rule nudges hand-rolled spec pytrees toward
+``auto_partition_specs``: a ``tree_map``/``tree_map_with_path`` whose mapped
+function constructs ``P(...)`` literals duplicates the inference that
+``parallel/sharding.py`` already centralises (that module itself is exempt —
+it is the spec layer).
+
+Suppress with ``# graftcheck: disable=sharding-consistency`` and a rationale.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable, List, Optional, Set
+
+from .core import SEVERITY_WARNING, Checker, Finding, Module, dotted_name
+
+# fallback when parallel/mesh.py is not present under the scanned repo root
+# (fixture runs); mirrors the AXIS_* constants
+FALLBACK_AXES = {"client", "data", "model", "pipe", "seq", "expert"}
+
+MESH_CONSTRUCTORS = {"Mesh", "MeshConfig", "create_mesh", "make_mesh",
+                     "create_device_mesh"}
+SPEC_FACTORIES = {"PartitionSpec"}
+TREE_MAPS = {"tree_map", "tree_map_with_path"}
+
+_AXIS_CONST_RE = re.compile(r'^AXIS_\w+\s*=\s*"([a-z_]+)"', re.M)
+
+# the spec layer itself: defines auto_partition_specs and the hand-written
+# architecture templates it dispatches to
+SPEC_LAYER = "fedml_tpu/parallel/sharding.py"
+
+
+def _spec_aliases(tree: ast.AST) -> Set[str]:
+    """Local names that refer to jax.sharding.PartitionSpec (``P`` by
+    convention), via ``from jax.sharding import PartitionSpec as P`` etc."""
+    aliases = set(SPEC_FACTORIES)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name in SPEC_FACTORIES:
+                    aliases.add(alias.asname or alias.name)
+    return aliases
+
+
+class ShardingConsistencyChecker(Checker):
+    id = "sharding-consistency"
+    description = ("PartitionSpec axis names not declared by any reachable "
+                   "mesh constructor or the canonical parallel/mesh.py axes; "
+                   "hand-rolled spec pytrees that bypass auto_partition_specs")
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        self._canonical: Optional[Set[str]] = None
+
+    def _canonical_axes(self) -> Set[str]:
+        if self._canonical is None:
+            mesh_py = os.path.join(
+                self.ctx.repo_root, "fedml_tpu", "parallel", "mesh.py")
+            axes: Set[str] = set()
+            if os.path.exists(mesh_py):
+                with open(mesh_py, encoding="utf-8") as f:
+                    axes = set(_AXIS_CONST_RE.findall(f.read()))
+            self._canonical = axes or set(FALLBACK_AXES)
+        return self._canonical
+
+    def visit_module(self, module: Module) -> Iterable[Finding]:
+        aliases = _spec_aliases(module.tree)
+        declared = self._canonical_axes() | self._declared_axes(module.tree)
+        findings: List[Finding] = []
+        seen: Set[str] = set()
+
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            last = name.split(".")[-1] if name else ""
+            if last in aliases or last in SPEC_FACTORIES:
+                for axis_node, axis in self._literal_axes(node):
+                    if axis in declared:
+                        continue
+                    key = f"unknown-axis:{axis}"
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    findings.append(Finding(
+                        checker=self.id, path=module.relpath,
+                        line=axis_node.lineno,
+                        message=(f"PartitionSpec names axis '{axis}' but no "
+                                 "mesh constructor in this module declares it "
+                                 "and it is not a canonical parallel/mesh.py "
+                                 f"axis ({', '.join(sorted(declared))}) — "
+                                 "placement with this spec fails at runtime"),
+                        key=key))
+            elif last in TREE_MAPS and module.relpath != SPEC_LAYER:
+                findings.extend(self._tree_literal_spec(
+                    module, node, aliases, seen))
+        return findings
+
+    # ----------------------------------------------------------- helpers
+
+    def _declared_axes(self, tree: ast.AST) -> Set[str]:
+        """String literals fed to mesh constructors anywhere in the module —
+        an ad-hoc ``Mesh(devs, ("rows", "cols"))`` declares its own names."""
+        axes: Set[str] = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name is None or name.split(".")[-1] not in MESH_CONSTRUCTORS:
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                    axes.add(sub.value)
+        return axes
+
+    def _literal_axes(self, call: ast.Call):
+        """(node, axis) for every string literal inside a P(...) call,
+        including nested tuples like P(("client", "model"))."""
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            for sub in ast.walk(arg):
+                if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                    yield sub, sub.value
+
+    def _tree_literal_spec(self, module: Module, call: ast.Call,
+                           aliases: Set[str], seen: Set[str]) -> List[Finding]:
+        """WARNING: tree_map whose mapped callable constructs P(...) literals
+        — duplicate of auto_partition_specs' inference."""
+        if not call.args:
+            return []
+        fn_arg = call.args[0]
+        has_spec = any(
+            isinstance(sub, ast.Call)
+            and (dotted_name(sub.func) or "").split(".")[-1] in aliases
+            for sub in ast.walk(fn_arg))
+        if not has_spec:
+            return []
+        key = "tree-literal-spec"
+        if key in seen:
+            return []
+        seen.add(key)
+        return [Finding(
+            checker=self.id, path=module.relpath, line=call.lineno,
+            message=("tree-mapped literal PartitionSpecs — prefer "
+                     "parallel.sharding.auto_partition_specs (it already "
+                     "infers per-leaf specs and stays consistent with the "
+                     "mesh shape)"),
+            key=key, severity=SEVERITY_WARNING)]

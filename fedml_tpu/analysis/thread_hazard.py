@@ -1,0 +1,417 @@
+"""thread-hazard checker: cross-thread attribute access without a common lock.
+
+The comm backends, the prefetcher, telemetry, the trace plane, and the CLI
+agent all spawn threads (receive loops, watchers, timers, executors) that
+share instance state with the main thread. A write from one thread and a
+read from another with no common lock is a race that no unit test reliably
+reproduces — it surfaces as a lost status update or a torn dict read under
+production load.
+
+Per module the checker:
+
+- finds the *thread roots*: callables handed to ``threading.Thread(target=…)``
+  / ``Timer``, ``executor.submit``, and observer/handler registrations
+  (``subscribe``, ``register_message_receive_handler``, …);
+- walks the same-module call graph from each root (plain-name and
+  ``self.method()`` edges, nested defs inherited — jit_purity's BFS), so
+  every method gets a set of execution contexts: the roots that reach it,
+  or ``main`` if none does;
+- records every ``self.X`` read and write together with the lock set held
+  at that point, reusing lock_order's lock-id inference (``Cls._lock``
+  identity from ``self._lock = threading.Lock()`` assignments) and its
+  ``with``-nesting recursion, plus a conservative entry-lock propagation
+  for helpers only ever called with a lock held;
+- flags an attribute written in one context and accessed in a different
+  one when the two access sites hold no lock in common.
+
+Deliberately out of scope (the idiomatic safe patterns):
+
+- ``__init__`` assignments — construction happens-before thread start;
+- attributes bound to internally-synchronized objects (locks, conditions,
+  events, queues, deques, thread handles);
+- constant flag flips (``self._running = False``) — a GIL-atomic store is
+  the standard cooperative-shutdown idiom;
+- races between two threads running the *same* root (the per-instance
+  state those touch is modelled as one context).
+
+Suppress a by-design site with ``# graftcheck: disable=thread-hazard`` and
+state the external synchronization in the comment.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, FrozenSet, Iterable, List, Optional, Set, Tuple
+
+from .core import Checker, Finding, Module, dotted_name
+from .jit_purity import _collect_functions, _is_ancestor, _walk_own_body
+from .lock_order import LOCK_FACTORIES
+
+SCOPE_PREFIXES = ("fedml_tpu/comm/",)
+SCOPE_FILES = (
+    "fedml_tpu/core/telemetry.py",
+    "fedml_tpu/core/trace_plane.py",
+    "fedml_tpu/cli/runner.py",
+    "fedml_tpu/simulation/prefetch.py",
+    "fedml_tpu/simulation/multi_run.py",
+)
+
+# attributes bound to these factories synchronize internally (or are the
+# synchronization itself) — accessing them cross-thread is their job
+SYNC_FACTORIES = LOCK_FACTORIES | {
+    "Event", "Barrier", "Queue", "LifoQueue", "PriorityQueue", "SimpleQueue",
+    "deque", "Thread", "Timer", "ThreadPoolExecutor", "local",
+}
+
+THREAD_SPAWNERS = {"Thread", "Timer"}
+REGISTRATION_CALLS = {"subscribe", "register_message_receive_handler",
+                      "add_done_callback", "add_observer", "add_listener",
+                      "register_handler"}
+
+_Access = Tuple[str, FrozenSet[str], int, str]  # kind, held, lineno, qualname
+
+
+class ThreadHazardChecker(Checker):
+    id = "thread-hazard"
+    description = ("instance attributes written from thread/timer/executor/"
+                   "handler entry points and accessed from other threads "
+                   "without a common lock")
+
+    def interested(self, relpath: str) -> bool:
+        return relpath.startswith(SCOPE_PREFIXES) or relpath in SCOPE_FILES
+
+    def visit_module(self, module: Module) -> Iterable[Finding]:
+        funcs = _collect_functions(module.tree)
+        if not funcs:
+            return []
+        by_simple: Dict[str, List] = {}
+        for f in funcs:
+            by_simple.setdefault(f.simple, []).append(f)
+
+        lock_attrs = self._collect_lock_attrs(module.tree)
+        exempt = self._exempt_attrs(module.tree)
+        roots = self._thread_roots(module.tree, funcs, by_simple)
+        if not roots:
+            return []
+        contexts = self._contexts(funcs, by_simple, roots)
+        entry_held = self._entry_held(funcs, by_simple, lock_attrs, roots)
+
+        # (cls, attr) -> accesses across all contexts
+        accesses: Dict[Tuple[str, str], List[Tuple[FrozenSet[str], _Access]]] = {}
+        method_names = {(f.cls, f.simple) for f in funcs if f.cls}
+        for f in funcs:
+            if f.cls is None or f.simple == "__init__":
+                continue
+            ctx = contexts.get(id(f.node), frozenset(["main"]))
+            base_held = entry_held.get(id(f.node), frozenset())
+            for attr, acc in self._collect_accesses(
+                    f, lock_attrs, method_names, base_held):
+                if (f.cls, attr) in exempt or attr in ("ctx",):
+                    continue
+                accesses.setdefault((f.cls, attr), []).append((ctx, acc))
+
+        return self._hazards(module, accesses)
+
+    # -------------------------------------------------------- thread roots
+
+    def _thread_roots(self, tree: ast.AST, funcs, by_simple) -> List:
+        roots: List = []
+
+        def resolve(expr: ast.AST, cls_hint: Optional[str]) -> None:
+            name = None
+            if isinstance(expr, ast.Name):
+                name = expr.id
+            elif isinstance(expr, ast.Attribute) and \
+                    isinstance(expr.value, ast.Name) and expr.value.id == "self":
+                name = expr.attr
+            if name is None:
+                return
+            for cand in by_simple.get(name, ()):
+                if cls_hint and cand.cls and cand.cls != cls_hint:
+                    continue
+                if cand not in roots:
+                    roots.append(cand)
+
+        cls_of: Dict[int, Optional[str]] = {}
+
+        def index(node: ast.AST, cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                cls_of[id(child)] = cls if not isinstance(child, ast.ClassDef) \
+                    else child.name
+                index(child, cls_of[id(child)])
+
+        index(tree, None)
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            cls_hint = cls_of.get(id(node))
+            fname = dotted_name(node.func) or ""
+            last = fname.split(".")[-1]
+            if last in THREAD_SPAWNERS:
+                for kw in node.keywords:
+                    if kw.arg in ("target", "function"):
+                        resolve(kw.value, cls_hint)
+                if last == "Timer" and len(node.args) >= 2:
+                    resolve(node.args[1], cls_hint)
+                elif last == "Thread":
+                    for arg in node.args:
+                        resolve(arg, cls_hint)
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "submit" and node.args:
+                resolve(node.args[0], cls_hint)
+            elif last in REGISTRATION_CALLS:
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    resolve(arg, cls_hint)
+        return roots
+
+    # --------------------------------------------------------- reachability
+
+    def _contexts(self, funcs, by_simple, roots) -> Dict[int, FrozenSet[str]]:
+        """id(func node) -> set of root qualnames whose thread reaches it;
+        unreachable functions default to the main context."""
+        ctx: Dict[int, Set[str]] = {}
+        nested_of: Dict[int, List] = {}
+        for f in funcs:
+            for g in funcs:
+                if g is not f and _is_ancestor(f.node, g.node):
+                    nested_of.setdefault(id(f), []).append(g)
+        for root in roots:
+            work = [root]
+            seen = {id(root)}
+            while work:
+                cur = work.pop()
+                ctx.setdefault(id(cur.node), set()).add(root.qualname)
+                for child in nested_of.get(id(cur), ()):
+                    if id(child) not in seen:
+                        seen.add(id(child))
+                        work.append(child)
+                for node in _walk_own_body(cur.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = None
+                    if isinstance(node.func, ast.Name):
+                        name = node.func.id
+                    elif isinstance(node.func, ast.Attribute) and \
+                            isinstance(node.func.value, ast.Name) and \
+                            node.func.value.id == "self":
+                        name = node.func.attr
+                    if name is None:
+                        continue
+                    for cand in by_simple.get(name, ()):
+                        if cand.cls and cur.cls and cand.cls != cur.cls:
+                            continue
+                        if id(cand) not in seen:
+                            seen.add(id(cand))
+                            work.append(cand)
+        return {k: frozenset(v) for k, v in ctx.items()}
+
+    # ------------------------------------------------------ lock inference
+
+    def _collect_lock_attrs(self, tree: ast.AST) -> Dict[Tuple[Optional[str], str], str]:
+        out: Dict[Tuple[Optional[str], str], str] = {}
+
+        def walk(node: ast.AST, cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    walk(child, child.name)
+                    continue
+                if isinstance(child, ast.Assign) and isinstance(child.value, ast.Call):
+                    name = dotted_name(child.value.func) or ""
+                    last = name.split(".")[-1]
+                    if last in LOCK_FACTORIES:
+                        for t in child.targets:
+                            if isinstance(t, ast.Attribute) and \
+                                    isinstance(t.value, ast.Name) and \
+                                    t.value.id == "self":
+                                out[(cls, t.attr)] = last
+                walk(child, cls)
+
+        walk(tree, None)
+        return out
+
+    def _exempt_attrs(self, tree: ast.AST) -> Set[Tuple[str, str]]:
+        """(cls, attr) bound to internally-synchronized factories anywhere."""
+        out: Set[Tuple[str, str]] = set()
+
+        def walk(node: ast.AST, cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    walk(child, child.name)
+                    continue
+                if isinstance(child, ast.Assign) and cls is not None:
+                    is_sync = isinstance(child.value, ast.Call) and \
+                        (dotted_name(child.value.func) or ""
+                         ).split(".")[-1] in SYNC_FACTORIES
+                    if is_sync:
+                        for t in child.targets:
+                            if isinstance(t, ast.Attribute) and \
+                                    isinstance(t.value, ast.Name) and \
+                                    t.value.id == "self":
+                                out.add((cls, t.attr))
+                walk(child, cls)
+
+        walk(tree, None)
+        return out
+
+    def _lock_id(self, expr: ast.AST, cls: Optional[str],
+                 lock_attrs) -> Optional[str]:
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self":
+            attr = expr.attr
+            if (cls, attr) in lock_attrs or "lock" in attr.lower() \
+                    or attr.endswith("_cond"):
+                return f"{cls}.{attr}" if cls else attr
+        return None
+
+    def _entry_held(self, funcs, by_simple, lock_attrs, roots) -> Dict[int, FrozenSet[str]]:
+        """Conservative entry-lock propagation: a private helper only ever
+        self-called with lock L held is analysed as holding L (the
+        '# caller holds _lock' idiom). Public methods and thread roots
+        always start unheld."""
+        call_held: Dict[int, List[FrozenSet[str]]] = {}
+
+        def record(cur, node: ast.AST, cls, held: FrozenSet[str]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                new_held = set(held)
+                for item in node.items:
+                    lock = self._lock_id(item.context_expr, cls, lock_attrs)
+                    if lock:
+                        new_held.add(lock)
+                for stmt in node.body:
+                    record(cur, stmt, cls, frozenset(new_held))
+                return
+            if isinstance(node, ast.Call):
+                callee = None
+                if isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id == "self":
+                    callee = node.func.attr
+                elif isinstance(node.func, ast.Name):
+                    # plain-name call: nested helper or module function
+                    callee = node.func.id
+                if callee is not None:
+                    for cand in by_simple.get(callee, ()):
+                        if cand.cls and cur.cls and cand.cls != cur.cls:
+                            continue
+                        call_held.setdefault(id(cand), []).append(held)
+            for child in ast.iter_child_nodes(node):
+                record(cur, child, cls, held)
+
+        for f in funcs:
+            for stmt in getattr(f.node, "body", ()):
+                record(f, stmt, f.cls, frozenset())
+
+        root_ids = {id(r) for r in roots}
+        out: Dict[int, FrozenSet[str]] = {}
+        for f in funcs:
+            sites = call_held.get(id(f), [])
+            if not sites or not f.simple.startswith("_") or id(f) in root_ids:
+                continue
+            common = frozenset.intersection(*sites)
+            if common:
+                out[id(f.node)] = common
+        return out
+
+    # ----------------------------------------------------- access scanning
+
+    def _collect_accesses(self, f, lock_attrs, method_names,
+                          base_held: FrozenSet[str]):
+        """Yield (attr, (kind, held, lineno, qualname)) for every self.X
+        read/write in f's own body, with the lock set held at that point."""
+        out: List[Tuple[str, _Access]] = []
+        cls = f.cls
+
+        def self_attr(node: ast.AST) -> Optional[str]:
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.value, ast.Name) and node.value.id == "self":
+                return node.attr
+            return None
+
+        def visit(node: ast.AST, held: FrozenSet[str]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                return  # nested defs carry their own context entry
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                new_held = set(held)
+                for item in node.items:
+                    lock = self._lock_id(item.context_expr, cls, lock_attrs)
+                    if lock:
+                        new_held.add(lock)
+                    visit(item.context_expr, held)
+                for stmt in node.body:
+                    visit(stmt, frozenset(new_held))
+                return
+            if isinstance(node, ast.Assign):
+                const = isinstance(node.value, ast.Constant)
+                for t in node.targets:
+                    attr = self_attr(t)
+                    if attr is not None and not const:
+                        out.append((attr, ("write", held, t.lineno, f.qualname)))
+                    sub_attr = self_attr(t.value) if isinstance(t, ast.Subscript) \
+                        else None
+                    if sub_attr is not None:
+                        out.append((sub_attr,
+                                    ("write", held, t.lineno, f.qualname)))
+                visit(node.value, held)
+                return
+            if isinstance(node, ast.AugAssign):
+                attr = self_attr(node.target)
+                if attr is not None:
+                    out.append((attr, ("write", held, node.lineno, f.qualname)))
+                if isinstance(node.target, ast.Subscript):
+                    sub_attr = self_attr(node.target.value)
+                    if sub_attr is not None:
+                        out.append((sub_attr,
+                                    ("write", held, node.lineno, f.qualname)))
+                visit(node.value, held)
+                return
+            if isinstance(node, ast.Call):
+                # self.method(...) is a call edge, not a state read
+                callee = self_attr(node.func)
+                if callee is not None and (cls, callee) in method_names:
+                    for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                        visit(arg, held)
+                    return
+            attr = self_attr(node)
+            if attr is not None and isinstance(getattr(node, "ctx", None), ast.Load) \
+                    and (cls, attr) not in method_names:
+                out.append((attr, ("read", held, node.lineno, f.qualname)))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        for stmt in getattr(f.node, "body", ()):
+            visit(stmt, base_held)
+        return out
+
+    # ------------------------------------------------------------- hazards
+
+    def _hazards(self, module: Module, accesses) -> List[Finding]:
+        findings: List[Finding] = []
+        for (cls, attr), recs in sorted(accesses.items()):
+            hit = None
+            for ctx_a, (kind_a, held_a, line_a, qual_a) in recs:
+                if kind_a != "write":
+                    continue
+                for ctx_b, (kind_b, held_b, line_b, qual_b) in recs:
+                    if len(ctx_a | ctx_b) < 2:
+                        continue  # same single execution context
+                    if held_a & held_b:
+                        continue  # common lock serializes the pair
+                    hit = (line_a, qual_a, qual_b, line_b,
+                           sorted(ctx_a), sorted(ctx_b))
+                    break
+                if hit:
+                    break
+            if hit:
+                line_a, qual_a, qual_b, line_b, ca, cb = hit
+                findings.append(Finding(
+                    checker=self.id, path=module.relpath, line=line_a,
+                    message=(f"self.{attr} written in {qual_a} (thread context "
+                             f"{'/'.join(ca)}) and accessed in {qual_b}:"
+                             f"{line_b} (context {'/'.join(cb)}) with no "
+                             "common lock — cross-thread race"),
+                    key=f"hazard:{cls}.{attr}"))
+        return findings

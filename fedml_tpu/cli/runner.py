@@ -77,6 +77,9 @@ class FedMLEdgeRunner:
             self.home, f"jobs_edge{self.edge_id}.json")
         self._history_lock = threading.Lock()
         self._job_history: Dict[str, str] = self._load_history()
+        # serializes status reports: the dispatcher thread (stop/replay) and
+        # a watcher thread (process exit) can report concurrently
+        self._status_lock = threading.Lock()
         self._report_status(MLOpsMetrics.STATUS_IDLE)
 
     @classmethod
@@ -193,9 +196,11 @@ class FedMLEdgeRunner:
     def _callback_start_train(self, job: Dict[str, Any]) -> None:
         """Reference ``callback_start_train:426``: package -> config -> fork."""
         run_id = job.get("run_id", 0)
-        if str(run_id) in self._job_history:
+        with self._history_lock:
+            prior = self._job_history.get(str(run_id))
+        if prior is not None:
             logging.info("edge %d: run %s already terminal (%s), skipping",
-                         self.edge_id, run_id, self._job_history[str(run_id)])
+                         self.edge_id, run_id, prior)
             return
         with self._proc_lock:
             if (self._proc is not None and self._proc.poll() is None
@@ -208,8 +213,11 @@ class FedMLEdgeRunner:
         # a different run supersedes the current one (reference restarts the
         # training process on every start message); record the loser as
         # KILLED here — its watcher bows out once self._proc is reassigned
-        if superseded is not None and str(superseded) not in self._job_history:
-            self._record_terminal(superseded, MLOpsMetrics.STATUS_KILLED)
+        if superseded is not None:
+            with self._history_lock:
+                known = str(superseded) in self._job_history
+            if not known:
+                self._record_terminal(superseded, MLOpsMetrics.STATUS_KILLED)
         self._kill_train_process()
         self.metrics.run_id = run_id
         self._done.clear()
@@ -266,9 +274,12 @@ class FedMLEdgeRunner:
     def _callback_stop_train(self, job: Dict[str, Any]) -> None:
         """Reference ``callback_stop_train:445``."""
         run_id = job.get("run_id", self._current_run)
-        if run_id is not None and str(run_id) in self._job_history:
-            # replayed stop for an already-terminal run: no spurious KILLED
-            return
+        if run_id is not None:
+            with self._history_lock:
+                terminal = str(run_id) in self._job_history
+            if terminal:
+                # replayed stop for an already-terminal run: no spurious KILLED
+                return
         if run_id is not None and self._current_run is not None \
                 and run_id != self._current_run:
             return  # stop for a run this daemon never started
@@ -290,18 +301,23 @@ class FedMLEdgeRunner:
     # --- status FSM ---------------------------------------------------------
     def _report_status(self, status: str) -> None:
         """Reference ``callback_runner_id_status:619`` + CLI status file."""
-        self.status = status
-        self.metrics.report_client_training_status(self.edge_id, status)
-        # per-edge file: multiple agents sharing one home dir must not
-        # clobber each other's state (plus the legacy shared file the CLI
-        # `status` command falls back to)
         rec = {"status": status, "edge_id": self.edge_id, "time": time.time(),
                "run_id": getattr(self.metrics, "run_id", None)}
-        with open(os.path.join(self.home,
-                               f"status_edge{self.edge_id}.json"), "w") as f:
-            json.dump(rec, f)
-        with open(os.path.join(self.home, "status.json"), "w") as f:
-            json.dump(rec, f)
+        # attr + status files under one lock: a watcher thread and the
+        # dispatcher can report concurrently, and a torn attr/file pair
+        # would show two different states to the CLI `status` command.
+        # The broker publish stays outside the critical section.
+        with self._status_lock:
+            self.status = status
+            # per-edge file: multiple agents sharing one home dir must not
+            # clobber each other's state (plus the legacy shared file the
+            # CLI `status` command falls back to)
+            with open(os.path.join(self.home,
+                                   f"status_edge{self.edge_id}.json"), "w") as f:
+                json.dump(rec, f)
+            with open(os.path.join(self.home, "status.json"), "w") as f:
+                json.dump(rec, f)
+        self.metrics.report_client_training_status(self.edge_id, status)
         self.broker.publish(STATUS_TOPIC, pack_payload(rec))
 
 

@@ -397,6 +397,9 @@ class MqttClient:
         self.keepalive = keepalive
         self._sock = socket.create_connection((host, port), timeout=timeout)
         self._send_lock = threading.Lock()
+        # guards the subscription/ack tables shared between the API threads
+        # and the reader; held only for dict ops, never across I/O
+        self._tab_lock = threading.Lock()
         self._subs: Dict[str, Callback] = {}
         self._acks: Dict[int, threading.Event] = {}
         self._suback: Dict[int, threading.Event] = {}
@@ -463,7 +466,9 @@ class MqttClient:
                 pass
 
     def _deliver(self, topic: str, payload: bytes) -> None:
-        for filt, cb in list(self._subs.items()):
+        with self._tab_lock:
+            subs = list(self._subs.items())
+        for filt, cb in subs:
             if topic_matches(filt, topic):
                 self._dispatch_q.put((cb, topic, payload))
 
@@ -508,12 +513,14 @@ class MqttClient:
                         self._deliver(*held)
                 elif ptype == PUBACK:
                     (pid,) = struct.unpack_from(">H", body, 0)
-                    ev = self._acks.pop(pid, None)
+                    with self._tab_lock:
+                        ev = self._acks.pop(pid, None)
                     if ev:
                         ev.set()
                 elif ptype in (SUBACK, UNSUBACK):
                     (pid,) = struct.unpack_from(">H", body, 0)
-                    ev = self._suback.pop(pid, None)
+                    with self._tab_lock:
+                        ev = self._suback.pop(pid, None)
                     if ev:
                         ev.set()
                 elif ptype == PINGRESP:
@@ -552,19 +559,22 @@ class MqttClient:
         if qos > 0:
             pid = self._pid()
             ev = threading.Event()
-            self._acks[pid] = ev
+            with self._tab_lock:
+                self._acks[pid] = ev
             vh += struct.pack(">H", pid)
         self._send(_packet(PUBLISH, flags, vh + payload))
         if qos > 0 and not ev.wait(self._timeout):
-            self._acks.pop(pid, None)
+            with self._tab_lock:
+                self._acks.pop(pid, None)
             raise TimeoutError(f"PUBACK timeout on {topic}")
 
     def subscribe(self, topic_filter: str, callback: Callback,
                   qos: int = 1) -> None:
-        self._subs[topic_filter] = callback
         pid = self._pid()
         ev = threading.Event()
-        self._suback[pid] = ev
+        with self._tab_lock:
+            self._subs[topic_filter] = callback
+            self._suback[pid] = ev
         body = (struct.pack(">H", pid) + _encode_string(topic_filter)
                 + bytes([qos]))
         self._send(_packet(SUBSCRIBE, 0b0010, body))
@@ -572,15 +582,17 @@ class MqttClient:
             # roll back: a subscription the caller believes failed must not
             # keep delivering, and the orphaned waiter must not catch a
             # later pid-wrap SUBACK
-            self._subs.pop(topic_filter, None)
-            self._suback.pop(pid, None)
+            with self._tab_lock:
+                self._subs.pop(topic_filter, None)
+                self._suback.pop(pid, None)
             raise TimeoutError(f"SUBACK timeout on {topic_filter}")
 
     def unsubscribe(self, topic_filter: str) -> None:
-        self._subs.pop(topic_filter, None)
         pid = self._pid()
         ev = threading.Event()
-        self._suback[pid] = ev
+        with self._tab_lock:
+            self._subs.pop(topic_filter, None)
+            self._suback[pid] = ev
         self._send(_packet(UNSUBSCRIBE, 0b0010,
                            struct.pack(">H", pid) + _encode_string(topic_filter)))
         ev.wait(self._timeout)

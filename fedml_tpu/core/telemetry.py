@@ -81,6 +81,8 @@ class Gauge:
         self._value = 0.0
 
     def set(self, v: float) -> None:
+        # last-writer-wins by design: one GIL-atomic float store keeps the
+        # sampler path lock-free — graftcheck: disable=thread-hazard
         self._value = float(v)
 
     @property

@@ -179,8 +179,10 @@ class FedMLAggregator:
                if self._robust is not None else None)
         agg_delta, quarantine, z = self._agg_fn(stacked, weights, rng)
         if quarantine is not None:
-            qn = np.asarray(quarantine)
-            zn = np.asarray(z)
+            # sync by design: the quarantine verdict decides which slots the
+            # server manager excludes BEFORE it broadcasts the next round
+            qn = np.asarray(quarantine)  # graftcheck: disable=host-sync
+            zn = np.asarray(z)  # graftcheck: disable=host-sync
             self.last_quarantined_slots = [idx[i] for i in np.nonzero(qn)[0]]
             self.last_z = {idx[i]: float(zn[i]) for i in range(len(idx))}
             if self.last_quarantined_slots:

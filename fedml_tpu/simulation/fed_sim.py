@@ -1089,6 +1089,8 @@ class FedSimulator:
             # no deferred readback
             self._last_round_end = time.perf_counter()
             self._run_selfheal(rounds, base_rng, apply_fn, ckpt, log_fn)
+            # end-of-run drain: wall-clock must cover in-flight device work
+            # — graftcheck: disable=host-sync
             jax.block_until_ready(self.params)
             if ckpt is not None:
                 ckpt.close()
@@ -1152,7 +1154,8 @@ class FedSimulator:
         # drain the async dispatch queue: per-round host reads (metric
         # scalars) can complete before the executables fully retire, so
         # without this the caller's wall-clock over run() — and the last
-        # rounds' attribution — would under-count device work still in flight
+        # rounds' attribution — would under-count device work still in
+        # flight; once per run, not per round — graftcheck: disable=host-sync
         jax.block_until_ready(self.params)
         if ckpt is not None:
             ckpt.close()
@@ -1224,8 +1227,10 @@ class FedSimulator:
                     metrics_vec = self._dispatch_even(inputs, step_rng)
                 self._phase_acc.append(
                     ("dispatch", time.perf_counter() - t_disp))
-                mvec = np.asarray(metrics_vec)  # sync: verdict gates round
-                qz = np.asarray(self._last_qz)
+                # sync by design: the watchdog verdict gates the next round's
+                # dispatch, so self-heal mode cannot defer this readback
+                mvec = np.asarray(metrics_vec)  # graftcheck: disable=host-sync
+                qz = np.asarray(self._last_qz)  # graftcheck: disable=host-sync
                 loss = float(mvec[0])
                 spike = (len(window) > 0 and np.isfinite(loss)
                          and loss > cfg.watchdog_factor * float(

@@ -226,6 +226,8 @@ class MultiTenantSimDriver:
             trace_plane.record_instant(
                 "admission", attrs={"tenant": job.tenant,
                                     "decision": verdict.decision})
+            # written before Thread.start(); start() is the happens-before
+            # edge the worker reads through — graftcheck: disable=thread-hazard
             self._results[job.tenant] = TenantRunResult(
                 tenant=job.tenant, verdict=verdict,
                 rounds_expected=int(sim.cfg.comm_round))
@@ -233,6 +235,8 @@ class MultiTenantSimDriver:
                 self._log(verdict.summary())
             if verdict.rejected:
                 continue
+            # written before Thread.start(); start() is the happens-before
+            # edge the worker reads through — graftcheck: disable=thread-hazard
             self._sims[job.tenant] = (sim, apply_fn, env)
             if verdict.admitted:
                 self.scheduler.register(job.tenant, env.round_cost,
@@ -249,12 +253,17 @@ class MultiTenantSimDriver:
                     if ready or not live:
                         break
                     self._cond.wait()
-            done = [t for t, s in dict(self._state).items() if s == _DONE
-                    and t in self._threads]
+                # snapshot under the cond — workers mutate _state under it;
+                # the joins in _finish stay outside the critical section
+                done = [t for t, s in self._state.items() if s == _DONE
+                        and t in self._threads]
             for t in done:
                 self._finish(t)
             if not ready:
-                if not [t for t in self._threads if self._state.get(t) != _DONE]:
+                with self._cond:
+                    still_live = any(self._state.get(t) != _DONE
+                                     for t in self._threads)
+                if not still_live:
                     break
                 continue
             tenant = self.scheduler.next_tenant(ready)

@@ -6,12 +6,12 @@ Library output must go through ``logging`` or the telemetry sinks
 a stray print in a hot path is invisible to log collectors and can stall
 under redirected stdout.
 
-The check itself now lives in the graftcheck suite as the ``no-print``
+The check itself lives in the graftcheck suite as the ``no-print``
 checker (``fedml_tpu/analysis/no_print.py``; run all checkers with
-``python -m fedml_tpu.cli analyze``). This script is kept as a thin
-compatibility shim: ``python scripts/check_no_print.py`` still exits 1 on
-violations, and ``find_print_calls`` keeps its old import surface for
-tests/test_no_print.py.
+``python -m fedml_tpu.cli analyze``). This script is a thin compatibility
+shim that delegates straight to the graftcheck frontend restricted to
+``no-print`` — one driver, one suppression/baseline semantics — and keeps
+``find_print_calls`` importable for tests/test_no_print.py.
 """
 
 from __future__ import annotations
@@ -26,19 +26,11 @@ from fedml_tpu.analysis.no_print import find_print_calls  # noqa: E402,F401
 
 
 def main() -> int:
-    from fedml_tpu.analysis.core import run_checkers
-    from fedml_tpu.analysis.no_print import NoPrintChecker
+    from fedml_tpu.analysis.core import main as graftcheck_main
 
-    package_dir = os.path.join(REPO_ROOT, "fedml_tpu")
-    findings = run_checkers([NoPrintChecker], package_dir, REPO_ROOT)
-    if findings:
-        print("bare print() calls in library code (use logging or the "
-              "telemetry sinks; see scripts/check_no_print.py):",
-              file=sys.stderr)
-        for f in findings:
-            print(f"  {f.render()}", file=sys.stderr)
-        return 1
-    return 0
+    # --no-baseline matches the shim's historical behaviour (it predates
+    # the baseline) and keeps other checkers' entries from showing as stale
+    return graftcheck_main(["--checker", "no-print", "--no-baseline"])
 
 
 if __name__ == "__main__":

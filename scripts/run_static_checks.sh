@@ -5,8 +5,12 @@
 #
 # Intended as the CI / pre-commit gate (see devops/README.md):
 #   1. graftcheck — the fedml_tpu.analysis checker suite (jit-purity,
-#      determinism, lock-order, config-drift, no-print); exits 1 on any
-#      finding not grandfathered in scripts/graftcheck_baseline.json.
+#      determinism, lock-order, config-drift, no-print, donation-safety,
+#      sharding-consistency, host-sync, collective-deadlock,
+#      thread-hazard); exits 1 on any finding not grandfathered in
+#      scripts/graftcheck_baseline.json. Pre-commit can pass
+#      "--changed-only" through for the <5s loop; CI runs the full scan
+#      (optionally with "--format sarif" for PR annotation).
 #   2. gen_config_reference --check — fails if docs/config_reference.md
 #      is stale relative to the config keys the code actually reads.
 #

@@ -1,0 +1,30 @@
+"""Fixture: collectives under per-process control flow — every guard
+flavour the checker recognises (process_index, *rank*, tenant, ternary)."""
+
+import jax
+from jax import lax
+
+from comm_stub import broadcast_one_to_all
+
+
+def sync_stats(x):
+    if jax.process_index() == 0:
+        return jax.lax.psum(x, "data")  # only process 0 ever joins
+    return x
+
+
+def rank_guarded(x, rank):
+    if rank == 0:
+        return lax.all_gather(x, "model")
+    return x
+
+
+def ternary(x):
+    return lax.pmean(x, "data") if jax.host_id() == 0 else x
+
+
+class TenantWorker:
+    def maybe_broadcast(self, x):
+        if self.tenant == "a":
+            return broadcast_one_to_all(x)  # tenants share one mesh
+        return x

@@ -1,0 +1,32 @@
+"""Fixture: collectives under uniform guards, per-process branches without
+collectives, and nested defs that reset the guard context."""
+
+import jax
+from jax import lax
+
+
+def config_guard(x, cfg):
+    if cfg.use_psum:  # same config on every participant
+        return jax.lax.psum(x, "data")
+    return x
+
+
+def count_guard(x):
+    if jax.process_count() > 1:  # uniform across the mesh
+        return jax.lax.pmean(x, "data")
+    return x
+
+
+def rank_reporting(x, rank, log):
+    if rank == 0:
+        log("round done")  # divergent branch, but no collective inside
+    return jax.lax.psum(x, "data")  # collective outside any guard
+
+
+def make_step(rank):
+    if rank == 0:
+        def step(x):
+            # new call boundary: the body does not run under the guard
+            return jax.lax.psum(x, "data")
+        return step
+    return None

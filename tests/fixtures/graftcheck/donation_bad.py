@@ -1,0 +1,50 @@
+"""Fixture: use-after-donate violations in every resolution shape the
+checker supports (direct binding, builder hop, decorator, inline)."""
+
+from functools import partial
+
+import jax
+
+
+def train_step(params, opt_state, batch):
+    return params, opt_state
+
+
+def _round(state, grads):
+    return state
+
+
+class Trainer:
+    def __init__(self):
+        # direct binding: jit with donate_argnums
+        self._step = jax.jit(train_step, donate_argnums=(0, 1))
+        # builder hop: the donated jit is made one call away
+        self._round = self._build_round_step()
+
+    def _build_round_step(self):
+        return jax.jit(_round, donate_argnums=(0,))
+
+    def step_and_log(self, batch):
+        out = self._step(self.params, self.opt_state, batch)
+        # self.params was donated at position 0 and never rebound
+        return out, self.params
+
+    def advance(self, state, grads):
+        result = self._round(state, grads)
+        # state was donated through the builder-returned jit
+        return result, state.shape
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def apply_update(params, update):
+    return params
+
+
+def drive(weights, update):
+    new = apply_update(weights, update)
+    return new, weights  # weights donated to the decorated jit above
+
+
+def inline(x, y):
+    out = jax.jit(train_step, donate_argnums=(0,))(x, y, None)
+    return out, x  # x donated to the inline jit call

@@ -1,0 +1,37 @@
+"""Fixture: donation used idiomatically — every donated buffer is either
+rebound in the same statement, rebound before any later read, or never
+read again. A non-donating jit imposes no restriction at all."""
+
+import jax
+
+
+def train_step(params, opt_state, batch):
+    return params, opt_state
+
+
+class Trainer:
+    def __init__(self):
+        self._step = jax.jit(train_step, donate_argnums=(0, 1))
+        self._fwd = jax.jit(train_step)  # no donation
+
+    def step(self, batch):
+        # same-statement rebinding: the canonical safe shape
+        self.params, self.opt_state = self._step(
+            self.params, self.opt_state, batch)
+        return self.params
+
+    def rebound_before_read(self, batch):
+        out = self._step(self.params, self.opt_state, batch)
+        self.params = out[0]
+        self.opt_state = out[1]
+        return self.params  # read lands after the rebinding horizon
+
+    def no_donation(self, batch):
+        out = self._fwd(self.params, self.opt_state, batch)
+        return out, self.params  # _fwd does not donate
+
+
+def drive(weights, update):
+    weights = jax.jit(train_step, donate_argnums=(0,))(
+        weights, update, None)[0]
+    return weights

@@ -1,0 +1,29 @@
+"""Fixture: a round loop that stays device-resident — readback only in
+cold phase-boundary planes, placement-wrapped host conversions, and
+host-container access (fed under the fed_sim.py relpath)."""
+
+import jax
+import numpy as np
+
+
+class FedSimulator:
+    def run(self, apply_fn):
+        state = None
+        for r in range(2):
+            state = self._step(r)
+        return self._eval_metrics(state)  # cold plane: readback is the point
+
+    def _step(self, r):
+        # host->device placement around asarray is not a sync
+        arr = jax.device_put(np.asarray(self._host_buf), self._sharding)
+        x = np.asarray(self._batches[0])  # host-container subscript
+        scale = float(0.5)                # plain python scalar
+        return arr, x, scale
+
+    def _eval_metrics(self, state):
+        return np.asarray(state)
+
+
+def build_round_inputs(batches):
+    # packing plane: host staging, never on the round loop
+    return [np.asarray(b) for b in batches]

@@ -1,0 +1,20 @@
+"""Fixture: PartitionSpec axis names nothing declares, plus a hand-rolled
+tree of literal specs that duplicates auto_partition_specs."""
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+
+def replicate_specs(params):
+    # "clients" is a typo of the canonical "client" axis
+    return P("clients", None)
+
+
+def model_specs():
+    # nested-tuple spec with a typo'd second axis
+    return P(("data", "modle"))
+
+
+def handrolled(params):
+    # WARNING: literal P(...) per leaf — auto_partition_specs' job
+    return jax.tree_util.tree_map(lambda x: P("data"), params)

@@ -1,0 +1,25 @@
+"""Fixture: every PartitionSpec axis is either canonical (parallel/mesh.py
+vocabulary) or declared by a mesh constructor in this module; tree_map
+without literal specs stays legal."""
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def local_mesh(devices):
+    # an ad-hoc mesh declares its own axis names for this module
+    return Mesh(devices, ("rows", "cols"))
+
+
+def local_spec():
+    return P("rows", "cols")
+
+
+def canonical_specs(mesh):
+    # canonical axes from the framework vocabulary
+    return NamedSharding(mesh, P("data", None)), P(("client", "model"))
+
+
+def scaled(params):
+    # tree_map without spec construction is not the spec layer's business
+    return jax.tree_util.tree_map(lambda x: x * 2, params)

@@ -1,0 +1,49 @@
+"""Fixture: cross-thread attribute races (fed to the checker under a
+comm/ relpath). A receive-loop thread writes shared state that the main
+thread reads with no common lock — including the one-sided-locking trap
+where only the reader takes the lock."""
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class Wire:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.status = None
+        self._pending = {}
+
+    def start(self):
+        t = threading.Thread(target=self._read_loop, daemon=True)
+        t.start()
+
+    def _read_loop(self):
+        while True:
+            msg = self._recv()
+            self.status = msg          # unlocked write from the thread
+            self._pending[msg.id] = msg
+
+    def poll(self):
+        return self.status             # unlocked read from main
+
+    def flush(self):
+        with self._lock:               # reader locks, writer doesn't:
+            self._pending.clear()      # still a race
+
+    def _recv(self):
+        return None
+
+
+class Pump:
+    def __init__(self):
+        self._pool = ThreadPoolExecutor(max_workers=1)
+        self.result = None
+
+    def kick(self, work):
+        self._pool.submit(self._work, work)
+
+    def _work(self, work):
+        self.result = work()           # executor-thread write
+
+    def read(self):
+        return self.result             # main-thread read, no lock anywhere

@@ -1,0 +1,50 @@
+"""Fixture: the safe cross-thread idioms — a common lock on both sides,
+entry-lock propagation into helpers (self-call and nested plain-name
+call), GIL-atomic flag flips, and internally-synchronized containers."""
+
+import queue
+import threading
+
+
+class SafeWire:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.status = None
+        self._running = True
+        self._q = queue.Queue()
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while self._running:           # reads a GIL-atomic flag
+            msg = self._q.get()        # Queue synchronizes internally
+            with self._lock:
+                self.status = msg
+
+    def stop(self):
+        self._running = False          # constant flag flip: the idiom
+
+    def poll(self):
+        with self._lock:
+            return self.status         # same lock as the writer
+
+    def update(self, m):
+        with self._lock:
+            self._apply(m)
+
+    def _apply(self, m):
+        # only ever called with _lock held — entry-lock propagation
+        self.status = m
+
+    def wait_ready(self):
+        def _ready():
+            return self.status is not None
+
+        with self._lock:
+            while not _ready():        # nested helper called under the lock
+                self._lock.release()
+                self._lock.acquire()
+
+    def push(self, m):
+        self._q.put(m)
