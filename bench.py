@@ -485,6 +485,207 @@ def cohort_sweep_bench(sizes=(10, 100, 1000, 10000), pool: int = 20000,
     return 0 if ok else 1
 
 
+def agg_sweep_bench(cohorts=(1000, 10000), codecs=("none", "q4"),
+                    defenses=("krum",), pool: int = 12000,
+                    warmup_rounds: int = 1, measured_rounds: int = 2) -> int:
+    """``--agg-sweep``: robust-aggregation frontier — defense x codec x
+    cohort, each cell run with ``agg_kernels`` off (the unfused programs)
+    and on (the fused quantize+pack / sanitize+Krum hot path), reporting
+    rounds/sec, the exact per-phase attribution, and the codec's wire
+    bytes per round (``spec_wire_nbytes`` x cohort). A second block
+    measures the double-buffered arena movement: the residual
+    ``state_gather + state_scatter`` cost under the prefetch pipeline
+    (where ``put_take`` fuses scatter-back with the next round's gather,
+    stamped ``state_move``) against the unoverlapped cost of the
+    synchronous path.
+
+    Gates: every phase breakdown must sum exactly to its round's wall
+    time; the overlapped gather+scatter residual must be <= 20% of the
+    unoverlapped cost; and on TPU (where the Pallas kernels engage — on
+    CPU they fall back to the bit-identical jnp references, so the fused
+    path's arithmetic is the same XLA code) the 10k-cohort krum+q4 cell
+    must clear 2x the unfused rounds/sec."""
+    import math
+
+    import numpy as np
+
+    import jax
+    import fedml_tpu
+    from fedml_tpu.comm.codec import spec_wire_nbytes
+    from fedml_tpu.data.federated import ArrayPair, build_federated_data
+    from fedml_tpu.simulation import build_simulator
+
+    # dim 64 keeps the lr weight leaf above the codec's _MIN_LEAF
+    # compressibility floor, so the wire-byte column actually shrinks
+    # under q4 instead of every leaf riding raw
+    spc, dim, class_num = 8, 64, 2
+    rng = np.random.default_rng(0)
+    n = pool * spc
+    y = (np.arange(n) % class_num).astype(np.int64)
+    x = rng.normal(size=(n, dim)).astype(np.float32) \
+        + 2.0 * y[:, None].astype(np.float32)
+    net_map = {c: list(range(c * spc, (c + 1) * spc)) for c in range(pool)}
+    fed = build_federated_data(
+        ArrayPair(x, y), ArrayPair(x[:64], y[:64]), net_map, class_num)
+
+    def _run_cell(per_round, defense, codec, kernels):
+        cfg = dict(
+            dataset="synthetic_blobs", model="lr",
+            client_num_in_total=pool, client_num_per_round=int(per_round),
+            comm_round=warmup_rounds + measured_rounds,
+            learning_rate=0.1, epochs=1, batch_size=spc,
+            frequency_of_the_test=10_000, random_seed=0,
+            federated_optimizer="FedAvg",
+            defense_type=defense, byzantine_n=2,
+            sanitize_updates=True,
+            agg_kernels=bool(kernels),
+            # synchronous rounds keep every phase inside its own round so
+            # the breakdown sums are exact (see cohort_sweep_bench)
+            prefetch=False,
+        )
+        if codec != "none":
+            cfg["comm_codec"] = codec
+        args = fedml_tpu.init(config=cfg)
+        sim, _ = build_simulator(args, fed_data=fed)
+        # shape/dtype template for the wire-byte estimate — the live params
+        # are donated into the round step, so snapshot before run()
+        params = jax.tree_util.tree_map(
+            lambda l: np.zeros(l.shape, l.dtype), sim.params)
+        hist = sim.run(apply_fn=None, log_fn=None)
+        recs = hist[warmup_rounds:]
+        wall = sum(r["round_time"] for r in recs)
+        acc, sums_ok = {}, True
+        for r in recs:
+            ps = r["phases"]
+            sums_ok = sums_ok and math.isclose(
+                sum(ps.values()), r["round_time"],
+                rel_tol=1e-6, abs_tol=1e-9)
+            for k, v in ps.items():
+                acc[k] = acc.get(k, 0.0) + v
+        return params, {
+            "rounds_per_sec": round(measured_rounds / wall, 4) if wall else None,
+            "phase_breakdown_s": {
+                k: round(v / measured_rounds, 6) for k, v in sorted(acc.items())},
+            "phase_sum_equals_round_time": bool(sums_ok),
+        }
+
+    results = []
+    for per_round in cohorts:
+        for defense in defenses:
+            for codec in codecs:
+                params, unfused = _run_cell(per_round, defense, codec, False)
+                _, fused = _run_cell(per_round, defense, codec, True)
+                raw_pc, coded_pc = (
+                    spec_wire_nbytes(codec, params) if codec != "none"
+                    else ((lambda b: (b, b))(sum(
+                        np.asarray(l).nbytes
+                        for l in jax.tree_util.tree_leaves(params)))))
+                ru, rf = unfused["rounds_per_sec"], fused["rounds_per_sec"]
+                cell = {
+                    "cohort": int(per_round), "defense": defense,
+                    "codec": codec,
+                    "wire_bytes_per_round": int(coded_pc) * int(per_round),
+                    "raw_bytes_per_round": int(raw_pc) * int(per_round),
+                    "unfused": unfused, "fused": fused,
+                    "speedup_fused_over_unfused": (
+                        round(rf / ru, 3) if ru and rf else None),
+                }
+                results.append(cell)
+                print(f"agg-sweep: cohort={per_round} defense={defense} "
+                      f"codec={codec} unfused={ru} fused={rf} r/s",
+                      file=sys.stderr, flush=True)
+
+    # --- double-buffered state movement: residual gather+scatter under the
+    # prefetch pipeline vs the unoverlapped synchronous cost (SCAFFOLD so
+    # every round moves real per-client arena state)
+    state_cohort = min(1000, pool)
+
+    def _state_run(prefetch, rounds=10):
+        args = fedml_tpu.init(config=dict(
+            dataset="synthetic_blobs", model="lr",
+            client_num_in_total=pool, client_num_per_round=state_cohort,
+            comm_round=rounds, learning_rate=0.1, epochs=1, batch_size=spc,
+            frequency_of_the_test=10_000, random_seed=0,
+            federated_optimizer="SCAFFOLD", prefetch=bool(prefetch),
+            # full-pool capacity isolates the overlap mechanism from the
+            # eviction policy: under capacity pressure put_take protect-
+            # aborts (by design) and the run degenerates to the sync path
+            client_state_capacity=pool,
+        ))
+        sim, _ = build_simulator(args, fed_data=fed)
+        hist = sim.run(apply_fn=None, log_fn=None)
+        # Window: skip the compile-heavy first rounds AND the last TWO
+        # records — the final round has no successor so it scatters
+        # synchronously, and under the deferred-readback attribution that
+        # scatter lands in the second-to-last record. Per-phase MEDIAN, not
+        # mean: a peek-miss round falls back to the sync scatter, and its
+        # first use mid-run pays a one-time compile spike that would
+        # otherwise dominate a short window; the gate is about the
+        # recurring steady-state residual.
+        recs = hist[3:-2]
+        keys = {k for r in recs for k in r["phases"]}
+        med = {}
+        for k in keys:
+            vals = sorted(r["phases"].get(k, 0.0) for r in recs)
+            med[k] = vals[len(vals) // 2] if vals else 0.0
+        engaged = sum(1 for r in recs if r["phases"].get("state_move", 0.0) > 0)
+        return med, engaged, len(recs)
+
+    sync_ph, _, _ = _state_run(False)
+    pipe_ph, engaged_rounds, window_rounds = _state_run(True)
+    unoverlapped = sync_ph.get("state_gather", 0.0) \
+        + sync_ph.get("state_scatter", 0.0)
+    residual = pipe_ph.get("state_gather", 0.0) \
+        + pipe_ph.get("state_scatter", 0.0)
+    ratio = (residual / unoverlapped) if unoverlapped > 0 else None
+    overlap_pass = (ratio is not None and ratio <= 0.20
+                    and engaged_rounds > 0)
+    state_move = {
+        "cohort": state_cohort,
+        "unoverlapped_gather_scatter_s": round(unoverlapped, 6),
+        "overlapped_residual_s": round(residual, 6),
+        "state_move_s": round(pipe_ph.get("state_move", 0.0), 6),
+        "engaged_rounds": f"{engaged_rounds}/{window_rounds}",
+        "residual_ratio": round(ratio, 4) if ratio is not None else None,
+        "pass_le_20pct": bool(overlap_pass),
+    }
+
+    backend = jax.default_backend()
+    target = next((c for c in results
+                   if c["cohort"] == 10000 and c["defense"] == "krum"
+                   and c["codec"] == "q4"), None)
+    speedup = (target or {}).get("speedup_fused_over_unfused")
+    speedup_pass = speedup is not None and speedup >= 2.0
+    all_sums = all(c["unfused"]["phase_sum_equals_round_time"]
+                   and c["fused"]["phase_sum_equals_round_time"]
+                   for c in results)
+    line = {
+        "metric": "agg_sweep_robust_frontier",
+        "unit": (f"rounds/sec per (defense, codec, cohort) cell, FedAvg lr "
+                 f"on synthetic blobs ({pool}-client pool, {spc} samples x "
+                 f"dim {dim}), sanitizer on, agg_kernels off vs on, "
+                 f"sync rounds; state-move block: SCAFFOLD cohort 1000, "
+                 f"prefetch off vs on"),
+        "backend": backend,
+        "results": results,
+        "state_move_overlap": state_move,
+        "speedup_10k_krum_q4": speedup,
+        "pass_10k_krum_q4_2x": bool(speedup_pass),
+        "phase_sums_exact": bool(all_sums),
+    }
+    print(json.dumps(line), flush=True)
+    # the 2x gate is a TPU gate: on CPU the Pallas kernels deliberately
+    # fall back to the bit-identical jnp references (interpret mode exists
+    # for parity testing, not speed), so fused == unfused arithmetic there
+    ok = all_sums and overlap_pass and (speedup_pass or backend != "tpu")
+    print(f"agg-sweep: phase_sums_exact={all_sums} "
+          f"overlap_ratio={state_move['residual_ratio']} "
+          f"(pass<=20%={overlap_pass}) 10k-krum-q4-speedup={speedup} "
+          f"(backend={backend}) {'OK' if ok else 'BELOW TARGET'}",
+          file=sys.stderr, flush=True)
+    return 0 if ok else 1
+
+
 def model_sweep_bench(model_axes=(1, 2, 4), rounds: int = 3) -> int:
     """``--model-sweep``: CPU-only memory-scaling sweep of the 2-D federated
     mesh — the same SCAFFOLD mnist/lr round loop on a fixed client axis (2)
@@ -780,6 +981,11 @@ if __name__ == "__main__":
         # cohort-axis scaling measurement — host + CPU backend only
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         sys.exit(cohort_sweep_bench())
+    if "--agg-sweep" in sys.argv:
+        # robust-aggregation frontier — CPU backend (kernels engage on TPU;
+        # CPU runs the bit-identical reference fallbacks)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        sys.exit(agg_sweep_bench())
     if "--model-sweep" in sys.argv:
         # model-axis memory scaling — CPU backend with virtual devices; the
         # flag must land before the first backend init to take effect

@@ -127,7 +127,7 @@ def get_algorithm(
             name=name, init_server_state=init_server_state,
             init_client_state=_no_state,
             local_update=local_update, server_update=server_update,
-            aggregate=aggregate,
+            aggregate=aggregate, robust=base_cfg,
         )
 
     if name_l == FEDML_FEDERATED_OPTIMIZER_FEDPROX.lower():

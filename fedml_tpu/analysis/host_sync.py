@@ -14,7 +14,11 @@ the engine entry points — the simulation round loops
 (``fed_sim.run``/``_run_selfheal``/dispatch/deferred-readback planes),
 the multi-tenant driver (``multi_run.run``/``_worker``), and the
 cross-silo round handlers (``aggregate``, ``train``, the ``_on_*``
-message callbacks) — and flags sync sites reachable from them.
+message callbacks) — and flags sync sites reachable from them. The
+first-party kernel package ``fedml_tpu/ops/pallas/`` is in scope too,
+with EVERY module-level function an entry point: those modules hold only
+kernel bodies and the op wrappers the compiled round step calls, so a
+sync anywhere in them stalls the aggregation hot path by construction.
 
 The walk deliberately does NOT descend into phase-boundary planes, where
 readback is the point: input-building/packing (``build_round_inputs``,
@@ -51,6 +55,8 @@ HOT_ENTRIES: Dict[str, Set[str]] = {
 CROSS_SILO_PREFIX = "fedml_tpu/cross_silo/"
 CROSS_SILO_ENTRIES = {"aggregate", "add_local_trained_result", "train",
                       "broadcast_round", "await_round"}
+# first-party Pallas kernels + their op wrappers: hot by construction
+PALLAS_PREFIX = "fedml_tpu/ops/pallas/"
 
 # functions the BFS never enters: phase-boundary planes where host readback
 # or host-side packing is the point
@@ -78,11 +84,14 @@ class HostSyncChecker(Checker):
                    "round-loop entry points")
 
     def interested(self, relpath: str) -> bool:
-        return relpath in HOT_ENTRIES or relpath.startswith(CROSS_SILO_PREFIX)
+        return (relpath in HOT_ENTRIES
+                or relpath.startswith(CROSS_SILO_PREFIX)
+                or relpath.startswith(PALLAS_PREFIX))
 
     def visit_module(self, module: Module) -> Iterable[Finding]:
         entries = HOT_ENTRIES.get(module.relpath)
         is_cross_silo = module.relpath.startswith(CROSS_SILO_PREFIX)
+        is_pallas = module.relpath.startswith(PALLAS_PREFIX)
         funcs = _collect_functions(module.tree)
         by_simple: Dict[str, List] = {}
         for f in funcs:
@@ -94,6 +103,10 @@ class HostSyncChecker(Checker):
                 roots.append(f)
             elif is_cross_silo and (f.simple in CROSS_SILO_ENTRIES
                                     or f.simple.startswith("_on_")):
+                roots.append(f)
+            elif is_pallas and "." not in f.qualname:
+                # kernels AND wrappers: every top-level def in a kernel
+                # module is on the compiled round step's dispatch path
                 roots.append(f)
         if not roots:
             return []

@@ -9,8 +9,9 @@ framework's bit-exactness and replay guarantees without failing any test.
 Roots are found per module: functions decorated with ``jax.jit``/``pjit``/
 ``shard_map``/``pmap`` (directly or via ``partial(jax.jit, ...)``),
 functions passed as arguments to those wrappers (``self._step =
-jax.jit(self._step_impl)``), and bodies handed to ``lax.scan``/
-``while_loop``/``fori_loop``/``cond``/``switch``. Reachability is a
+jax.jit(self._step_impl)``), Pallas kernel bodies handed to
+``pl.pallas_call`` (directly or through ``functools.partial``), and bodies
+handed to ``lax.scan``/``while_loop``/``fori_loop``/``cond``/``switch``. Reachability is a
 same-module call-graph walk: plain-name calls and ``self.method()`` calls
 resolve to same-scope/same-class function defs (conservatively by simple
 name). Nested defs inside a reachable function are scanned as part of it —
@@ -30,8 +31,11 @@ from .core import (
     dotted_name,
 )
 
-# wrapper callables whose function argument (or decorated function) is traced
-JIT_WRAPPERS = {"jit", "pjit", "pmap", "shard_map", "xmap"}
+# wrapper callables whose function argument (or decorated function) is traced.
+# pallas_call is included: a Pallas kernel body is traced exactly like a jit
+# body (it runs once to build the kernel program — host reads/side effects
+# bake trace-time constants into every subsequent launch).
+JIT_WRAPPERS = {"jit", "pjit", "pmap", "shard_map", "xmap", "pallas_call"}
 # lax control-flow primitives whose callable arguments are traced
 TRACED_HOF = {"scan", "while_loop", "fori_loop", "cond", "switch", "associated_scan",
               "associative_scan", "map", "checkpoint", "remat", "custom_vjp",
@@ -136,6 +140,15 @@ class JitPurityChecker(Checker):
             """Mark the function a wrapper argument refers to."""
             if isinstance(expr, ast.Lambda):
                 return  # lambdas are scanned via enclosing function reachability
+            if isinstance(expr, ast.Call):
+                # functools.partial(kernel, ...) hands the kernel to the
+                # wrapper — the idiomatic way static args reach a Pallas
+                # kernel (pl.pallas_call(partial(_kernel, bits=b), ...))
+                fname = dotted_name(expr.func)
+                if fname is not None and fname.split(".")[-1] == "partial":
+                    for a in expr.args:
+                        mark_target(a, why, cls_hint)
+                return
             name = None
             if isinstance(expr, ast.Name):
                 name = expr.id

@@ -588,7 +588,8 @@ def _flatten_with_paths(tree) -> Tuple[List[str], List[Any], Any]:
     return paths, leaves, treedef
 
 
-def build_stacked_roundtrip(spec, seed: int, update_shardings=None):
+def build_stacked_roundtrip(spec, seed: int, update_shardings=None,
+                            agg_kernels: bool = False):
     """Build the simulator-side codec: a jit-safe function applying
     encode+decode per client along the leading cohort axis.
 
@@ -606,6 +607,13 @@ def build_stacked_roundtrip(spec, seed: int, update_shardings=None):
     inside a sharded jit: the top-k scatter/argsort are per-row ops, but on
     a 2-D (client×model) mesh GSPMD needs the constraint to keep the decoded
     stack and the EF carry from gathering. Numerically a no-op.
+
+    ``agg_kernels=True`` routes the q8/q4 stage through the fused Pallas
+    quantize+pack kernel (``ops.pallas.agg_quant``) — one VMEM pass per
+    leaf instead of the quantize/scale/pack round-trips. Bit-identical to
+    this module's unfused path (and therefore to the numpy wire bytes);
+    leaves outside the kernel's tiling take the jittable reference, which
+    is the same arithmetic.
     """
     cs = spec if isinstance(spec, CodecSpec) else parse_codec_spec(spec)
 
@@ -647,9 +655,16 @@ def build_stacked_roundtrip(spec, seed: int, update_shardings=None):
                 xw = x
                 vals = x
             if cs.bits is not None:
-                dec_vals = _quant_roundtrip_jnp(
-                    vals, cs.bits, seed, round_u32, cids_u32,
-                    _leaf_hash(path), jnp)
+                if agg_kernels:
+                    from ..ops.pallas import agg_quant as _aq
+
+                    _, _, dec_vals = _aq.fused_quantize_pack(
+                        vals, cs.bits, seed, round_u32, cids_u32,
+                        _leaf_hash(path))
+                else:
+                    dec_vals = _quant_roundtrip_jnp(
+                        vals, cs.bits, seed, round_u32, cids_u32,
+                        _leaf_hash(path), jnp)
             else:
                 dec_vals = vals
             if cs.topk is not None:

@@ -126,6 +126,10 @@ class FedAlgorithm:
     # aggregation requires params-shaped updates and falls back to the even
     # schedule otherwise.
     update_is_params: bool = True
+    # The RobustAggregator behind ``aggregate`` when there is one: lets the
+    # simulator see the defense config (e.g. fuse sanitize+Krum into one
+    # kernel pass under agg_kernels) without unwrapping the closure.
+    robust: Optional[Any] = None
 
 
 # --- object shells (reference API parity) -----------------------------------

@@ -206,7 +206,10 @@ def pairwise_sq_dists(stacked_updates: PyTree, valid=None,
     size (``lax.map`` over ``(C/t, t, D) @ (D, C)`` blocks): peak live
     intermediate drops from the full (C, D) x (C, D) product's workspace to
     one tile's, and under a sharded jit each device only materializes its
-    own row tiles. Must divide C; ``None`` is the original single matmul.
+    own row tiles. Any positive size works — a final partial tile is padded
+    with zero rows whose Gram outputs are sliced away (zero pad rows cannot
+    perturb the real elements' bits, and they never reach the distance
+    matrix). ``None`` is the original single matmul.
 
     ``valid`` (HOST bool (C,)) marks real rows of a padded cohort: any
     distance involving a padded row is +inf (so Krum never counts a pad row
@@ -223,10 +226,14 @@ def pairwise_sq_dists(stacked_updates: PyTree, valid=None,
         gram = jax.vmap(lambda r: flat @ r)(flat)
     else:
         t = int(tile_size)
-        if C % t != 0:
-            raise ValueError(f"tile_size={t} must divide cohort size {C}")
-        tiles = flat.reshape(C // t, t, flat.shape[1])
-        gram = jax.lax.map(lambda blk: blk @ flat.T, tiles).reshape(C, C)
+        if t <= 0:
+            raise ValueError(f"tile_size={t} must be positive")
+        cpad = -(-C // t) * t
+        fp = flat if cpad == C else jnp.concatenate(
+            [flat, jnp.zeros((cpad - C, flat.shape[1]), jnp.float32)], axis=0)
+        tiles = fp.reshape(cpad // t, t, flat.shape[1])
+        gram = jax.lax.map(
+            lambda blk: blk @ flat.T, tiles).reshape(cpad, C)[:C]
     d = jnp.maximum(sqn[:, None] + sqn[None, :] - 2.0 * gram, 0.0)
     if valid is not None:
         v = jnp.asarray(valid)
@@ -288,6 +295,109 @@ def krum_aggregate(stacked_updates: PyTree, weights: jax.Array,
         lambda x: jnp.tensordot(w.astype(x.dtype), x, axes=1), stacked_updates
     )
     return agg, selected
+
+
+def fused_sanitize_krum(stacked_updates: PyTree, weights: jax.Array,
+                        z_thresh: float = 6.0, n_byz: int = 0, m: int = 1,
+                        sample_weighted: bool = False, valid=None,
+                        out_shardings=None, use_kernel: bool = True,
+                        interpret=None):
+    """Fused ``sanitize_stacked`` + ``krum_aggregate`` over one read of the
+    cohort stack — the agg_kernels fast path for the Krum defense family.
+
+    Bit-identical to the sequential pair the simulator runs unfused
+    (``sanitize_stacked(valid=..., out_shardings=...)`` followed by
+    ``krum_aggregate`` WITHOUT ``valid`` — mirroring
+    :meth:`RobustAggregator.aggregate_with_info`'s exact call). The zeroed
+    "clean" copy of the stack is never materialized: the pairwise Gram
+    matrix is computed from the raw (nan-sanitized) stack in one Pallas
+    pass (``ops.pallas.agg_robust.fused_gram``) and the quarantine zeroing
+    is applied algebraically afterwards — zeroing a matmul operand row
+    cannot perturb any other output element's bits, so exact ``where``
+    masks on the Gram/sq-norm planes reproduce the zero-copy-then-matmul
+    distances. The cheap O(C*D) sanitize statistics stay in plain jnp with
+    ``sanitize_stacked``'s verbatim per-leaf expressions (same shapes =>
+    same reduction order => same bits; a strided-slice sum inside the
+    kernel's fused row tiles is NOT reduction-order-stable — see
+    agg_robust's module docstring). The only remaining reads of the update
+    are fused into the final weighted ``tensordot``.
+
+    Returns ``(agg, clean_weights, quarantine, z, selected)``.
+    """
+    leaves = jax.tree_util.tree_leaves(stacked_updates)
+    C = leaves[0].shape[0]
+    # --- sanitize_stacked's statistics, expression for expression on the
+    # oracle's own per-leaf (C, -1) shapes
+    bad = jnp.zeros((C,), bool)
+    sq = jnp.zeros((C,), jnp.float32)
+    for x in leaves:
+        xf = x.astype(jnp.float32).reshape(C, -1)
+        bad = bad | ~jnp.isfinite(xf).all(axis=1)
+        sq = sq + jnp.sum(jnp.square(jnp.nan_to_num(xf)), axis=1)
+    norm = jnp.sqrt(sq)
+    if valid is None:
+        med = jnp.median(norm)
+        mad = jnp.median(jnp.abs(norm - med))
+    else:
+        import numpy as _np
+
+        v_np = _np.asarray(valid, bool)
+        n_valid = int(v_np.sum())
+        med = _masked_median(norm, v_np, n_valid)
+        mad = _masked_median(jnp.abs(norm - med), v_np, n_valid)
+    scale = jnp.maximum(1.4826 * mad, 1e-6 + 0.05 * med)
+    z = jnp.where(bad, jnp.inf, (norm - med) / scale)
+    quarantine = bad | (z > z_thresh)
+    if valid is not None:
+        v = jnp.asarray(valid)
+        quarantine = quarantine & v
+        z = jnp.where(v, z, 0.0)
+    keep = 1.0 - quarantine.astype(jnp.float32)
+    clean_weights = weights * keep
+    # --- pairwise_sq_dists on the zeroed stack, algebraically: flat/sqn are
+    # its verbatim expressions on the RAW stack (bit-identical rows for
+    # non-quarantined clients); a zeroed row has sq-norm exactly +0.0 and
+    # Gram entries exactly +0.0, so masking with where (NOT multiplying —
+    # 0 * inf from an overflowed norm would differ) reproduces the unfused
+    # distance bits. Only the O(C^2*D) Gram plane runs in the kernel.
+    flat = jnp.concatenate(
+        [jnp.nan_to_num(x.astype(jnp.float32)).reshape(C, -1) for x in leaves],
+        axis=1,
+    )
+    sqn = jnp.sum(flat * flat, axis=1)
+    from ..ops.pallas import agg_robust as _ar
+
+    gram = _ar.fused_gram(flat, use_kernel=use_kernel, interpret=interpret)
+    sqn_m = jnp.where(quarantine, jnp.float32(0.0), sqn)
+    pair_q = quarantine[:, None] | quarantine[None, :]
+    gram_m = jnp.where(pair_q, jnp.float32(0.0), gram)
+    d = jnp.maximum(sqn_m[:, None] + sqn_m[None, :] - 2.0 * gram_m, 0.0)
+    # --- krum_aggregate, expression for expression (no valid= here: the
+    # simulator's unfused path never threads it into the Krum stage either)
+    scores = krum_scores(d, n_byz, n_valid=None)
+    scores = jnp.where(clean_weights > 0, scores, jnp.inf)
+    m = max(1, min(int(m), C))
+    _, idx = jax.lax.top_k(-scores, m)
+    selected = jnp.zeros((C,), jnp.float32).at[idx].set(1.0)
+    selected = selected * (clean_weights > 0)
+    w = (selected * clean_weights.astype(jnp.float32) if sample_weighted
+         else selected)
+    w = w / jnp.maximum(jnp.sum(w), 1e-12)
+
+    def _agg_leaf(x, s=None):
+        xm = jnp.where(
+            quarantine.reshape((C,) + (1,) * (x.ndim - 1)),
+            jnp.zeros_like(x), x)
+        if s is not None:
+            xm = jax.lax.with_sharding_constraint(xm, s)
+        return jnp.tensordot(w.astype(x.dtype), xm, axes=1)
+
+    if out_shardings is None:
+        agg = jax.tree_util.tree_map(_agg_leaf, stacked_updates)
+    else:
+        agg = jax.tree_util.tree_map(
+            _agg_leaf, stacked_updates, out_shardings)
+    return agg, clean_weights, quarantine, z, selected
 
 
 @dataclasses.dataclass(frozen=True)

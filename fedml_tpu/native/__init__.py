@@ -20,6 +20,46 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_HERE, "libfedml_native.so")
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
+_hash_warned = False
+
+
+def _src_hash() -> str:
+    """Truncated sha256 of fedml_native.cpp — the provenance token the
+    Makefile bakes into the binary (see fedml_native_src_hash)."""
+    import hashlib
+
+    with open(os.path.join(_HERE, "fedml_native.cpp"), "rb") as f:
+        return hashlib.sha256(f.read()).hexdigest()[:16]
+
+
+def _hash_ok(lib: ctypes.CDLL) -> bool:
+    """Compare the binary's embedded source hash against the on-disk source.
+
+    The mtime guard (:func:`_fresh`) misses staleness when timestamps lie —
+    fresh checkouts, copied build trees, prebuilt artifacts — so the loaded
+    binary itself is the authority: on mismatch (or a pre-hash binary) warn
+    once and refuse the .so, engaging the numpy fallback.
+    """
+    global _hash_warned
+    embedded = None
+    try:
+        fn = lib.fedml_native_src_hash
+        fn.restype = ctypes.c_char_p
+        raw = fn()
+        if raw:
+            embedded = raw.decode("ascii", "replace").split("=", 1)[-1]
+    except AttributeError:
+        pass  # binary predates the hash scheme: stale by definition
+    expect = _src_hash()
+    if embedded == expect:
+        return True
+    if not _hash_warned:
+        _hash_warned = True
+        logging.warning(
+            "libfedml_native.so was built from different sources (embedded "
+            "hash %s, source %s); numpy fallback engaged — rebuild with "
+            "`make -C fedml_tpu/native`", embedded, expect)
+    return False
 
 
 def _fresh() -> bool:
@@ -84,6 +124,8 @@ def get_lib() -> Optional[ctypes.CDLL]:
         # no-toolchain with a prebuilt .so present: best available option
     try:
         lib = ctypes.CDLL(_SO)
+        if not _hash_ok(lib):
+            return None  # stale binary: numpy fallback (warned once above)
         lib.pack_cohort_f32.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,

@@ -182,4 +182,19 @@ void unpack_i4(const uint8_t* packed, int64_t n, int8_t* dst) {
     }
 }
 
+// ---------------------------------------------------------------------------
+// 4. build provenance: the Makefile bakes a truncated sha256 of this file
+//    into the binary so the loader (and `make check`) can detect a stale .so
+//    even when filesystem mtimes lie (fresh checkouts, copied build trees).
+//    The "FEDML_SRC_HASH=" prefix makes the hash greppable from the binary.
+// ---------------------------------------------------------------------------
+
+#ifndef FEDML_NATIVE_SRC_HASH
+#define FEDML_NATIVE_SRC_HASH "unknown"
+#endif
+
+const char* fedml_native_src_hash(void) {
+    return "FEDML_SRC_HASH=" FEDML_NATIVE_SRC_HASH;
+}
+
 }  // extern "C"

@@ -1,0 +1,261 @@
+"""Pallas fused stochastic-quantize + wire-pack for the codec hot path.
+
+The compressed update plane's q8/q4 stage (comm/codec.py) is three passes
+over every compressible leaf: hash-derived uniforms + per-256-chunk pow2
+scales + clip/floor (``stochastic_quantize``), a dequantize multiply, and a
+separate nibble/byte pack for the wire. All of it is memory-bound
+elementwise work on a (C, m) cohort stack — prime fusion territory. This
+kernel does the whole stage in ONE pass per (row-block, column-block) tile:
+counter-hash uniforms (lowbias32, the exact mixing chain of
+``codec._mix32_arr``), chunk absmax -> pow2 scale, stochastic floor,
+int8/int4 byte emission, and the decode-side multiply, all while the tile
+sits in VMEM. The grid is (C/block_c, mpad/block_m) with every tile
+independent (chunk scales never cross a 256 boundary, and block_m is a
+multiple of 256), so Mosaic pipelines tiles back-to-back with no carried
+scratch.
+
+Bit-exactness is the load-bearing invariant: pow2 scales make every op in
+the pipeline exact arithmetic except the single ``floor(v/s + u)``, so the
+packed bytes must equal the numpy wire path (``UpdateCodec._encode_leaf``)
+byte-for-byte and the decoded stack must equal the unfused XLA path
+(``codec._quant_roundtrip_jnp``) bit-for-bit. The kernel computes the
+frexp/ldexp scale with pure uint32 exponent arithmetic, matching XLA's
+frexp semantics (subnormal absmax -> flushed scale, inf -> 2^-eb, nan/zero
+-> 1.0); chunks whose absmax is subnormal are outside the numpy parity
+contract (numpy keeps subnormal scales where XLA flushes — a pre-existing
+property of the unfused path, pinned by tests).
+
+On non-TPU backends the default dispatch is the jittable jnp reference
+(same arithmetic, no Pallas) — interpret mode (``interpret=True``) exists
+for the parity suite, which pins kernel == reference bit equality on CPU.
+Shapes outside the kernel's tiling take the reference on every backend.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+# Mirrors codec._QCHUNK / codec._EB — the codec asserts the values agree at
+# wiring time so the two modules cannot drift silently.
+QCHUNK = 256
+_EB = {8: 6, 4: 2}
+_BOUND = {8: 127, 4: 7}
+
+# Row-block of 8 (f32 sublane) and a column block of up to 16 chunks keeps
+# the per-tile working set (values + uniforms + levels + bytes + decode)
+# around 100 KB — far inside VMEM even with double buffering.
+_BLOCK_C = 8
+_MAX_BLOCK_CHUNKS = 16
+
+# One kernel instance's VMEM working set must stay well under the ~16 MB
+# budget; 2 MB of f32 per tile is conservative given Mosaic double-buffers.
+_VMEM_TILE_BUDGET = 2 * 1024 * 1024
+# interpret mode (non-TPU) unrolls every grid step into the jaxpr — fine
+# for parity-test shapes, catastrophic at cohort scale (10k rows = 1250
+# row blocks). Past this many steps the interpret path takes the jnp
+# reference; kernel/reference bit parity makes the switch invisible.
+_INTERPRET_GRID_CAP = 4096
+
+
+def _mix32(x):
+    """lowbias32 finalizer on uint32 arrays — the exact constants of
+    ``codec._mix32_arr`` (asserted equal at import of the codec wiring)."""
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x7FEB352D)
+    x = x ^ (x >> jnp.uint32(15))
+    x = x * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> jnp.uint32(16))
+    return x
+
+
+def _uniform_from_idx(idx_u32, base_u32):
+    """Hash (element index XOR row key) -> f32 uniform in [0, 1)."""
+    h = _mix32(idx_u32 ^ base_u32)
+    return (h >> jnp.uint32(8)).astype(jnp.float32) * jnp.float32(1.0 / (1 << 24))
+
+
+def _pow2_scale_bits(amax, eb: int):
+    """Per-chunk power-of-two scale 2^(frexp_exp(amax) - eb) via uint32
+    exponent arithmetic — bit-identical to XLA's frexp/ldexp pair
+    (``codec._pow2_scales`` under jnp) without relying on Mosaic support
+    for those ops: subnormal absmax takes XLA's frexp exponent of -149 (so
+    the ldexp result flushes to 0), inf maps to exponent 0, and zero/nan
+    absmax yield scale 1.0."""
+    bits = jax.lax.bitcast_convert_type(amax, jnp.uint32)
+    be = (bits >> jnp.uint32(23)).astype(jnp.int32)  # biased exp; sign is 0
+    ea = jnp.where(be == 255, 0, jnp.where(be == 0, -149, be - 126))
+    e2 = ea - eb
+    s_norm = jax.lax.bitcast_convert_type(
+        ((e2 + 127) << 23).astype(jnp.uint32), jnp.float32)
+    s = jnp.where(e2 >= -126, s_norm, jnp.float32(0.0))
+    return jnp.where(amax > 0, s, jnp.float32(1.0))
+
+
+def _quant_tile(v, key_col, col0, bits: int):
+    """Shared per-tile arithmetic: (block_c, block_m) f32 values + (block_c,
+    1) uint32 row keys -> (levels f32 in [-bound, bound], scales (block_c,
+    nchunk))."""
+    bc, bm = v.shape
+    nchunk = bm // QCHUNK
+    idx = col0 + jax.lax.broadcasted_iota(jnp.uint32, (bc, bm), 1)
+    u = _uniform_from_idx(idx, key_col)
+    blk = v.reshape(bc, nchunk, QCHUNK)
+    amax = jnp.max(jnp.abs(blk), axis=-1)
+    s = _pow2_scale_bits(amax, _EB[bits])
+    bound = jnp.float32(_BOUND[bits])
+    q = jnp.clip(jnp.floor(blk / s[..., None] + u.reshape(bc, nchunk, QCHUNK)),
+                 -bound, bound)
+    return q.reshape(bc, bm), s
+
+
+def _pack_nibbles(q_i32):
+    """int32 levels in [-7, 7] -> two-per-byte uint8 (bias +8, first element
+    high nibble) — the byte layout of native ``pack_i4``."""
+    bc, bm = q_i32.shape
+    b = (q_i32 + 8).reshape(bc, bm // 2, 2)
+    return ((b[:, :, 0] << 4) | b[:, :, 1]).astype(jnp.uint8)
+
+
+def _quantize_pack_kernel(v_ref, h_ref, packed_ref, s_ref, dec_ref, *,
+                          bits: int, block_m: int):
+    """Grid (C/block_c, mpad/block_m). Refs: v (block_c, block_m) f32,
+    h (block_c, 1) uint32 row keys; outputs packed (block_c, block_m [q8
+    int8] or block_m/2 [q4 uint8]), s (block_c, block_m/QCHUNK) f32,
+    dec (block_c, block_m) f32. Tiles are independent: uniforms come from
+    the global element index (col0 offset), scales never cross a chunk
+    boundary, so there is no carried state and no init/finalize step."""
+    col0 = jnp.uint32(pl.program_id(1) * block_m)
+    q, s = _quant_tile(v_ref[...], h_ref[...], col0, bits)
+    s_ref[...] = s
+    # wire path stores int8 and multiplies back in f32; same values here
+    qi = q.astype(jnp.int8)
+    dec_ref[...] = qi.astype(jnp.float32) * jnp.repeat(s, QCHUNK, axis=1)
+    if bits == 8:
+        packed_ref[...] = qi
+    else:
+        packed_ref[...] = _pack_nibbles(q.astype(jnp.int32))
+
+
+def _pad_cols(m: int, block_m: int) -> int:
+    return -(-m // block_m) * block_m
+
+
+def _block_m_for(mpad: int) -> int:
+    return QCHUNK * min(mpad // QCHUNK, _MAX_BLOCK_CHUNKS)
+
+
+def quant_shapes_ok(C: int, m: int) -> bool:
+    """True when the fused kernel's tiling handles (C, m): at least one
+    quant chunk of payload and a per-tile working set inside the VMEM
+    budget (~6 f32 planes of block_c x block_m)."""
+    if C < 1 or m < 1:
+        return False
+    block_m = _block_m_for(_pad_cols(m, QCHUNK))
+    return 6 * 4 * _BLOCK_C * block_m <= _VMEM_TILE_BUDGET
+
+
+def row_keys(seed: int, round_u32, cids_u32, leaf_hash: int):
+    """Per-row base keys: the ``codec.stochastic_key`` mixing chain with the
+    traced round/client ids entering as uint32 arrays (identical to the
+    unfused ``codec._quant_roundtrip_jnp`` preamble)."""
+    h = jnp.uint32((int(seed) ^ 0x9E3779B9) & 0xFFFFFFFF)
+    h = _mix32(h ^ jnp.asarray(round_u32).astype(jnp.uint32))
+    h = _mix32(h ^ jnp.asarray(cids_u32).astype(jnp.uint32))
+    h = _mix32(h ^ jnp.uint32(leaf_hash))
+    return h
+
+
+def fused_quantize_pack(vals, bits: int, seed: int, round_u32, cids_u32,
+                        leaf_hash: int = 0, *,
+                        interpret: Optional[bool] = None,
+                        use_kernel: bool = True,
+                        ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One-pass stochastic quantize + wire pack + decode over a cohort stack.
+
+    ``vals`` is the (C, m) f32 value stack (one row per client),
+    ``round_u32``/``cids_u32`` the traced round scalar and (C,) client-id
+    vector. Returns ``(packed, scales, dec)``:
+
+    - ``packed`` — the wire bytes, per row: (C, m) int8 for q8, or
+      (C, ceil(m/2)) uint8 nibble-packed for q4. Row ``i`` equals the numpy
+      wire path's ``rec["q"]`` for client ``cids[i]`` byte-for-byte
+      (``pack_i4``'s odd-tail pad nibble falls out for free: a padded zero
+      element stochastically floors to level 0 = biased nibble 8).
+    - ``scales`` — (C, ceil(m/256)) f32 per-chunk pow2 scales
+      (== ``rec["s"]``).
+    - ``dec`` — (C, m) f32 decoded values, bit-identical to the unfused
+      ``codec._quant_roundtrip_jnp``.
+
+    ``use_kernel=False`` (or shapes outside :func:`quant_shapes_ok`) takes
+    the jittable jnp reference — same arithmetic, no Pallas.
+    """
+    vals = jnp.asarray(vals, jnp.float32)
+    C, m = vals.shape
+    h = row_keys(seed, round_u32, cids_u32, leaf_hash)
+    if not (use_kernel and quant_shapes_ok(C, m)):
+        return _reference_quantize_pack(vals, bits, h)
+    if interpret is None:
+        # Non-TPU production dispatch takes the bit-identical jnp reference:
+        # interpret mode emulates the kernel step by step and is far slower
+        # than plain XLA. The parity suite opts in with interpret=True.
+        if jax.default_backend() != "tpu":
+            return _reference_quantize_pack(vals, bits, h)
+        interpret = False
+
+    mpad = _pad_cols(m, QCHUNK)
+    block_m = _block_m_for(mpad)
+    mpad2 = _pad_cols(mpad, block_m)
+    cpad = _pad_cols(C, _BLOCK_C)
+    grid = (cpad // _BLOCK_C, mpad2 // block_m)
+    if interpret and grid[0] * grid[1] > _INTERPRET_GRID_CAP:
+        return _reference_quantize_pack(vals, bits, h)
+    vp = jnp.zeros((cpad, mpad2), jnp.float32).at[:C, :m].set(vals)
+    hp = jnp.zeros((cpad, 1), jnp.uint32).at[:C, 0].set(h)
+    packed_dt = jnp.int8 if bits == 8 else jnp.uint8
+    packed_bm = block_m if bits == 8 else block_m // 2
+    packed_cols = mpad2 if bits == 8 else mpad2 // 2
+    nchunk_blk = block_m // QCHUNK
+    packed, scales, dec = pl.pallas_call(
+        functools.partial(_quantize_pack_kernel, bits=bits, block_m=block_m),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_BLOCK_C, block_m), lambda i, j: (i, j)),
+            pl.BlockSpec((_BLOCK_C, 1), lambda i, j: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((_BLOCK_C, packed_bm), lambda i, j: (i, j)),
+            pl.BlockSpec((_BLOCK_C, nchunk_blk), lambda i, j: (i, j)),
+            pl.BlockSpec((_BLOCK_C, block_m), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((cpad, packed_cols), packed_dt),
+            jax.ShapeDtypeStruct((cpad, mpad2 // QCHUNK), jnp.float32),
+            jax.ShapeDtypeStruct((cpad, mpad2), jnp.float32),
+        ],
+        interpret=interpret,
+    )(vp, hp)
+    nbytes = m if bits == 8 else (m + 1) // 2
+    return (packed[:C, :nbytes], scales[:C, :mpad // QCHUNK], dec[:C, :m])
+
+
+def _reference_quantize_pack(vals, bits: int, h):
+    """Jittable jnp reference: identical arithmetic to the kernel (and to
+    ``codec._quant_roundtrip_jnp`` on the decode side), one expression per
+    stage instead of one VMEM pass."""
+    C, m = vals.shape
+    mpad = _pad_cols(m, QCHUNK)
+    vp = jnp.zeros((C, mpad), jnp.float32).at[:, :m].set(vals)
+    q, s = _quant_tile(vp, h[:, None], jnp.uint32(0), bits)
+    qi = q.astype(jnp.int8)
+    dec = (qi.astype(jnp.float32)
+           * jnp.repeat(s, QCHUNK, axis=1))[:, :m]
+    if bits == 8:
+        return qi[:, :m], s, dec
+    packed = _pack_nibbles(q.astype(jnp.int32))
+    return packed[:, :(m + 1) // 2], s, dec

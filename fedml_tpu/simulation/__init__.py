@@ -107,6 +107,7 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
             getattr(args, "local_test_on_all_clients", False)),
         prefetch=bool(getattr(args, "prefetch", True)),
         prefetch_depth=int(getattr(args, "prefetch_depth", 2)),
+        agg_kernels=bool(getattr(args, "agg_kernels", False)),
         sanitize_updates=bool(getattr(args, "sanitize_updates", False)),
         sanitize_z_thresh=float(getattr(args, "sanitize_z_thresh", 6.0)),
         watchdog_factor=float(getattr(args, "watchdog_factor", 0.0) or 0.0),

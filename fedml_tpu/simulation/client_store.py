@@ -64,7 +64,9 @@ class ClientStateArena:
 
     All device traffic is batched: a cohort gather is one jitted ``take``
     (plus at most one ``take`` + one ``scatter`` to evict/load around it),
-    a cohort scatter is one jitted ``at[slots].set``.
+    a cohort scatter is one jitted ``at[slots].set``. :meth:`put_take`
+    fuses round r's scatter with round r+1's gather into one dispatch for
+    the simulator's double-buffered state movement.
     """
 
     def __init__(self, proto: PyTree, capacity: int, *,
@@ -133,10 +135,21 @@ class ClientStateArena:
         def _put(arena_leaves, slots, rows):
             return [l.at[slots].set(r) for l, r in zip(arena_leaves, rows)]
 
+        def _put_take(arena_leaves, put_slots, rows, take_slots):
+            # scatter-then-gather in ONE program: the gather reads the
+            # post-scatter leaves, so a client in both cohorts comes back
+            # with its fresh row — no separate overlap patch needed
+            new_leaves = [l.at[put_slots].set(r)
+                          for l, r in zip(arena_leaves, rows)]
+            return new_leaves, [l[take_slots] for l in new_leaves]
+
         # out_shardings pins cohort stacks / arena leaves to the client
         # axis; donation lets XLA update the arena in place on scatter
         self._take_fn = jax.jit(_take, out_shardings=row_sh)
         self._put_fn = jax.jit(_put, donate_argnums=(0,), out_shardings=row_sh)
+        self._put_take_fn = jax.jit(
+            _put_take, donate_argnums=(0,),
+            out_shardings=None if row_sh is None else (row_sh, row_sh))
 
     # ------------------------------------------------------------- public
 
@@ -168,6 +181,50 @@ class ClientStateArena:
             self._put_fn(self._leaves, jnp.asarray(slots, jnp.int32), rows))
         self._clock += 1
         self._last_used[slots] = self._clock
+
+    def put_take(self, put_ids: Sequence[int], stacked: PyTree,
+                 take_ids: Sequence[int]) -> Optional[PyTree]:
+        """Fused ``scatter(put_ids, stacked)`` + ``gather(take_ids)`` as ONE
+        jitted dispatch whose gather reads the post-scatter leaves.
+
+        This is the double-buffering primitive: dispatched right after round
+        r's step (with ``stacked`` still an in-flight device future), it
+        writes round r's state back AND pre-gathers round r+1's cohort while
+        the device is busy, so neither transfer sits on the host critical
+        path between rounds. Overlapping clients (in both cohorts) read
+        their fresh rows by construction.
+
+        Returns the stacked take tree, or ``None`` — with the arena left
+        completely untouched — when ``take_ids`` cannot be made resident
+        without evicting a ``put_ids`` client (whose device row is still
+        pre-scatter, so spilling it would persist stale state). Callers
+        fall back to the separate scatter-now / gather-later path.
+        """
+        pids = np.asarray(put_ids, dtype=np.int64)
+        if len(np.unique(pids)) != len(pids):
+            raise ValueError("put_take put ids must be unique (slice padding "
+                             "duplicates off before scattering)")
+        rows, treedef = jax.tree_util.tree_flatten(stacked)
+        if treedef != self._treedef:
+            raise ValueError(
+                f"put_take structure {treedef} != arena proto {self._treedef}")
+        try:
+            put_slots = np.asarray(
+                [self._slot_of[int(c)] for c in pids], np.int64)
+        except KeyError as e:
+            raise KeyError(f"put_take of non-resident client {e}; gather the "
+                           "cohort before scattering it") from e
+        tids = np.asarray(take_ids, dtype=np.int64)
+        take_slots = self._ensure(tids, protect=frozenset(int(c) for c in pids))
+        if take_slots is None:
+            return None
+        new_leaves, out = self._put_take_fn(
+            self._leaves, jnp.asarray(put_slots, jnp.int32), rows,
+            jnp.asarray(take_slots, jnp.int32))
+        self._leaves = list(new_leaves)
+        self._clock += 1
+        self._last_used[put_slots] = self._clock
+        return jax.tree_util.tree_unflatten(self._treedef, out)
 
     def state_of(self, client_id: int) -> PyTree:
         """One client's current state as host numpy (test/debug helper —
@@ -282,8 +339,15 @@ class ClientStateArena:
             return jax.device_put(arr, self._row_sh[leaf_idx])
         return jnp.asarray(arr)
 
-    def _ensure(self, ids: np.ndarray) -> np.ndarray:
-        """Make every id resident; return their slots (aligned to ids)."""
+    def _ensure(self, ids: np.ndarray,
+                protect: Optional[frozenset] = None) -> Optional[np.ndarray]:
+        """Make every id resident; return their slots (aligned to ids).
+
+        ``protect`` names client ids whose slots must not be evicted (their
+        device rows have a scatter still in flight — spilling now would
+        persist pre-scatter state). If residency would require evicting a
+        protected id, return ``None`` without touching any arena state.
+        """
         uniq, first = np.unique(ids, return_index=True)
         uniq = uniq[np.argsort(first)]
         if len(uniq) > self.capacity:
@@ -296,8 +360,12 @@ class ClientStateArena:
             need = len(missing) - len(free)
             if need > 0:
                 in_cohort = {int(c) for c in uniq}
+                if protect:
+                    in_cohort = in_cohort | set(protect)
                 cand = [int(s) for s in np.nonzero(self._slot_client >= 0)[0]
                         if int(self._slot_client[s]) not in in_cohort]
+                if protect is not None and len(cand) < need:
+                    return None  # nothing mutated yet — caller falls back
                 cand.sort(key=lambda s: (self._last_used[s], s))
                 self._evict(np.asarray(cand[:need], np.int64))
                 free = np.nonzero(self._slot_client < 0)[0]

@@ -137,6 +137,14 @@ class SimConfig:
     # host tensors).
     prefetch: bool = True
     prefetch_depth: int = 2
+    # fused aggregation hot path (ops/pallas): the q8/q4 codec stage runs
+    # as one fused quantize+pack kernel pass per leaf, and a Krum-family
+    # defense with the sanitizer on collapses sanitize + pairwise distances
+    # + selection into one read of the stacked update
+    # (core.robust.fused_sanitize_krum). Bit-identical to the unfused
+    # paths — round history, codec bytes, and quarantine/z telemetry are
+    # unchanged; off (default) preserves the exact unfused programs.
+    agg_kernels: bool = False
     # per-client local-test evaluation at eval rounds (reference
     # ``_local_test_on_all_clients``, fedavg_api.py:188-246): every client's
     # local train AND local test split is evaluated under the current global
@@ -301,6 +309,10 @@ class FedSimulator:
         # round_dispatch spans from the round loop
         self._profiler = profiler
         self._prefetcher = None  # live only inside run()
+        # double-buffered arena movement: (round_idx, gather-ids key, stack)
+        # produced by put_take under the previous round's device shadow
+        self._pregathered_state = None
+        self._pregathered_codec = None
         # packed schedule: round-independent lane structure per (cohort,
         # drop) pattern — full-participation runs hit every round
         self._lane_plan_cache: Dict[Any, Dict[str, Any]] = {}
@@ -437,7 +449,8 @@ class FedSimulator:
             self._codec_rt = wire_codec.build_stacked_roundtrip(
                 self._codec_spec, cfg.seed,
                 # 2-D mesh: decoded updates + EF carry stay cohort×model
-                update_shardings=self._update_sh)
+                update_shardings=self._update_sh,
+                agg_kernels=bool(cfg.agg_kernels))
             self._codec_record = wire_codec.record_codec
             self._codec_wire = wire_codec.spec_wire_nbytes(
                 self._codec_spec, init_variables)
@@ -575,6 +588,14 @@ class FedSimulator:
         # invisible to the sanitizer's median/MAD (a zero-update row is a
         # perfectly plausible inlier that would drag the statistics)
         valid_np = (np.arange(c_real + pad) < c_real) if pad else None
+        # agg_kernels + sanitizer + a Krum-family defense (whose aggregator
+        # does not run its own second sanitize): collapse the
+        # sanitize->Krum pair into core.robust.fused_sanitize_krum
+        fuse_robust = bool(
+            self.cfg.agg_kernels and detect
+            and getattr(alg, "robust", None) is not None
+            and alg.robust.defense_type in type(alg.robust).KRUM_FAMILY
+            and not alg.robust.sanitize)
 
         def _probe(tag, tree):
             if self._sharding_probe is not None:
@@ -686,7 +707,22 @@ class FedSimulator:
             if transform is not None:
                 update = transform(update, w)
             qz = None
-            if detect:
+            if detect and fuse_robust:
+                # agg_kernels fast path: sanitize + Krum distances +
+                # selection in one read of the stacked update
+                # (core.robust.fused_sanitize_krum mirrors the
+                # sanitize_stacked -> aggregate pair below bit for bit)
+                from ..core.robust import fused_sanitize_krum
+
+                ra = alg.robust
+                f_byz, m_krum = ra._krum_fm(c_real + pad)
+                agg, w, quar, z, _sel = fused_sanitize_krum(
+                    update, w, z_thresh=z_thresh, n_byz=f_byz, m=m_krum,
+                    sample_weighted=ra.defense_type == "krum_fedavg",
+                    valid=valid_np, out_shardings=upd_sh)
+                qz = jnp.stack([quar.astype(jnp.float32),
+                                jnp.nan_to_num(z, posinf=1e30)])
+            elif detect:
                 from ..core.robust import sanitize_stacked
 
                 update, w, quar, z = sanitize_stacked(
@@ -696,17 +732,20 @@ class FedSimulator:
                 # with the metrics — a single extra host transfer per round
                 qz = jnp.stack([quar.astype(jnp.float32),
                                 jnp.nan_to_num(z, posinf=1e30)])
-            if mdl and (codec_rt is not None or transform is not None):
-                # codec/attack stages are elementwise over rows but carry
-                # no layout promise — re-pin before the reduction
-                update = _pin(update, upd_sh)
-            _probe("update", update)
-            if alg.aggregate is not None:
-                agg = alg.aggregate(update, w)
+            if detect and fuse_robust:
+                pass  # aggregate already folded into the fused pass
             else:
-                from ..core.algframe import weighted_mean
+                if mdl and (codec_rt is not None or transform is not None):
+                    # codec/attack stages are elementwise over rows but carry
+                    # no layout promise — re-pin before the reduction
+                    update = _pin(update, upd_sh)
+                _probe("update", update)
+                if alg.aggregate is not None:
+                    agg = alg.aggregate(update, w)
+                else:
+                    from ..core.algframe import weighted_mean
 
-                agg = weighted_mean(update, w)
+                    agg = weighted_mean(update, w)
             if mdl:
                 # the client-axis reduction leaves each aggregate leaf on
                 # its model layout — pin it so the optimizer apply below
@@ -1051,6 +1090,33 @@ class FedSimulator:
         for i, c in enumerate(client_ids):
             self.client_states[int(c)] = jax.tree.map(lambda x: x[i], stacked_states)
 
+    def _take_pregathered(self, attr: str, round_idx: int, key: bytes):
+        """Consume a pregathered (double-buffered) arena stack if it matches
+        this round's gather ids; any non-match is dropped so a stale stack
+        can never be fed to the wrong cohort."""
+        pg = getattr(self, attr)
+        setattr(self, attr, None)
+        if pg is not None and pg[0] == round_idx and pg[1] == key:
+            return pg[2]
+        return None
+
+    def _try_move(self, arena, attr: str, next_inputs, ids: np.ndarray,
+                  new_rows) -> bool:
+        """Dispatch this round's scatter fused with round r+1's gather
+        (``ClientStateArena.put_take``) while the round step is still in
+        flight. False (arena untouched) when the next cohort cannot be made
+        resident without evicting a row whose scatter is pending — the
+        caller then scatters now and round r+1 gathers normally."""
+        nids = next_inputs.client_ids
+        npad = self._cohort_pad
+        g = nids if not npad else np.concatenate(
+            [nids, np.repeat(nids[-1], npad)])
+        stacked = arena.put_take(ids, new_rows, g)
+        if stacked is None:
+            return False
+        setattr(self, attr, (next_inputs.round_idx, g.tobytes(), stacked))
+        return True
+
     def _gather_states(self, client_ids: np.ndarray) -> PyTree:
         """Stacked, prepared cohort states. Arena backend: one jitted take
         (+ the vectorized prepare); dict backend: the legacy per-client
@@ -1146,6 +1212,8 @@ class FedSimulator:
                     log_fn, timing,
                 )
         finally:
+            # pregathered stacks are only valid within one prefetched run
+            self._pregathered_state = self._pregathered_codec = None
             if self._prefetcher is not None:
                 self._prefetcher.close()
                 self._prefetcher = None
@@ -1587,9 +1655,20 @@ class FedSimulator:
         # keeps its extra update rows inert); only real rows scatter back
         gather_ids = ids if not pad else np.concatenate(
             [ids, np.repeat(ids[-1], pad)])
+        gkey = gather_ids.tobytes()
         if stateful:
             t = time.perf_counter()
-            states = self._gather_states(gather_ids)
+            # a matching pregathered stack (dispatched under the PREVIOUS
+            # round's device shadow via put_take) makes this a tree
+            # unflatten + the prepare dispatch; prepare must run at consume
+            # time because it reads the previous round's server_state OUTPUT
+            states = self._take_pregathered(
+                "_pregathered_state", inputs.round_idx, gkey)
+            if states is not None:
+                if self._prepare_fn is not None:
+                    states = self._prepare_fn(self.server_state, states)
+            else:
+                states = self._gather_states(gather_ids)
             self._phase_acc.append(("state_gather", time.perf_counter() - t))
         else:
             states = ()
@@ -1598,8 +1677,12 @@ class FedSimulator:
             # EF residuals ride the same padded-gather pattern as client
             # state; the id vector keys each row's stochastic-rounding stream
             t = time.perf_counter()
-            codec_res = (self._codec_arena.gather(gather_ids)
-                         if self._codec_arena is not None else ())
+            codec_res = ()
+            if self._codec_arena is not None:
+                codec_res = self._take_pregathered(
+                    "_pregathered_codec", inputs.round_idx, gkey)
+                if codec_res is None:
+                    codec_res = self._codec_arena.gather(gather_ids)
             step_args += (codec_res,
                           jnp.asarray(gather_ids.astype(np.uint32)),
                           jnp.uint32(inputs.round_idx))
@@ -1607,6 +1690,13 @@ class FedSimulator:
         if self._use_device_data:
             step_args += (self._x_dev, self._y_dev)
         out = self._round_step(*step_args)
+        # peek (non-blocking) at round r+1's prefetched inputs NOW, with the
+        # step freshly dispatched: a hit lets the arena scatter+next-gather
+        # pair ride the device shadow as one fused put_take dispatch
+        nxt = (self._prefetcher.peek(inputs.round_idx + 1)
+               if self._prefetcher is not None else None)
+        if nxt is not None and nxt.kind != "even":
+            nxt = None
         if self._codec_arena is not None:
             *out, new_codec_res = out
         if self._detect:
@@ -1620,15 +1710,30 @@ class FedSimulator:
             t = time.perf_counter()
             if pad:
                 new_states = jax.tree.map(lambda x: x[: len(ids)], new_states)
-            self._scatter_states(ids, new_states)
-            self._phase_acc.append(("state_scatter", time.perf_counter() - t))
+            if (nxt is not None and self._arena is not None
+                    and self._try_move(self._arena, "_pregathered_state",
+                                       nxt, ids, new_states)):
+                # the scatter AND round r+1's gather just dispatched under
+                # the in-flight step — stamped as their own phase so
+                # state_gather/state_scatter honestly show only what is
+                # left on the between-rounds critical path
+                self._phase_acc.append(
+                    ("state_move", time.perf_counter() - t))
+            else:
+                self._scatter_states(ids, new_states)
+                self._phase_acc.append(
+                    ("state_scatter", time.perf_counter() - t))
         if self._codec_rt is not None:
             t = time.perf_counter()
             if self._codec_arena is not None:
                 if pad:
                     new_codec_res = jax.tree.map(
                         lambda x: x[: len(ids)], new_codec_res)
-                self._codec_arena.scatter(ids, new_codec_res)
+                if not (nxt is not None
+                        and self._try_move(self._codec_arena,
+                                           "_pregathered_codec",
+                                           nxt, ids, new_codec_res)):
+                    self._codec_arena.scatter(ids, new_codec_res)
             dt = time.perf_counter() - t
             self._phase_acc.append(("codec", dt))
             raw, coded = self._codec_wire

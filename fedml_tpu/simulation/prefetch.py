@@ -123,6 +123,28 @@ class RoundPrefetcher:
                 f"prefetch out of order: expected round {round_idx}, got {r}")
         return item
 
+    def peek(self, round_idx: int):
+        """Non-blocking look at the next round's inputs without consuming
+        them: the item for ``round_idx`` if the worker has already built it,
+        else ``None``. Never raises — a queued worker exception is left in
+        place for ``get`` to surface on the proper round.
+
+        The round loop uses this to start moving round r+1's arena state
+        while round r's device step is still in flight (double-buffered
+        gather/scatter); ``get(round_idx)`` still pops the item normally.
+        Only the consumer thread pops, so a peeked item cannot be stolen
+        between ``peek`` and the matching ``get``.
+        """
+        if self._closed:
+            return None
+        with self._q.mutex:
+            if not self._q.queue:
+                return None
+            r, item, exc = self._q.queue[0]
+        if exc is not None or r != round_idx:
+            return None
+        return item
+
     def pause(self) -> None:
         """Block until the worker is outside the build function and keep it
         there until ``resume`` — the eval/checkpoint sync point."""

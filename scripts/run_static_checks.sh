@@ -13,9 +13,13 @@
 #      (optionally with "--format sarif" for PR annotation).
 #   2. gen_config_reference --check — fails if docs/config_reference.md
 #      is stale relative to the config keys the code actually reads.
+#   3. make -C fedml_tpu/native check — rebuilds libfedml_native.so if
+#      mtime-stale, then verifies the source hash baked into the binary
+#      matches fedml_native.cpp (skipped when no toolchain; the runtime
+#      falls back to numpy there anyway).
 #
-# Both checks are pure-AST and run in seconds on CPU; no JAX devices,
-# network, or model downloads are involved.
+# The checks are pure-AST / host-compile and run in seconds on CPU; no JAX
+# devices, network, or model downloads are involved.
 set -u
 
 REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
@@ -29,6 +33,15 @@ echo "== graftcheck (fedml_tpu static-analysis suite) =="
 
 echo "== config reference freshness =="
 "$PY" scripts/gen_config_reference.py --check || rc=1
+
+echo "== native library source hash =="
+if command -v make >/dev/null 2>&1 && command -v "${CXX:-g++}" >/dev/null 2>&1; then
+    make -s -C fedml_tpu/native check || rc=1
+else
+    # no toolchain: the runtime warns once and uses the numpy fallback, so
+    # a stale .so cannot silently serve wrong code — skip rather than fail
+    echo "(skipped: native toolchain unavailable)"
+fi
 
 if [ "$rc" -ne 0 ]; then
     echo "static checks FAILED (see above)" >&2

@@ -1,0 +1,37 @@
+"""Bad fixture: a Pallas aggregation kernel module doing host work.
+
+jit-purity must flag the kernel body — it is traced by ``pl.pallas_call``
+exactly like a jit body (handed over through ``functools.partial``, the
+idiomatic static-arg route), so host clocks/RNG/print bake trace-time
+constants into every launch and ``.item()`` forces a sync mid-trace.
+
+host-sync must flag the op wrapper when this module masquerades as
+``fedml_tpu/ops/pallas/`` (every top-level def in a kernel module is an
+entry point there): the explicit sync and the device->host copy stall
+the aggregation hot path on every call.
+"""
+import functools
+import time
+
+import jax
+import numpy as np
+from jax.experimental import pallas as pl
+
+
+def _agg_kernel(x_ref, o_ref, *, block):
+    tile = x_ref[...]
+    print("tile", tile)              # trace-time host I/O
+    t = time.time()                  # host clock -> trace-time constant
+    noise = np.random.rand(block)    # host RNG draw, constant-folded
+    scale = tile.mean().item()       # host sync inside traced code
+    o_ref[...] = tile * scale + noise + t
+
+
+def fused_agg(x):
+    out = pl.pallas_call(
+        functools.partial(_agg_kernel, block=8),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+    out.block_until_ready()          # serializes the op pipeline
+    host = np.asarray(out)           # device->host copy per call
+    return host

@@ -1,0 +1,20 @@
+"""Clean twin of agg_pallas_bad.py: same pallas_call structure, pure
+kernel body, no syncs in the op wrapper — both checkers must stay silent
+even with the module scoped as ``fedml_tpu/ops/pallas/``."""
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _agg_kernel(x_ref, o_ref, *, block):
+    tile = x_ref[...]
+    o_ref[...] = tile * jnp.float32(block)
+
+
+def fused_agg(x):
+    return pl.pallas_call(
+        functools.partial(_agg_kernel, block=8),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)

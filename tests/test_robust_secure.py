@@ -178,19 +178,20 @@ def test_sanitize_valid_mask_matches_subset_run():
 
 def test_pairwise_dists_tiled_matches_untiled():
     """The client-axis tiling (how the sharded Krum path bounds the C x C
-    distance matrix working set) is exact, and a non-divisor tile is a
-    hard error."""
+    distance matrix working set) is exact — including a non-divisor tile,
+    whose last partial block is zero-padded and trimmed. Only a
+    non-positive tile is a hard error."""
     import pytest
 
     rng = np.random.default_rng(1)
     stacked = {"w": jnp.asarray(rng.normal(size=(8, 5)).astype(np.float32))}
     base = np.asarray(pairwise_sq_dists(stacked))
-    for t in (1, 2, 4, 8):
+    for t in (1, 2, 3, 4, 8):
         np.testing.assert_allclose(
             np.asarray(pairwise_sq_dists(stacked, tile_size=t)), base,
             rtol=1e-5)
-    with pytest.raises(ValueError, match="tile_size"):
-        pairwise_sq_dists(stacked, tile_size=3)
+    with pytest.raises(ValueError, match="must be positive"):
+        pairwise_sq_dists(stacked, tile_size=0)
 
 
 def test_pairwise_dists_valid_mask_isolates_pads():

@@ -95,6 +95,22 @@ def test_jit_purity_silent_on_clean_fixture():
     assert _run_on_fixture(JitPurityChecker, "jit_purity_clean.py") == []
 
 
+def test_jit_purity_fires_inside_pallas_kernel():
+    # a pallas_call kernel body (handed over via functools.partial) is a
+    # traced root exactly like a jit body
+    findings = _run_on_fixture(JitPurityChecker, "agg_pallas_bad.py")
+    keys = {f.key for f in findings}
+    assert "_agg_kernel:print" in keys
+    assert "_agg_kernel:time.time" in keys
+    assert "_agg_kernel:np.random.rand" in keys
+    assert "_agg_kernel:.item" in keys
+    assert all(f.checker == "jit-purity" for f in findings)
+
+
+def test_jit_purity_silent_on_clean_pallas_fixture():
+    assert _run_on_fixture(JitPurityChecker, "agg_pallas_clean.py") == []
+
+
 # ----------------------------------------------------------- determinism
 
 def test_determinism_fires_on_bad_fixture():
@@ -249,6 +265,28 @@ def test_host_sync_silent_on_clean_fixture():
 def test_host_sync_ignores_out_of_scope_files():
     findings = _run_on_fixture(HostSyncChecker, "host_sync_bad.py")
     assert findings == []
+
+
+_PALLAS_MOD = "fedml_tpu/ops/pallas/agg_fixture.py"
+
+
+def test_host_sync_covers_pallas_op_modules():
+    # every top-level def in an ops/pallas module is a hot entry point
+    findings = _run_on_fixture(
+        HostSyncChecker, "agg_pallas_bad.py", relpath=_PALLAS_MOD)
+    keys = {f.key for f in findings}
+    assert "fused_agg:block_until_ready" in keys
+    assert "fused_agg:np.asarray:out" in keys
+    assert "_agg_kernel:item:expr" in keys
+
+
+def test_host_sync_silent_on_clean_pallas_fixture():
+    assert _run_on_fixture(
+        HostSyncChecker, "agg_pallas_clean.py", relpath=_PALLAS_MOD) == []
+
+
+def test_host_sync_pallas_fixture_out_of_scope_by_default():
+    assert _run_on_fixture(HostSyncChecker, "agg_pallas_bad.py") == []
 
 
 # ----------------------------------------------------- collective-deadlock
