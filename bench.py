@@ -939,6 +939,123 @@ def codec_sweep_bench(specs=("q8", "delta|topk:0.05|q8", "delta|topk:0.01|q8"),
     return 0 if ok else 1
 
 
+def async_sweep_bench(buffer_sizes=(1, 2, 4, None), skew: float = 10.0,
+                      rounds: int = 6) -> int:
+    """``--async-sweep``: the sync-vs-async frontier of buffered-async
+    aggregation. Per buffer size K (None = full cohort, the lockstep
+    fallback): one sync and one async run of the simulation engine over the
+    SAME seeded heavy-tail delay plan (slowest client ``skew``× the
+    fastest), comparing committed-update goodput on the shared virtual
+    clock against the barrier's round rate, plus final accuracy.
+
+    Gates: every buffered K (< cohort) must clear goodput >= 3x the sync
+    round rate at final accuracy within 2% of sync; the K == cohort run
+    must replay the sync engine bit-for-bit (params equality); and every
+    async commit record's phase breakdown must sum exactly to its
+    round_time (the ``commit`` phase is attributed, not leaked into
+    host_other)."""
+    import math
+
+    import jax
+    import numpy as np
+
+    import fedml_tpu
+    from fedml_tpu.cross_silo.chaos import STRAGGLER_DEFAULTS
+    from fedml_tpu.simulation import build_simulator
+    from fedml_tpu.simulation.async_engine import sync_virtual_seconds
+    from fedml_tpu.comm.resilience import ClientDelayPlan
+
+    cfg = dict(STRAGGLER_DEFAULTS, comm_round=rounds, async_delay_skew=skew)
+    cohort = int(cfg["client_num_per_round"])
+    plan = ClientDelayPlan(
+        seed=int(cfg["random_seed"]), base_s=float(cfg["async_delay_base_s"]),
+        skew=skew, jitter=float(cfg["async_delay_jitter"]))
+    sync_vs = sync_virtual_seconds(
+        plan, float(cfg["async_delay_base_s"]), range(cohort), rounds)
+    sync_round_rate = rounds / sync_vs
+
+    def _run(extra):
+        args = fedml_tpu.init(config=dict(cfg, **extra))
+        sim, apply_fn = build_simulator(args)
+        history = sim.run(apply_fn, log_fn=None)
+        return sim, history
+
+    def _acc(history):
+        accs = [r["test_acc"] for r in history if "test_acc" in r]
+        return float(accs[-1]) if accs else float("nan")
+
+    sync_sim, sync_hist = _run({"async_mode": False})
+    sync_acc = _acc(sync_hist)
+
+    results = []
+    gates_ok = True
+    phase_ok = True
+    lockstep_exact = None
+    for k in buffer_sizes:
+        k_eff = cohort if k is None else int(k)
+        sim, hist = _run({"async_mode": True, "async_buffer_size": k_eff})
+        stats = sim.async_stats()
+        acc = _acc(hist)
+        ratio = (stats["goodput_updates_per_s"] / sync_round_rate
+                 if sync_round_rate > 0 else 0.0)
+        for rec in hist:
+            if "phases" in rec and not math.isclose(
+                    sum(rec["phases"].values()), rec["round_time"],
+                    rel_tol=1e-6, abs_tol=1e-9):
+                phase_ok = False
+        row = {
+            "buffer_size": k_eff,
+            "lockstep": k_eff == cohort,
+            "commits": int(stats["version"]),
+            "committed_updates": int(stats["committed_updates"]),
+            "shed_updates": int(stats["shed_updates"]),
+            "virtual_time_s": round(stats["virtual_time_s"], 4),
+            "goodput_updates_per_vs": round(
+                stats["goodput_updates_per_s"], 4),
+            "goodput_over_sync_round_rate": round(ratio, 3),
+            "final_acc": round(acc, 6),
+            "acc_delta_vs_sync": round(sync_acc - acc, 6),
+            "staleness_max": max(
+                (int(r.get("staleness_max", 0)) for r in hist), default=0),
+        }
+        if k_eff == cohort:
+            eq = jax.tree.map(
+                lambda a, b: bool((np.asarray(a) == np.asarray(b)).all()),
+                sync_sim.params, sim.params)
+            lockstep_exact = all(jax.tree_util.tree_leaves(eq)) and all(
+                s.get("test_acc") == a.get("test_acc")
+                for s, a in zip(sync_hist, hist) if "test_acc" in s)
+            row["bit_exact_vs_sync"] = bool(lockstep_exact)
+        else:
+            row_ok = ratio >= 3.0 and (sync_acc - acc) <= 0.02
+            row["pass_goodput_and_acc"] = bool(row_ok)
+            gates_ok = gates_ok and row_ok
+        results.append(row)
+        print(f"async-sweep: K={k_eff} ratio={ratio:.1f}x acc={acc:.4f} "
+              f"(sync {sync_acc:.4f})", file=sys.stderr, flush=True)
+
+    ok = gates_ok and phase_ok and bool(lockstep_exact)
+    line = {
+        "metric": "async_sweep_goodput_frontier",
+        "unit": (f"committed-update goodput vs sync round rate on the shared "
+                 f"virtual clock ({skew:g}x seeded speed skew, digits/lr "
+                 f"homo, cohort {cohort}, {rounds} rounds), per async "
+                 "buffer size; lockstep row replays the sync engine"),
+        "backend": "cpu",
+        "sync_rounds_per_vs": round(sync_round_rate, 4),
+        "sync_final_acc": round(sync_acc, 6),
+        "results": results,
+        "pass_goodput_3x_within_2pct": bool(gates_ok),
+        "pass_lockstep_bit_exact": bool(lockstep_exact),
+        "pass_phase_sums_exact": bool(phase_ok),
+    }
+    print(json.dumps(line), flush=True)
+    print(f"async-sweep: {'OK' if ok else 'FAIL'} (goodput={gates_ok} "
+          f"lockstep={lockstep_exact} phases={phase_ok})",
+          file=sys.stderr, flush=True)
+    return 0 if ok else 1
+
+
 def loadgen_bench(duration_s: float = 2.0, seed: int = 0) -> int:
     """``--loadgen``: overload gate for the tenancy control plane — the
     check-in load generator must sustain >=10k offered check-ins/sec through
@@ -1003,6 +1120,11 @@ if __name__ == "__main__":
         # compression frontier — loopback + CPU simulator only
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         sys.exit(codec_sweep_bench())
+    if "--async-sweep" in sys.argv:
+        # buffered-async frontier — simulation engine on the CPU backend,
+        # goodput measured on the seeded virtual clock (deterministic)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        sys.exit(async_sweep_bench())
     if "--loadgen" in sys.argv:
         # check-in overload drill — host threads + codec only, no chip
         os.environ.setdefault("JAX_PLATFORMS", "cpu")

@@ -54,6 +54,7 @@ SCOPE_FILES = (
     "fedml_tpu/cli/runner.py",
     "fedml_tpu/simulation/prefetch.py",
     "fedml_tpu/simulation/multi_run.py",
+    "fedml_tpu/simulation/async_engine.py",
 )
 
 # attributes bound to these factories synchronize internally (or are the

@@ -295,15 +295,42 @@ def run(config_file, backend, flight_record):
 @click.option("--json", "as_json", is_flag=True,
               help="Emit the drill outcome as one JSON line (the same "
                    "reporter bench.py --chaos uses) instead of the summary.")
+@click.option("--straggler", is_flag=True,
+              help="Run the straggler drill instead: sync vs buffered-async "
+                   "engines under one seeded heavy-tail delay plan; gates "
+                   "async goodput >= --min-goodput-ratio x the sync round "
+                   "rate at final accuracy within --max-acc-delta.")
+@click.option("--skew", default=10.0, type=float,
+              help="Straggler drill: slowest/fastest client speed ratio.")
+@click.option("--buffer-size", default=2, type=int,
+              help="Straggler drill: async commit buffer size K.")
+@click.option("--min-goodput-ratio", default=3.0, type=float,
+              help="Straggler drill: async-goodput / sync-round-rate gate.")
+@click.option("--max-acc-delta", default=0.02, type=float,
+              help="Straggler drill: max allowed sync-minus-async accuracy.")
 def chaos_drill(seed, rounds, clients, drop_rate, duplicate_rate,
                 fail_send_rate, crash_rank, crash_at_round, byzantine_kind,
                 byzantine_rate, byzantine_scale, defend, codec, timeout,
-                tenant, flight_record, flight_dir, as_json):
+                tenant, flight_record, flight_dir, as_json, straggler, skew,
+                buffer_size, min_goodput_ratio, max_acc_delta):
     """Stand up a full cross-silo deployment (server + clients, real codec,
     real round FSM) under the given fault plan and verify every round still
     closes. Exits 1 if the run hangs or loses rounds — the same check
     ``tests/test_chaos.py`` gates CI with, runnable against any config."""
     from ..cross_silo.chaos import run_chaos_drill
+
+    if straggler:
+        from ..cross_silo.chaos import run_straggler_drill
+
+        result = run_straggler_drill(
+            min_goodput_ratio=min_goodput_ratio, max_acc_delta=max_acc_delta,
+            random_seed=seed, async_delay_skew=skew,
+            async_buffer_size=buffer_size)
+        click.echo(json.dumps(result.json_record()) if as_json
+                   else result.summary())
+        if not result.ok:
+            raise SystemExit(1)
+        return
 
     kw = dict(
         fault_seed=seed, comm_round=rounds, client_num_in_total=clients,
@@ -494,6 +521,7 @@ def telemetry_summary(jsonl_path, tenant):
     from ..core import telemetry as _telemetry
 
     spans = {}
+    instants = {}
     snapshot = None
     skipped = 0
     with open(jsonl_path) as f:
@@ -515,6 +543,16 @@ def telemetry_summary(jsonl_path, tenant):
                 s["durations"].append(float(rec.get("duration", 0.0)))
                 if rec.get("trace_id"):
                     s["traces"].add(rec["trace_id"])
+            elif kind == "instant":
+                # point events (commit / quarantine / rollback / shed …) —
+                # the same records the Perfetto export renders as ph:"i"
+                if tenant is not None and rec.get("tenant") != tenant:
+                    continue
+                i = instants.setdefault(
+                    rec.get("name", "?"), {"count": 0, "rounds": set()})
+                i["count"] += 1
+                if rec.get("round") is not None:
+                    i["rounds"].add(int(rec["round"]))
             elif kind == "registry_snapshot":
                 snapshot = rec.get("registry")  # keep the LAST one
     if snapshot is not None and tenant is not None:
@@ -531,6 +569,12 @@ def telemetry_summary(jsonl_path, tenant):
             click.echo(f"  {name:<28}{len(ds):>7}{total:>10.4f}"
                        f"{total / len(ds):>10.5f}{p95:>10.5f}"
                        f"{len(spans[name]['traces']):>8}")
+    if instants:
+        click.echo("instants:")
+        click.echo(f"  {'name':<28}{'count':>7}{'rounds':>8}")
+        for name in sorted(instants):
+            i = instants[name]
+            click.echo(f"  {name:<28}{i['count']:>7}{len(i['rounds']):>8}")
     if snapshot:
         counters = snapshot.get("counters", {})
         dropped = sum(v for k, v in counters.items()
@@ -559,7 +603,7 @@ def telemetry_summary(jsonl_path, tenant):
             click.echo("round phase breakdown (share of attributed wall):")
             for phase, v in sorted(phase_rows, key=lambda kv: -kv[1]):
                 click.echo(f"  {phase:<12}{v:>12.4f}s{v / total:>9.1%}")
-    if not spans and not snapshot:
+    if not spans and not instants and not snapshot:
         click.echo("no span or registry_snapshot records found")
     if skipped:
         click.echo(f"({skipped} unparseable lines skipped)")

@@ -112,6 +112,69 @@ def _hash_fraction(*parts) -> float:
     return int.from_bytes(h[:8], "big") / 2.0 ** 64
 
 
+# --- straggler delay plan ----------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ClientDelayPlan:
+    """Seeded heavy-tail per-client completion-time plan.
+
+    The buffered-async engines emulate per-client speed skew with this plan:
+    each client gets a deterministic *speed factor* in ``[1, skew]`` drawn
+    from a heavy-tail (power-law) map of a hash-uniform fraction — most
+    clients sit near 1x, a deterministic minority near the full ``skew`` —
+    and each dispatch draws a jittered completion delay around
+    ``base_s * factor``. Every draw is a pure function of
+    ``(seed, client, seq)``, so a 10x-skew run replays identically across
+    threads, processes, and resumes (the FedJAX-style simulated cost model,
+    arXiv:2108.02117).
+
+    The simulation engine consumes :meth:`delay_s` as *virtual seconds*
+    (uncapped). Cross-silo clients consume :meth:`sleep_s`, which is bounded
+    by ``MAX_INJECTED_DELAY_S`` — chaos drills perturb ordering, never stall
+    a test suite.
+    """
+
+    seed: int = 0
+    base_s: float = 0.05
+    skew: float = 10.0
+    # jitter fraction around the client's mean delay (0 = exact factor)
+    jitter: float = 0.2
+
+    def speed_factor(self, client: int) -> float:
+        """Deterministic per-client slowdown in ``[1, skew]``; the cube map
+        concentrates mass near 1x with a heavy straggler tail."""
+        frac = _hash_fraction(self.seed, "speed", int(client))
+        return 1.0 + (max(self.skew, 1.0) - 1.0) * frac ** 3
+
+    def delay_s(self, client: int, seq: int) -> float:
+        """Completion delay for one dispatch, keyed ``(seed, client, seq)``."""
+        frac = _hash_fraction(self.seed, "delay", int(client), int(seq))
+        jit = 1.0 + self.jitter * (2.0 * frac - 1.0)
+        return self.base_s * self.speed_factor(client) * jit
+
+    def sleep_s(self, client: int, seq: int) -> float:
+        """Wall-clock-safe variant for live (cross-silo) clients."""
+        return min(self.delay_s(client, seq), MAX_INJECTED_DELAY_S)
+
+    @classmethod
+    def from_args(cls, args) -> Optional["ClientDelayPlan"]:
+        """Build from flat ``straggler_*`` keys; ``None`` unless a positive
+        skew is configured (no plan = zero injected delay anywhere)."""
+        if args is None:
+            return None
+        skew = float(getattr(args, "straggler_skew", 0.0) or 0.0)
+        if skew <= 0.0:
+            return None
+        return cls(
+            seed=int(getattr(args, "straggler_seed",
+                             getattr(args, "fault_seed", 0)) or 0),
+            base_s=float(getattr(args, "straggler_base_delay_s", 0.05)),
+            skew=skew,
+            jitter=float(getattr(args, "straggler_jitter", 0.2)),
+        )
+
+
 # --- retry engine ------------------------------------------------------------
 
 

@@ -121,7 +121,8 @@ def _masked_median(x: jax.Array, valid, n_valid: int) -> jax.Array:
 
 
 def sanitize_stacked(stacked_updates: PyTree, weights: jax.Array,
-                     z_thresh: float = 6.0, valid=None, out_shardings=None):
+                     z_thresh: float = 6.0, valid=None, out_shardings=None,
+                     staleness_scale=None):
     """Quarantine poisoned rows of a stacked cohort before any aggregation.
 
     Two detectors, both jit-able over the whole cohort at once:
@@ -152,6 +153,14 @@ def sanitize_stacked(stacked_updates: PyTree, weights: jax.Array,
     jit — the zeroing ``where`` is elementwise, but on a 2-D (client×model)
     mesh the constraint keeps GSPMD from gathering the stack before the
     aggregation that follows. Numerically a no-op.
+
+    ``staleness_scale`` (optional, (C,) f32) makes the z-score
+    staleness-aware for buffered-async cohorts: each row's norm is
+    multiplied by its staleness down-weight ``(1+s)^-α`` BEFORE the
+    median/MAD statistics, so the detector judges updates by what they
+    will actually contribute post-weighting — a stale honest client whose
+    raw norm drifted high is not flagged, while a fresh boosted upload
+    still is. ``None`` is byte-identical to the synchronous behavior.
     """
     leaves = jax.tree_util.tree_leaves(stacked_updates)
     C = leaves[0].shape[0]
@@ -162,6 +171,8 @@ def sanitize_stacked(stacked_updates: PyTree, weights: jax.Array,
         bad = bad | ~jnp.isfinite(xf).all(axis=1)
         sq = sq + jnp.sum(jnp.square(jnp.nan_to_num(xf)), axis=1)
     norm = jnp.sqrt(sq)
+    if staleness_scale is not None:
+        norm = norm * staleness_scale.astype(jnp.float32)
     if valid is None:
         med = jnp.median(norm)
         mad = jnp.median(jnp.abs(norm - med))
@@ -301,7 +312,7 @@ def fused_sanitize_krum(stacked_updates: PyTree, weights: jax.Array,
                         z_thresh: float = 6.0, n_byz: int = 0, m: int = 1,
                         sample_weighted: bool = False, valid=None,
                         out_shardings=None, use_kernel: bool = True,
-                        interpret=None):
+                        interpret=None, staleness_scale=None):
     """Fused ``sanitize_stacked`` + ``krum_aggregate`` over one read of the
     cohort stack — the agg_kernels fast path for the Krum defense family.
 
@@ -322,6 +333,10 @@ def fused_sanitize_krum(stacked_updates: PyTree, weights: jax.Array,
     agg_robust's module docstring). The only remaining reads of the update
     are fused into the final weighted ``tensordot``.
 
+    ``staleness_scale`` mirrors :func:`sanitize_stacked`'s parameter (the
+    identical expression on the identical shape, so the fused/unfused
+    parity contract holds with or without it).
+
     Returns ``(agg, clean_weights, quarantine, z, selected)``.
     """
     leaves = jax.tree_util.tree_leaves(stacked_updates)
@@ -335,6 +350,8 @@ def fused_sanitize_krum(stacked_updates: PyTree, weights: jax.Array,
         bad = bad | ~jnp.isfinite(xf).all(axis=1)
         sq = sq + jnp.sum(jnp.square(jnp.nan_to_num(xf)), axis=1)
     norm = jnp.sqrt(sq)
+    if staleness_scale is not None:
+        norm = norm * staleness_scale.astype(jnp.float32)
     if valid is None:
         med = jnp.median(norm)
         mad = jnp.median(jnp.abs(norm - med))
@@ -447,14 +464,19 @@ class RobustAggregator:
         return agg
 
     def aggregate_with_info(self, stacked_updates: PyTree, weights: jax.Array,
-                            rng=None) -> tuple:
+                            rng=None, staleness_scale=None) -> tuple:
         """Defended aggregate plus a jit-compatible info dict:
         ``quarantine`` (C,) bool, ``z`` (C,) robust z-scores, ``selected``
-        (C,) float — the clients that actually contributed."""
+        (C,) float — the clients that actually contributed.
+
+        ``staleness_scale`` forwards to :func:`sanitize_stacked` (buffered-
+        async cohorts judge norms post-down-weighting); ``None`` keeps the
+        synchronous behavior bit-for-bit."""
         C = jax.tree_util.tree_leaves(stacked_updates)[0].shape[0]
         if self.sanitize:
             stacked_updates, weights, quarantine, z = sanitize_stacked(
-                stacked_updates, weights, self.z_thresh)
+                stacked_updates, weights, self.z_thresh,
+                staleness_scale=staleness_scale)
         else:
             quarantine = jnp.zeros((C,), bool)
             z = jnp.zeros((C,), jnp.float32)

@@ -89,6 +89,13 @@ class FedMLAggregator:
         self.last_quarantined_slots: List[int] = []
         self.last_z: Dict[int, float] = {}
         self._agg_fn = jax.jit(self._aggregate_stacked)
+        # buffered-async plane: updates fold here as they arrive (tagged with
+        # the model version they trained against); commit_async drains the
+        # buffer into one staleness-weighted aggregate. Sender-keyed, not
+        # slot-keyed — async has no per-round cohort slots.
+        self._async_buffer: List[tuple] = []
+        self.last_quarantined_senders: List[int] = []
+        self._agg_fn_async = jax.jit(self._aggregate_async)
 
     # --- reference API ------------------------------------------------------
 
@@ -98,8 +105,8 @@ class FedMLAggregator:
     def set_global_model_params(self, model_parameters: PyTree) -> None:
         self.model_params = model_parameters
 
-    def add_local_trained_result(self, index: int, model_params: PyTree, sample_num) -> None:
-        logging.debug("add_model. index = %d", index)
+    @staticmethod
+    def _decode_upload(model_params: PyTree, tag: int) -> PyTree:
         from ..comm import codec as comm_codec
         from ..comm.message import decompress_tree, is_compressed
 
@@ -108,15 +115,104 @@ class FedMLAggregator:
             # FaultyCommManager's decompress-then-corrupt byzantine path)
             # always see plain update trees
             t0 = time.perf_counter()
-            with telemetry.get_tracer().span("codec.decode", slot=index):
+            with telemetry.get_tracer().span("codec.decode", slot=tag):
                 frame_bytes = comm_codec.frame_nbytes(model_params)
                 model_params = decompress_tree(model_params)
             comm_codec.record_codec(
                 "decode", frame_bytes, comm_codec.tree_nbytes(model_params),
                 time.perf_counter() - t0)
+        return model_params
+
+    def add_local_trained_result(self, index: int, model_params: PyTree, sample_num) -> None:
+        logging.debug("add_model. index = %d", index)
+        model_params = self._decode_upload(model_params, index)
         self.model_dict[index] = model_params
         self.sample_num_dict[index] = float(sample_num)
         self.flag_client_model_uploaded_dict[index] = True
+
+    # --- buffered-async plane (FedBuff-style) -------------------------------
+
+    def add_async_result(self, sender: int, model_params: PyTree, sample_num,
+                         staleness: int) -> None:
+        """Fold one free-running client's update into the commit buffer.
+        ``staleness`` = committed model versions since the version this
+        update trained against (0 = perfectly fresh)."""
+        model_params = self._decode_upload(model_params, int(sender))
+        self._async_buffer.append(
+            (int(sender), model_params, float(sample_num), int(staleness)))
+
+    @property
+    def async_buffer_len(self) -> int:
+        return len(self._async_buffer)
+
+    def _aggregate_async(self, stacked: PyTree, weights: jax.Array,
+                         sw: jax.Array, rng):
+        """Staleness-weighted aggregate of a drained commit buffer: weights
+        are sample counts × the staleness down-weight ``(1+s)^-α``; the
+        sanitizer's robust z judges norms on the same post-weighting scale
+        (``staleness_scale``) so a stale honest client is not flagged for
+        drift the down-weight already absorbs."""
+        wf = weights * sw
+        if self._robust is not None:
+            agg, info = self._robust.aggregate_with_info(
+                stacked, wf, rng, staleness_scale=sw)
+            return agg, info["quarantine"], info["z"]
+        w = wf / jnp.maximum(wf.sum(), 1e-12)
+        agg = jax.tree.map(
+            lambda x: jnp.tensordot(
+                w.astype(jnp.float32), x.astype(jnp.float32),
+                axes=(0, 0)).astype(x.dtype),
+            stacked,
+        )
+        return agg, None, None
+
+    def commit_async(self, alpha: float, cohort: int) -> PyTree:
+        """Drain the buffer into one commit: staleness-weighted robust
+        aggregate, scaled by the buffer fraction ``n/cohort`` so a full
+        cycle of commits applies the same total server step a synchronous
+        round would (a full-cohort buffer — the lockstep fallback — hits
+        ``frac == 1.0`` and skips the scale entirely)."""
+        buf = self._async_buffer
+        self._async_buffer = []
+        self.last_quarantined_slots = []
+        self.last_z = {}
+        self.last_quarantined_senders = []
+        if not buf:
+            return self.model_params
+        senders = [b[0] for b in buf]
+        stacked = jax.tree.map(
+            lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+            *[b[1] for b in buf],
+        )
+        weights = jnp.asarray([b[2] for b in buf], jnp.float32)
+        sw = jnp.asarray([(1.0 + b[3]) ** (-alpha) for b in buf], jnp.float32)
+        self._agg_calls += 1
+        rng = (jax.random.fold_in(self._dp_key, self._agg_calls)
+               if self._robust is not None else None)
+        agg_delta, quarantine, z = self._agg_fn_async(stacked, weights, sw, rng)
+        frac = len(buf) / float(max(int(cohort), 1))
+        if frac != 1.0:
+            agg_delta = jax.tree.map(
+                lambda a: (a * frac).astype(a.dtype), agg_delta)
+        if quarantine is not None:
+            # sync by design: the verdict feeds the commit record the server
+            # writes before replying to the uploader
+            qn = np.asarray(quarantine)  # graftcheck: disable=host-sync
+            zn = np.asarray(z)  # graftcheck: disable=host-sync
+            self.last_quarantined_senders = sorted(
+                {senders[i] for i in np.nonzero(qn)[0]})
+            self.last_z = {senders[i]: float(zn[i])
+                           for i in range(len(senders))}
+            if self.last_quarantined_senders:
+                reg = telemetry.get_registry()
+                if reg.enabled:
+                    reg.counter("fedml_quarantined_total").inc(
+                        len(self.last_quarantined_senders))
+        self.model_params = jax.tree.map(
+            lambda p, d: (jnp.asarray(p) + d.astype(p.dtype)),
+            self.model_params, agg_delta,
+        )
+        return self.model_params
 
     def set_expected_this_round(self, n: int) -> None:
         self.expected_this_round = int(n)

@@ -120,6 +120,162 @@ class ChaosDrillResult:
         return rec
 
 
+STRAGGLER_DEFAULTS = dict(
+    dataset="digits",
+    model="lr",
+    partition_method="homo",
+    client_num_in_total=8,
+    client_num_per_round=8,
+    comm_round=6,
+    learning_rate=0.3,
+    epochs=1,
+    batch_size=32,
+    frequency_of_the_test=3,
+    random_seed=0,
+    # the straggler plan: deterministic heavy-tail speed skew — the slowest
+    # client runs async_delay_skew× slower than the fastest, per-round jitter
+    # on top, all hash-seeded so every drill replays bit-for-bit
+    async_buffer_size=2,
+    async_staleness_alpha=0.5,
+    async_delay_base_s=1.0,
+    async_delay_skew=10.0,
+    async_delay_jitter=0.2,
+)
+
+
+@dataclasses.dataclass
+class StragglerDrillResult:
+    """Sync-vs-async outcome under one seeded straggler plan. Goodput is
+    measured on the shared virtual clock (committed updates per virtual
+    second), so the comparison is deterministic — a wall-clock drill would
+    gate CI on scheduler noise."""
+
+    commits: int
+    committed_updates: int
+    shed_updates: int
+    staleness_max: int
+    sync_round_rate: float   # sync rounds per virtual second (barrier pace)
+    async_goodput_ups: float  # async committed updates per virtual second
+    sync_final_acc: float
+    async_final_acc: float
+    elapsed_s: float
+    min_goodput_ratio: float = 3.0
+    max_acc_delta: float = 0.02
+    history: List[dict] = dataclasses.field(default_factory=list)
+
+    @property
+    def goodput_ratio(self) -> float:
+        """Committed-update goodput over the synchronous round rate — the
+        acceptance metric: a sync round folds its whole cohort but lands only
+        at the barrier pace the slowest client sets, while async keeps
+        committing off the fast clients the barrier would have idled."""
+        return (self.async_goodput_ups / self.sync_round_rate
+                if self.sync_round_rate > 0 else 0.0)
+
+    @property
+    def acc_delta(self) -> float:
+        return self.sync_final_acc - self.async_final_acc
+
+    @property
+    def ok(self) -> bool:
+        return (self.goodput_ratio >= self.min_goodput_ratio
+                and self.acc_delta <= self.max_acc_delta)
+
+    def summary(self) -> str:
+        return (
+            f"straggler drill: {'PASS' if self.ok else 'FAIL'} — "
+            f"async {self.async_goodput_ups:.2f} upd/vs vs sync "
+            f"{self.sync_round_rate:.2f} rounds/vs "
+            f"({self.goodput_ratio:.1f}x, gate >={self.min_goodput_ratio:.1f}x)"
+            f" | acc async {self.async_final_acc:.4f} vs sync "
+            f"{self.sync_final_acc:.4f} (delta {self.acc_delta:+.4f}, gate "
+            f"<={self.max_acc_delta:.2f}) | {self.commits} commits, "
+            f"{self.committed_updates} updates, max staleness "
+            f"{self.staleness_max}, shed {self.shed_updates}"
+        )
+
+    def json_record(self) -> dict:
+        """Same single-reporter contract as :meth:`ChaosDrillResult.
+        json_record` — one JSON-able dict behind ``bench.py --async-sweep``
+        and ``fedml-tpu chaos-drill --straggler --json``."""
+        return {
+            "commits": self.commits,
+            "committed_updates": self.committed_updates,
+            "shed_updates": self.shed_updates,
+            "staleness_max": self.staleness_max,
+            "sync_rounds_per_vs": round(self.sync_round_rate, 4),
+            "async_goodput_updates_per_vs": round(self.async_goodput_ups, 4),
+            "goodput_ratio": round(self.goodput_ratio, 3),
+            "sync_final_acc": round(self.sync_final_acc, 6),
+            "async_final_acc": round(self.async_final_acc, 6),
+            "acc_delta": round(self.acc_delta, 6),
+            "elapsed_s": round(self.elapsed_s, 3),
+            "ok": self.ok,
+        }
+
+
+def _final_acc(history: List[dict]) -> float:
+    accs = [r["test_acc"] for r in history if "test_acc" in r]
+    return float(accs[-1]) if accs else float("nan")
+
+
+def run_straggler_drill(min_goodput_ratio: float = 3.0,
+                        max_acc_delta: float = 0.02,
+                        **overrides) -> StragglerDrillResult:
+    """Run the sync and buffered-async simulation engines over the SAME
+    seeded heavy-tail delay plan and compare goodput + final accuracy.
+
+    The sync side barriers every round on the slowest sampled client
+    (:func:`~fedml_tpu.simulation.async_engine.sync_virtual_seconds`), the
+    async side commits every ``async_buffer_size`` arrivals — both on the
+    identical hash-seeded virtual clock, so the reported ratio is a property
+    of the plan, not of the machine running the drill."""
+    import time as _time
+
+    import fedml_tpu
+    from ..comm.resilience import ClientDelayPlan
+    from ..simulation import build_simulator
+    from ..simulation.async_engine import sync_virtual_seconds
+
+    cfg = dict(STRAGGLER_DEFAULTS)
+    cfg.update(overrides)
+    t0 = _time.perf_counter()
+
+    def _run(extra):
+        args = fedml_tpu.init(config=dict(cfg, **extra))
+        sim, apply_fn = build_simulator(args)
+        history = sim.run(apply_fn, log_fn=None)
+        return sim, history
+
+    sync_sim, sync_hist = _run({"async_mode": False})
+    async_sim, async_hist = _run({"async_mode": True})
+
+    plan = ClientDelayPlan(
+        seed=int(cfg["random_seed"]), base_s=float(cfg["async_delay_base_s"]),
+        skew=float(cfg["async_delay_skew"]),
+        jitter=float(cfg["async_delay_jitter"]))
+    n_rounds = int(cfg["comm_round"])
+    cohort = int(cfg["client_num_per_round"])
+    sync_vs = sync_virtual_seconds(
+        plan, float(cfg["async_delay_base_s"]), range(cohort), n_rounds)
+    stats = async_sim.async_stats()
+    return StragglerDrillResult(
+        commits=int(stats["version"]),
+        committed_updates=int(stats["committed_updates"]),
+        shed_updates=int(stats["shed_updates"]),
+        staleness_max=max(
+            (int(r.get("staleness_max", 0)) for r in async_hist), default=0),
+        sync_round_rate=n_rounds / sync_vs if sync_vs > 0 else 0.0,
+        async_goodput_ups=float(stats["goodput_updates_per_s"]),
+        sync_final_acc=_final_acc(sync_hist),
+        async_final_acc=_final_acc(async_hist),
+        elapsed_s=_time.perf_counter() - t0,
+        min_goodput_ratio=float(min_goodput_ratio),
+        max_acc_delta=float(max_acc_delta),
+        history=list(async_hist),
+    )
+
+
 def _label_totals(counters: Dict[str, float], name: str,
                   label: Optional[str] = None,
                   where: Optional[Dict[str, str]] = None) -> Dict[str, float]:

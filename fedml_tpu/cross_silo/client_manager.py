@@ -18,7 +18,7 @@ import numpy as np
 from ..comm import Message, ClientManager
 from ..comm import codec as comm_codec
 from ..comm.message import decompress_tree, is_compressed
-from ..comm.resilience import SendFailure
+from ..comm.resilience import ClientDelayPlan, SendFailure
 from ..comm.utils import log_communication_tick, log_communication_tock
 from ..core import telemetry, trace_plane
 from .message_define import MyMessage
@@ -42,6 +42,15 @@ class FedMLClientManager(ClientManager):
         self._codec = comm_codec.UpdateCodec(spec) if spec else None
         self._codec_residuals: Dict[str, np.ndarray] = {}
         self._codec_seed = int(getattr(args, "random_seed", 0))
+        # straggler drill hook: when a seeded delay plan is configured
+        # (straggler_skew > 0), this client sleeps its deterministic per-round
+        # delay before each upload — a replayable 10× speed skew without
+        # touching the training path. None in normal runs.
+        self._delay_plan = ClientDelayPlan.from_args(args)
+        # committed model version last received from a buffered-async server
+        # (echoed on upload so the server can compute this update's
+        # staleness); None when the server never sent one (sync runs)
+        self._model_version = None
 
     def register_message_receive_handlers(self) -> None:
         self.register_message_receive_handler(
@@ -98,6 +107,7 @@ class FedMLClientManager(ClientManager):
         # a resumed server's INIT names the round it restarts from; a fresh
         # run's INIT carries no round param and starts at 0 as before
         self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_INDEX, 0))
+        self._note_model_version(msg)
         self._train()
 
     def _on_sync(self, msg: Message) -> None:
@@ -105,9 +115,15 @@ class FedMLClientManager(ClientManager):
             msg.get(MyMessage.MSG_ARG_KEY_MODEL_PARAMS))
         client_index = msg.get(MyMessage.MSG_ARG_KEY_CLIENT_INDEX)
         self.round_idx = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.round_idx + 1))
+        self._note_model_version(msg)
         self.trainer.update_model(global_model_params)
         self.trainer.update_dataset(int(client_index))
         self._train()
+
+    def _note_model_version(self, msg: Message) -> None:
+        v = msg.get(MyMessage.MSG_ARG_KEY_MODEL_VERSION)
+        if v is not None:
+            self._model_version = int(v)
 
     def _train(self) -> None:
         logging.info("client %d: round %d train start", self.rank, self.round_idx)
@@ -137,6 +153,16 @@ class FedMLClientManager(ClientManager):
         msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS, update)
         msg.add_params(MyMessage.MSG_ARG_KEY_NUM_SAMPLES, local_sample_num)
         msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
+        if self._model_version is not None:
+            # buffered-async echo: which committed version this update
+            # trained against (a sync server never set it → key absent, wire
+            # bytes unchanged)
+            msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_VERSION,
+                           int(self._model_version))
+        if self._delay_plan is not None:
+            # seeded straggler injection: deterministic per-(client, round)
+            # heavy-tail delay, applied at the upload edge
+            time.sleep(self._delay_plan.sleep_s(self.rank, self.round_idx))
         # ship this rank's finished spans for the round with the upload —
         # the server assembles the cross-rank round timeline from them
         trace_plane.attach_spans(msg, self.round_idx, self.rank)

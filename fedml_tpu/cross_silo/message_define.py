@@ -30,6 +30,10 @@ class MyMessage:
     MSG_ARG_KEY_CLIENT_STATUS = "client_status"
     MSG_ARG_KEY_CLIENT_OS = "client_os"
     MSG_ARG_KEY_ROUND_INDEX = "round_idx"
+    # buffered-async plane (ours): committed model version carried on S2C
+    # init/sync and echoed back on the upload — the server derives each
+    # update's staleness from the echo. Absent entirely in synchronous runs.
+    MSG_ARG_KEY_MODEL_VERSION = "model_version"
 
     MSG_CLIENT_STATUS_OFFLINE = "OFFLINE"
     MSG_CLIENT_STATUS_IDLE = "IDLE"

@@ -83,6 +83,43 @@ class FedMLServerManager(ServerManager):
         self._round_gen = 0  # increments at each round completion
         self._timer: Optional[threading.Timer] = None
         self._handshake_timer: Optional[threading.Timer] = None
+        # buffered-async mode (FedBuff-style): no round barrier — each upload
+        # folds into the aggregator's commit buffer under the FSM lock and
+        # every async_buffer_size folds commit a new model version; the
+        # uploader gets the freshest committed model back immediately and
+        # keeps free-running. comm_round counts COMMITS here, not rounds.
+        self.async_mode = bool(getattr(args, "async_mode", False))
+        self.model_version = 0
+        self.committed_updates = 0
+        self.shed_updates = 0
+        self._client_seq: Dict[int, int] = {}
+        if self.async_mode:
+            if float(getattr(args, "watchdog_factor", 0.0) or 0.0) > 0:
+                raise ValueError(
+                    "async_mode is incompatible with the divergence watchdog "
+                    "(rollback assumes a round barrier to re-run); rely on "
+                    "the staleness-aware sanitizer instead")
+            k = getattr(args, "async_buffer_size", None)
+            cohort = int(getattr(args, "client_num_per_round", client_num)
+                         or client_num)
+            self.async_buffer_size = int(k) if k is not None else cohort
+            if not (1 <= self.async_buffer_size <= cohort):
+                raise ValueError(
+                    f"async_buffer_size must be in [1, {cohort}], got {k}")
+            self.async_staleness_alpha = float(
+                getattr(args, "async_staleness_alpha", 0.5))
+            # no barrier → nothing for the straggler timer to close
+            self.round_timeout = None
+            from ..core.tenancy import (CheckinQueue,
+                                        DeficitRoundRobinScheduler)
+
+            # admission edge: uploads check in here before folding; a full
+            # queue sheds (the client still gets a fresh model back, only
+            # the update is dropped) and the DRR deficit keeps a fast
+            # client from monopolizing commit slots
+            self._checkin = CheckinQueue(maxsize=max(64, 4 * cohort))
+            self._adrr = DeficitRoundRobinScheduler()
+            self._adrr_tenants: Set[str] = set()
         # round-state checkpointing: global params + next round + np RNG,
         # saved every ckpt_every_rounds completions; a restarted server
         # process resumes mid-run instead of starting from round 0
@@ -93,6 +130,19 @@ class FedMLServerManager(ServerManager):
             state = self.round_store.load()
             self.round_idx = int(state["round_idx"])
             self.aggregator.set_global_model_params(state["params"])
+            extra = state.get("extra") or {}
+            if self.async_mode and extra:
+                # model-version log: a restarted server resumes the commit
+                # counters and each client's upload sequence — a client
+                # re-sending an already-committed update is deduped by its
+                # stale sequence number instead of double-committed
+                self.model_version = int(extra.get("model_version", 0))
+                self.committed_updates = int(
+                    extra.get("committed_updates", 0))
+                self._client_seq = {
+                    int(c): int(s)
+                    for c, s in (extra.get("client_seq") or {}).items()}
+                self.round_idx = self.model_version
             logging.warning(
                 "server: resumed round state from %s — continuing at round "
                 "%d/%d", ckpt_path, self.round_idx, self.round_num)
@@ -185,7 +235,16 @@ class FedMLServerManager(ServerManager):
             msg.add_params(
                 MyMessage.MSG_ARG_KEY_CLIENT_INDEX, int(self.data_silo_index_list[idx])
             )
-            if self.round_idx > 0:
+            if self.async_mode:
+                # per-client upload sequence (resumes non-zero after a server
+                # restart) + the committed version this model carries, so the
+                # upload's staleness echo starts correct from the first round
+                seq = self._client_seq.get(client_id, 0)
+                if seq > 0:
+                    msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, seq)
+                msg.add_params(MyMessage.MSG_ARG_KEY_MODEL_VERSION,
+                               int(self.model_version))
+            elif self.round_idx > 0:
                 # resume-from-checkpoint: tell clients which round this is.
                 # A fresh run's INIT stays byte-identical to before.
                 msg.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
@@ -354,6 +413,13 @@ class FedMLServerManager(ServerManager):
         re-enters the round. Caller holds the round lock."""
         if sender not in self.client_id_list_in_this_round:
             return None
+        if self.async_mode:
+            # free-running regime: a rejoiner just gets the freshest
+            # committed model and its current upload sequence
+            logging.warning(
+                "server: client %d rejoined async run — resending version %d",
+                sender, self.model_version)
+            return self._async_sync_msg_locked(sender)
         slot = self.client_id_list_in_this_round.index(sender)
         if self.aggregator.has_upload_from(slot):
             return None  # its result is already in — nothing to redo
@@ -391,6 +457,9 @@ class FedMLServerManager(ServerManager):
                 "fedml_client_round_trip_seconds",
                 client=str(msg.get_sender_id()),
             ).observe(time.perf_counter() - sent_at)
+        if self.async_mode:
+            self._on_model_async(msg, model_params, local_sample_num)
+            return
         outcome = None
         with self._round_lock:
             msg_round = msg.get(MyMessage.MSG_ARG_KEY_ROUND_INDEX)
@@ -414,6 +483,157 @@ class FedMLServerManager(ServerManager):
             if self.aggregator.check_whether_all_receive():
                 outcome = self._complete_round_locked()
         self._dispatch_round_end(outcome)
+
+    # --- buffered-async plane (FedBuff-style) ------------------------------
+
+    def _on_model_async(self, msg, model_params, local_sample_num) -> None:
+        """Async upload path: dedup by per-sender sequence, admit through the
+        checkin queue, fold into the aggregator's commit buffer, commit every
+        ``async_buffer_size`` folds, and immediately hand the uploader the
+        freshest committed model — no barrier, no cohort wait."""
+        sender = msg.get_sender_id()
+        outcome = None
+        reply = None
+        with self._round_lock:
+            if self.model_version >= self.round_num:
+                return  # run finished; a late upload changes nothing
+            seq = int(msg.get(MyMessage.MSG_ARG_KEY_ROUND_INDEX, 0) or 0)
+            expected = self._client_seq.get(sender, 0)
+            if seq != expected:
+                # seq < expected: a duplicate (e.g. the client re-sent after
+                # a server restart whose fold was already committed and
+                # persisted in the version log) — drop the update but
+                # re-sync the client so it keeps free-running. seq >
+                # expected cannot happen with an honest client; drop it too.
+                logging.warning(
+                    "server: async upload from %d with seq %d (expected %d)"
+                    " — deduped", sender, seq, expected)
+                if seq < expected:
+                    reply = self._async_sync_msg_locked(sender)
+            else:
+                base_version = int(
+                    msg.get(MyMessage.MSG_ARG_KEY_MODEL_VERSION, 0) or 0)
+                staleness = max(0, self.model_version - base_version)
+                self._client_seq[sender] = seq + 1
+                tenant = str(sender)
+                if tenant not in self._adrr_tenants:
+                    self._adrr.register(tenant, round_cost=1.0)
+                    self._adrr_tenants.add(tenant)
+                if not self._checkin.offer((sender, seq), tenant=tenant):
+                    # admission queue full: shed the update (never the
+                    # client — it still gets a fresh model back)
+                    self.shed_updates += 1
+                    reg = telemetry.get_registry()
+                    if reg.enabled:
+                        reg.counter("fedml_shed_updates_total").inc()
+                else:
+                    self._checkin.poll()
+                    self._adrr.charge(tenant, 1.0)
+                    self.aggregator.add_async_result(
+                        sender, model_params, local_sample_num, staleness)
+                    reg = telemetry.get_registry()
+                    if reg.enabled:
+                        reg.histogram(
+                            "fedml_update_staleness").observe(
+                                float(staleness))
+                    if (self.aggregator.async_buffer_len
+                            >= self.async_buffer_size):
+                        if self._commit_async_locked():
+                            outcome = (
+                                [Message(MyMessage.MSG_TYPE_S2C_FINISH,
+                                         self.rank, cid)
+                                 for cid in self.client_real_ids],
+                                True, self._round_gen, self._round_ctx)
+                if outcome is None:
+                    reply = self._async_sync_msg_locked(sender)
+        if outcome is not None:
+            self._dispatch_round_end(outcome)
+        elif reply is not None:
+            self._client_send_ts[sender] = time.perf_counter()
+            try:
+                with self._in_round_ctx():
+                    self.send_message(reply)
+            except SendFailure as exc:
+                # an unreachable free-running client simply stops running;
+                # it rejoins by re-announcing ONLINE
+                logging.error(
+                    "server: async sync to client %d failed (%s)",
+                    sender, exc)
+
+    def _async_sync_msg_locked(self, sender: int):
+        """Fresh-model SYNC for one free-running client: current committed
+        params, that client's next upload sequence, and the version being
+        handed out (the staleness echo). Caller holds the round lock."""
+        if sender not in self.client_id_list_in_this_round:
+            return None
+        slot = self.client_id_list_in_this_round.index(sender)
+        sync = Message(
+            MyMessage.MSG_TYPE_S2C_SYNC_MODEL_TO_CLIENT, self.rank, sender)
+        sync.add_params(MyMessage.MSG_ARG_KEY_MODEL_PARAMS,
+                        self._encode_broadcast(
+                            self.aggregator.get_global_model_params()))
+        sync.add_params(MyMessage.MSG_ARG_KEY_CLIENT_INDEX,
+                        int(self.data_silo_index_list[slot]))
+        sync.add_params(MyMessage.MSG_ARG_KEY_ROUND_INDEX,
+                        int(self._client_seq.get(sender, 0)))
+        sync.add_params(MyMessage.MSG_ARG_KEY_MODEL_VERSION,
+                        int(self.model_version))
+        return sync
+
+    def _commit_async_locked(self) -> bool:
+        """Drain the commit buffer into one model version. Returns True when
+        this commit finishes the run (``comm_round`` commits). Caller holds
+        the round lock."""
+        n = self.aggregator.async_buffer_len
+        cohort = int(getattr(self.args, "client_num_per_round",
+                             self.client_num) or self.client_num)
+        with self._in_round_ctx():
+            with telemetry.get_tracer().span(
+                    "server.commit", round_idx=self.model_version):
+                self.aggregator.commit_async(
+                    self.async_staleness_alpha, cohort)
+                metrics = self.aggregator.test_on_server_for_all_clients(
+                    self.model_version) or {}
+        self.model_version += 1
+        self.committed_updates += n
+        # round_idx mirrors the version so FINISH checks, resumed-INIT
+        # short-circuits, and log lines all stay meaningful
+        self.round_idx = self.model_version
+        record = {"round": self.model_version - 1,
+                  "model_version": self.model_version,
+                  "n_updates": n, **metrics}
+        if getattr(self.aggregator, "detect", False):
+            record["quarantined"] = sorted(
+                getattr(self.aggregator, "last_quarantined_senders", []))
+        self.history.append(record)
+        trace_plane.record_instant(
+            "commit", round_idx=self.model_version - 1, rank=self.rank,
+            attrs={"n": n, "version": self.model_version})
+        trace_plane.on_round_record(record, rank=self.rank)
+        reg = telemetry.get_registry()
+        if reg.enabled:
+            reg.counter("fedml_commits_total").inc()
+            elapsed = time.time() - self.start_running_time
+            if elapsed > 0:
+                reg.gauge("fedml_goodput_updates_per_s").set(
+                    self.committed_updates / elapsed)
+        log_round_end(self.rank, self.model_version - 1)
+        if self.round_store is not None and self.ckpt_every_rounds > 0 and (
+                self.model_version % self.ckpt_every_rounds == 0
+                or self.model_version >= self.round_num):
+            # model-version log: commit counters + per-client sequences ride
+            # the same atomic blob as the params, so a restarted server
+            # neither loses nor double-commits a committed update
+            self.round_store.save(
+                self.model_version,
+                self.aggregator.get_global_model_params(),
+                extra={
+                    "model_version": int(self.model_version),
+                    "committed_updates": int(self.committed_updates),
+                    "client_seq": {str(c): int(s)
+                                   for c, s in self._client_seq.items()},
+                })
+        return self.model_version >= self.round_num
 
     def _on_round_timeout(self, gen: int) -> None:
         outcome = None

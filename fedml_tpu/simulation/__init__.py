@@ -18,12 +18,14 @@ from .. import models as models_mod
 from ..algorithms import LocalTrainConfig, get_algorithm
 from ..algorithms.local_sgd import infer_loss_kind as _infer_loss_kind
 from ..parallel.mesh import AXIS_CLIENT, AXIS_MODEL, MeshConfig, create_mesh
+from .async_engine import AsyncFedSimulator
 from .fed_sim import FedSimulator, SimConfig, reference_client_sampling
 from .hierarchical import HierarchicalFedSimulator
 from .decentralized import DecentralizedSimulator
 from .multi_run import MultiTenantSimDriver, TenantJob, TenantRunResult
 
 __all__ = [
+    "AsyncFedSimulator",
     "FedSimulator",
     "SimConfig",
     "SimulatorSingleProcess",
@@ -138,6 +140,19 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
             in ("", "none", "off", "auto")
             else str(args.comm_codec)
         ),
+        # buffered-async aggregation (simulation/async_engine.py): off by
+        # default — the default path stays byte-identical to the
+        # synchronous engine
+        async_mode=bool(getattr(args, "async_mode", False)),
+        async_buffer_size=(
+            None if getattr(args, "async_buffer_size", None) is None
+            else int(args.async_buffer_size)
+        ),
+        async_staleness_alpha=float(
+            getattr(args, "async_staleness_alpha", 0.5)),
+        async_delay_base_s=float(getattr(args, "async_delay_base_s", 1.0)),
+        async_delay_skew=float(getattr(args, "async_delay_skew", 0.0) or 0.0),
+        async_delay_jitter=float(getattr(args, "async_delay_jitter", 0.2)),
     )
 
     attack_type = getattr(args, "attack_type", None)
@@ -204,7 +219,8 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
         dp_seed=int(getattr(args, "random_seed", 0)),
     )
     update_transform = _make_attack_transform(alg, args) if attack_type else None
-    sim = FedSimulator(
+    sim_cls = AsyncFedSimulator if sim_cfg.async_mode else FedSimulator
+    sim = sim_cls(
         fed_data, alg, variables, sim_cfg, mesh=mesh,
         # raw pieces for the packed cohort schedule's in-scan batch step
         packed_ctx=(apply_fn, cfg, needs_dropout, has_batch_stats),

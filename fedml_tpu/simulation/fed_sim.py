@@ -220,6 +220,26 @@ class SimConfig:
     # carry survives the re-run, same as a real client re-encoding.
     # None = updates flow uncompressed (bit-identical to pre-codec runs).
     comm_codec: Optional[str] = None
+    # --- buffered-async aggregation (simulation/async_engine.py) --------
+    # FedBuff-style server: client updates fold into a staleness-weighted
+    # buffer as they (virtually) complete and a new model version commits
+    # every async_buffer_size updates — no cohort barrier. Off (default)
+    # keeps the synchronous engine byte-identical.
+    async_mode: bool = False
+    # commit threshold K; None = the full cohort (the bit-exact fallback
+    # regime when the delay plan has zero skew)
+    async_buffer_size: Optional[int] = None
+    # stale-update down-weight exponent: weight *= 1/(1+staleness)^alpha,
+    # where staleness = commits since the update's base model version; the
+    # same factor scales the sanitizer's robust-z norms (staleness-aware
+    # outlier detection)
+    async_staleness_alpha: float = 0.5
+    # seeded heavy-tail per-client completion-time plan (virtual seconds;
+    # comm/resilience.ClientDelayPlan): skew <= 0 disables the plan (every
+    # client completes in async_delay_base_s exactly)
+    async_delay_base_s: float = 1.0
+    async_delay_skew: float = 0.0
+    async_delay_jitter: float = 0.2
 
 
 @dataclasses.dataclass

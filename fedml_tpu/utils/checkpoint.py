@@ -77,7 +77,14 @@ class RoundStateStore:
     def exists(self) -> bool:
         return os.path.exists(self.path)
 
-    def save(self, round_idx: int, global_params: PyTree) -> None:
+    def save(self, round_idx: int, global_params: PyTree,
+             extra: Optional[dict] = None) -> None:
+        """``extra`` (optional, msgpack-friendly dict): engine-specific state
+        riding the same atomic blob — the buffered-async server stores its
+        model-version log here (committed ``[sender, version]`` pairs plus
+        the commit counters), so a restarted server can dedup re-uploaded
+        updates instead of double-committing them. Absent for synchronous
+        servers; old blobs without the key load unchanged."""
         import numpy as np
 
         from ..comm.message import pack_payload
@@ -89,6 +96,7 @@ class RoundStateStore:
             # MT19937 state tuple, msgpack-friendly (the keys ndarray rides
             # the codec's ndarray ext type)
             "rng_state": [s[0], s[1], int(s[2]), int(s[3]), float(s[4])],
+            **({"extra": extra} if extra is not None else {}),
         })
         d = os.path.dirname(os.path.abspath(self.path))
         os.makedirs(d, exist_ok=True)
@@ -144,6 +152,14 @@ def save_simulator_state(manager: CheckpointManager, sim, round_idx: int) -> Non
     arena = getattr(sim, "_arena", None)
     if arena is not None:
         state["client_arena"] = arena.export_state()
+    # engine hook (duck-typed): the buffered-async engine persists its
+    # model-version counters (committed version, virtual clock, next
+    # generation) as a small scalar dict — checkpoints only fire at
+    # generation boundaries after a buffer flush, so no update stacks ever
+    # need saving and the sync engine's checkpoint format is unchanged
+    export = getattr(sim, "_export_extra_state", None)
+    if export is not None:
+        state["engine_extra"] = export()
     manager.save(round_idx, state)
 
 
@@ -176,4 +192,7 @@ def restore_simulator_state(manager: CheckpointManager, sim) -> int:
     else:
         sim.client_states = {
             int(k): v for k, v in state.get("client_states", {}).items()}
+    imp = getattr(sim, "_import_extra_state", None)
+    if imp is not None and state.get("engine_extra") is not None:
+        imp(state["engine_extra"])
     return int(state["round"]) + 1

@@ -1,0 +1,39 @@
+"""Fixture: the two hazards the buffered-async engine must not grow
+(fed to the checkers under the async_engine relpath). An ingest thread
+folds arriving updates into the commit buffer with no lock against the
+committer, and the straggler delay plan draws from an unseeded RNG —
+the exact races/replay breaks thread-hazard and determinism guard."""
+
+import threading
+
+import numpy as np
+
+
+class BadAsyncServer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buffer = []
+        self._version = 0
+        # unseeded: every replay gets a different delay schedule
+        self._rng = np.random.default_rng()
+
+    def start(self):
+        t = threading.Thread(target=self._ingest_loop, daemon=True)
+        t.start()
+
+    def _ingest_loop(self):
+        while True:
+            update = self._recv()
+            self._buffer.append(update)      # unlocked write from the thread
+            self._version = self._version + 1
+
+    def commit(self):
+        batch = list(self._buffer)           # unlocked read from main
+        self._buffer = []                    # unlocked main-thread write
+        return batch, self._version
+
+    def next_delay(self):
+        return self._rng.exponential()
+
+    def _recv(self):
+        return None

@@ -1,0 +1,39 @@
+"""Fixture: the safe twin of async_engine_bad — every buffer/version
+access from either thread happens under the one lock, and the delay
+plan's RNG stream is derived from the run seed, so a replay with the
+same seed sees the same schedule."""
+
+import threading
+
+import numpy as np
+
+
+class CleanAsyncServer:
+    def __init__(self, seed):
+        self._lock = threading.Lock()
+        self._buffer = []
+        self._version = 0
+        self._rng = np.random.default_rng((int(seed), 0xA5))
+
+    def start(self):
+        t = threading.Thread(target=self._ingest_loop, daemon=True)
+        t.start()
+
+    def _ingest_loop(self):
+        while True:
+            update = self._recv()
+            with self._lock:
+                self._buffer.append(update)
+                self._version = self._version + 1
+
+    def commit(self):
+        with self._lock:
+            batch = list(self._buffer)
+            self._buffer = []
+            return batch, self._version
+
+    def next_delay(self):
+        return self._rng.exponential()
+
+    def _recv(self):
+        return None

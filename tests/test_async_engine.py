@@ -1,0 +1,239 @@
+"""Buffered-async aggregation (PR 14): kill the synchronous round barrier.
+
+Acceptance drills for the FedBuff-style engine: the ``async_buffer_size ==
+cohort`` fallback must replay the synchronous engine bit for bit (including
+the SCAFFOLD control-variate arena and codec error-feedback residuals),
+eval/checkpoint boundaries must flush the partial buffer, a mid-run restart
+must resume from the model-version log with no duplicate or lost committed
+updates, the seeded delay plan must replay exactly, and every commit
+record's phase breakdown must still sum to its wall-clock.
+"""
+
+import math
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.comm import LoopbackHub
+from fedml_tpu.comm.resilience import ClientDelayPlan
+from fedml_tpu.cross_silo import FedML_Horizontal
+from fedml_tpu.simulation import AsyncFedSimulator, FedSimulator, build_simulator
+
+
+def _build(**kw):
+    cfg = dict(
+        dataset="digits", model="lr", partition_method="homo",
+        client_num_in_total=8, client_num_per_round=8, comm_round=6,
+        learning_rate=0.3, epochs=1, batch_size=32,
+        frequency_of_the_test=3, random_seed=0,
+    )
+    cfg.update(kw)
+    args = fedml_tpu.init(config=cfg)
+    return build_simulator(args)
+
+
+def _trees_equal(a, b) -> bool:
+    flat_a, _ = jax.tree.flatten(a)
+    flat_b, _ = jax.tree.flatten(b)
+    return len(flat_a) == len(flat_b) and all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(flat_a, flat_b))
+
+
+# --- off-by-default & lockstep fallback -------------------------------------
+
+
+def test_async_off_by_default_builds_sync_engine():
+    sim, _ = _build()
+    assert type(sim) is FedSimulator
+    sim2, _ = _build(async_mode=True)
+    assert isinstance(sim2, AsyncFedSimulator)
+
+
+@pytest.mark.parametrize("kw", [
+    dict(federated_optimizer="SCAFFOLD", comm_round=4),
+    dict(comm_codec="delta|topk:0.01|q8", comm_round=4),
+], ids=["scaffold_arena", "codec_ef_residuals"])
+def test_lockstep_fallback_bit_exact(kw):
+    """K == cohort with zero skew is the synchronous engine bit for bit —
+    including SCAFFOLD's client control-variate arena and the codec's
+    per-client error-feedback residuals, the two pieces of cross-round
+    state most likely to drift under a reordered fold (the engine rejects
+    the two knobs together, so each variant exercises one)."""
+    sync_sim, sync_apply = _build(**kw)
+    sync_hist = sync_sim.run(sync_apply, log_fn=None)
+    async_sim, async_apply = _build(async_mode=True, **kw)
+    assert async_sim._lockstep
+    async_hist = async_sim.run(async_apply, log_fn=None)
+
+    assert _trees_equal(sync_sim.params, async_sim.params)
+    assert _trees_equal(sync_sim.server_state, async_sim.server_state)
+    assert [h.get("test_acc") for h in sync_hist] \
+        == [h.get("test_acc") for h in async_hist]
+
+
+def test_staleness_scale_none_is_identical_bits():
+    """The robust sanitizer with staleness_scale=None must be byte-for-byte
+    the synchronous code path (the z-scores see unscaled norms)."""
+    from fedml_tpu.core.robust import sanitize_stacked
+
+    rng = np.random.default_rng(7)
+    stacked = {"w": np.asarray(rng.normal(size=(6, 5)), np.float32)}
+    w = np.ones((6,), np.float32)
+    base = sanitize_stacked(stacked, w, 6.0)
+    none = sanitize_stacked(stacked, w, 6.0, staleness_scale=None)
+    assert _trees_equal(base[0], none[0])
+    for got, want in zip(none[1:], base[1:]):
+        assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+# --- buffered regime --------------------------------------------------------
+
+
+def test_buffered_run_commits_phase_sums_and_goodput():
+    """K=2 under 10× seeded skew: one history record per commit, each
+    record's phases summing exactly to its wall-clock, committed updates
+    conserved, staleness bounded, and positive virtual-time goodput."""
+    sim, apply_fn = _build(
+        async_mode=True, async_buffer_size=2, async_delay_skew=10.0)
+    hist = sim.run(apply_fn, log_fn=None)
+    stats = sim.async_stats()
+
+    assert stats["version"] == len(hist)
+    assert stats["committed_updates"] == 6 * 8  # every update lands
+    assert stats["committed_updates"] == sum(h["buffer_fill"] for h in hist)
+    assert stats["virtual_time_s"] > 0
+    assert stats["goodput_updates_per_s"] > 0
+    for h in hist:
+        assert math.isclose(sum(h["phases"].values()), h["round_time"],
+                            rel_tol=1e-6, abs_tol=1e-9)
+    # phase-to-record assignment is by completion interval (deferred
+    # readback), so the commit phase shows up across the run, not
+    # necessarily on every record
+    assert any("commit" in h["phases"] for h in hist)
+    assert max(h["staleness_max"] for h in hist) >= 1  # skew makes staleness
+    assert hist[-1]["test_acc"] > 0.7, hist[-1]
+
+
+def test_eval_mid_buffer_forces_flush():
+    """An eval boundary hitting a partially-filled buffer must flush it:
+    cohort=8 with K=3 leaves 8 mod 3 = 2 updates buffered at every
+    generation boundary, and eval-every-generation must still always see a
+    committed model — so flush records carry test_acc at under-K fill."""
+    sim, apply_fn = _build(
+        async_mode=True, async_buffer_size=3, async_delay_skew=10.0,
+        frequency_of_the_test=1)
+    hist = sim.run(apply_fn, log_fn=None)
+
+    flushed = [h for h in hist if h["buffer_fill"] < 3]
+    assert flushed, "expected partial-buffer flush commits"
+    assert any("test_acc" in h for h in flushed)
+    assert sim.async_stats()["committed_updates"] == 6 * 8
+
+
+def test_delay_plan_replays_exactly():
+    plan_a = ClientDelayPlan(seed=3, base_s=1.0, skew=10.0, jitter=0.2)
+    plan_b = ClientDelayPlan(seed=3, base_s=1.0, skew=10.0, jitter=0.2)
+    plan_c = ClientDelayPlan(seed=4, base_s=1.0, skew=10.0, jitter=0.2)
+    grid_a = [plan_a.delay_s(c, g) for c in range(8) for g in range(6)]
+    grid_b = [plan_b.delay_s(c, g) for c in range(8) for g in range(6)]
+    grid_c = [plan_c.delay_s(c, g) for c in range(8) for g in range(6)]
+    assert grid_a == grid_b
+    assert grid_a != grid_c
+    # the 10× skew actually materializes as a heavy tail
+    assert max(grid_a) / min(grid_a) > 5.0
+
+
+def test_buffered_run_is_deterministic():
+    """Same seed → identical commit schedule, virtual clock, and params."""
+    sim_a, apply_a = _build(
+        async_mode=True, async_buffer_size=2, async_delay_skew=10.0)
+    hist_a = sim_a.run(apply_a, log_fn=None)
+    sim_b, apply_b = _build(
+        async_mode=True, async_buffer_size=2, async_delay_skew=10.0)
+    hist_b = sim_b.run(apply_b, log_fn=None)
+
+    assert _trees_equal(sim_a.params, sim_b.params)
+    assert sim_a.async_stats() == sim_b.async_stats()
+    assert [h["buffer_fill"] for h in hist_a] \
+        == [h["buffer_fill"] for h in hist_b]
+    assert [h["virtual_time_s"] for h in hist_a] \
+        == [h["virtual_time_s"] for h in hist_b]
+
+
+# --- restart without round boundaries ---------------------------------------
+
+
+def test_checkpoint_resume_mid_buffer_no_lost_or_duplicate_updates(tmp_path):
+    """Interrupt after 4 of 6 generations and resume from the model-version
+    log: the resumed run must land bit-exact on an uninterrupted run with
+    the same checkpoint cadence (checkpoint boundaries force buffer
+    flushes, so the cadence is part of the commit partitioning)."""
+    kw = dict(async_mode=True, async_buffer_size=3, async_delay_skew=10.0,
+              checkpoint_frequency=2)
+
+    full_sim, full_apply = _build(checkpoint_dir=str(tmp_path / "full"), **kw)
+    full_sim.run(full_apply, log_fn=None)
+    full_stats = full_sim.async_stats()
+
+    part_sim, part_apply = _build(
+        checkpoint_dir=str(tmp_path / "part"), comm_round=4, **kw)
+    part_sim.run(part_apply, log_fn=None)
+    interrupted = part_sim.async_stats()
+
+    res_sim, res_apply = _build(
+        checkpoint_dir=str(tmp_path / "part"), **kw)
+    res_sim.run(res_apply, log_fn=None)
+    resumed = res_sim.async_stats()
+
+    assert interrupted["version"] < resumed["version"]  # it actually resumed
+    assert resumed == full_stats  # version/committed/virtual-time conserved
+    assert _trees_equal(res_sim.params, full_sim.params)
+
+
+# --- cross-silo FSM ---------------------------------------------------------
+
+
+def _silo_args(**kw):
+    base = dict(
+        dataset="mnist", model="lr", debug_small_data=True,
+        client_num_in_total=3, client_num_per_round=3, comm_round=6,
+        learning_rate=0.1, epochs=1, batch_size=8, frequency_of_the_test=1,
+        random_seed=0,
+    )
+    base.update(kw)
+    return fedml_tpu.init(config=base)
+
+
+def test_cross_silo_async_loopback_full_run():
+    """The live server FSM in async mode: 3 free-running silos, K=2 —
+    comm_round counts commits, every upload is folded (none shed at this
+    scale), and the model still learns."""
+    args = _silo_args(async_mode=True, async_buffer_size=2)
+    hub = LoopbackHub()
+    server = FedML_Horizontal(args, 0, 3, backend="LOOPBACK", hub=hub)
+    clients = [FedML_Horizontal(args, r, 3, backend="LOOPBACK", hub=hub)
+               for r in range(1, 4)]
+    threads = [threading.Thread(target=c.run, daemon=True) for c in clients]
+    for t in threads:
+        t.start()
+    server.start()
+    server.run()
+    for t in threads:
+        t.join(timeout=60)
+
+    assert server.model_version == 6
+    assert len(server.history) == 6
+    assert server.committed_updates == 6 * 2
+    assert server.shed_updates == 0
+    assert all(h["n_updates"] == 2 for h in server.history)
+    assert server.history[-1]["test_acc"] > 0.4, server.history[-1]
+
+
+def test_cross_silo_async_rejects_watchdog():
+    args = _silo_args(async_mode=True, watchdog_factor=3.0)
+    with pytest.raises(ValueError, match="watchdog"):
+        FedML_Horizontal(args, 0, 3, backend="LOOPBACK", hub=LoopbackHub())

@@ -331,3 +331,19 @@ def test_chaos_disabled_fault_config_is_byte_identical():
     baseline = _recorded_run()
     with_keys = _recorded_run(**disabled)
     assert baseline == with_keys
+
+
+def test_straggler_drill_gates_goodput_and_accuracy():
+    """The buffered-async straggler drill (PR 14 acceptance): under 10×
+    seeded heavy-tail skew the async engine's goodput (committed updates
+    per virtual second) must beat the synchronous round rate ≥3× with
+    final accuracy within 2% of the sync run — and the drill's json_record
+    must carry the gate verdicts for the bench artifact."""
+    from fedml_tpu.cross_silo.chaos import run_straggler_drill
+
+    result = run_straggler_drill()
+    assert result.ok, result.summary()
+    assert result.goodput_ratio >= 3.0
+    assert abs(result.acc_delta) <= 0.02
+    rec = result.json_record()
+    assert rec["ok"] and rec["goodput_ratio"] >= 3.0

@@ -332,6 +332,37 @@ def test_thread_hazard_ignores_out_of_scope_files():
     assert findings == []
 
 
+# ------------------------------------------- async engine (thread + replay)
+
+_ASYNC_ENGINE = "fedml_tpu/simulation/async_engine.py"
+
+
+def test_async_engine_scope_fires_on_bad_fixture():
+    # the buffered-async module is in thread-hazard scope: an ingest
+    # thread folding into the commit buffer without the committer's lock
+    # must fire, and so must an unseeded delay-plan RNG (determinism —
+    # a replayed straggler schedule would diverge)
+    hazards = _run_on_fixture(
+        ThreadHazardChecker, "async_engine_bad.py", relpath=_ASYNC_ENGINE)
+    keys = {f.key for f in hazards}
+    assert "hazard:BadAsyncServer._buffer" in keys
+    assert "hazard:BadAsyncServer._version" in keys
+    det = _run_on_fixture(
+        DeterminismChecker, "async_engine_bad.py", relpath=_ASYNC_ENGINE)
+    assert any("default_rng" in f.message for f in det)
+
+
+def test_async_engine_scope_silent_on_clean_fixture():
+    # lock-protected fold/commit + seed-derived RNG stream: both checkers
+    # stay quiet, so the real module's discipline is the enforced shape
+    assert _run_on_fixture(
+        ThreadHazardChecker, "async_engine_clean.py",
+        relpath=_ASYNC_ENGINE) == []
+    assert _run_on_fixture(
+        DeterminismChecker, "async_engine_clean.py",
+        relpath=_ASYNC_ENGINE) == []
+
+
 # ----------------------------------------------------------- suppression
 
 def _no_print_over(tmp_path, source):
