@@ -837,7 +837,31 @@ def chaos_bench(seed: int = 7) -> int:
     }
     print(json.dumps(line), flush=True)
     print(byz.summary(), file=sys.stderr, flush=True)
-    return 0 if byz_ok else 1
+    if not byz_ok:
+        return 1
+
+    # third + fourth scenarios: the hierarchical-federation failure domain —
+    # a leaf aggregator killed mid-generation (its shard rehydrates on a
+    # survivor) and a root<->leaf partition that heals after one round
+    # window. Both gate exactly-once commits and accuracy against the
+    # fault-free single-process reference.
+    from fedml_tpu.cross_silo.chaos import run_tier_drill
+
+    rc = 0
+    for scenario in ("leaf_crash", "partition"):
+        tier = run_tier_drill(scenario=scenario, random_seed=seed)
+        line = {
+            "metric": f"chaos_tier_{scenario}",
+            "unit": ("client updates committed exactly once under a "
+                     f"{scenario.replace('_', ' ')} (seed={seed}); accuracy "
+                     "gated against the fault-free reference"),
+            **tier.json_record(),
+        }
+        print(json.dumps(line), flush=True)
+        print(tier.summary(), file=sys.stderr, flush=True)
+        if not tier.ok:
+            rc = 1
+    return rc
 
 
 def codec_sweep_bench(specs=("q8", "delta|topk:0.05|q8", "delta|topk:0.01|q8"),

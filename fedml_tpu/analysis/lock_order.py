@@ -44,6 +44,8 @@ SCOPE_FILES = (
     "fedml_tpu/cli/runner.py",
     "fedml_tpu/simulation/prefetch.py",
     "fedml_tpu/simulation/multi_run.py",
+    "fedml_tpu/simulation/federation.py",
+    "fedml_tpu/simulation/hierarchical.py",
 )
 
 LOCK_FACTORIES = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}

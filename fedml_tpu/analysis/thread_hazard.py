@@ -55,6 +55,8 @@ SCOPE_FILES = (
     "fedml_tpu/simulation/prefetch.py",
     "fedml_tpu/simulation/multi_run.py",
     "fedml_tpu/simulation/async_engine.py",
+    "fedml_tpu/simulation/federation.py",
+    "fedml_tpu/simulation/hierarchical.py",
 )
 
 # attributes bound to these factories synchronize internally (or are the

@@ -300,6 +300,16 @@ def run(config_file, backend, flight_record):
                    "engines under one seeded heavy-tail delay plan; gates "
                    "async goodput >= --min-goodput-ratio x the sync round "
                    "rate at final accuracy within --max-acc-delta.")
+@click.option("--leaf-crash", "tier_scenario", flag_value="leaf_crash",
+              default=None,
+              help="Run the hierarchical-federation drill instead: kill a "
+                   "leaf aggregator mid-generation and gate that failover "
+                   "commits every surviving client's update exactly once "
+                   "within --max-acc-delta of the fault-free run.")
+@click.option("--partition", "tier_scenario", flag_value="partition",
+              help="Hierarchical drill variant: cut root<->leaf for one "
+                   "round window, verify the cut heals and the same "
+                   "exactly-once + accuracy gates hold.")
 @click.option("--skew", default=10.0, type=float,
               help="Straggler drill: slowest/fastest client speed ratio.")
 @click.option("--buffer-size", default=2, type=int,
@@ -311,13 +321,26 @@ def run(config_file, backend, flight_record):
 def chaos_drill(seed, rounds, clients, drop_rate, duplicate_rate,
                 fail_send_rate, crash_rank, crash_at_round, byzantine_kind,
                 byzantine_rate, byzantine_scale, defend, codec, timeout,
-                tenant, flight_record, flight_dir, as_json, straggler, skew,
-                buffer_size, min_goodput_ratio, max_acc_delta):
+                tenant, flight_record, flight_dir, as_json, straggler,
+                tier_scenario, skew, buffer_size, min_goodput_ratio,
+                max_acc_delta):
     """Stand up a full cross-silo deployment (server + clients, real codec,
     real round FSM) under the given fault plan and verify every round still
     closes. Exits 1 if the run hangs or loses rounds — the same check
     ``tests/test_chaos.py`` gates CI with, runnable against any config."""
     from ..cross_silo.chaos import run_chaos_drill
+
+    if tier_scenario is not None:
+        from ..cross_silo.chaos import run_tier_drill
+
+        result = run_tier_drill(
+            scenario=tier_scenario, max_acc_delta=max_acc_delta,
+            random_seed=seed, comm_round=rounds)
+        click.echo(json.dumps(result.json_record()) if as_json
+                   else result.summary())
+        if not result.ok:
+            raise SystemExit(1)
+        return
 
     if straggler:
         from ..cross_silo.chaos import run_straggler_drill

@@ -32,7 +32,7 @@ import hashlib
 import logging
 import threading
 import time
-from typing import Callable, FrozenSet, Optional, Sequence, Tuple
+from typing import Callable, Dict, FrozenSet, Optional, Sequence, Tuple
 
 from ..core import telemetry
 from .base import BaseCommunicationManager, Observer, dispatch_to_observers
@@ -264,6 +264,55 @@ def retry_send(
             attempt += 1
 
 
+# --- lease table (tier heartbeat protocol) -----------------------------------
+
+
+class LeaseTable:
+    """Heartbeat-renewed lease tracker for the tiered federation plane.
+
+    The root grants each leaf aggregator a lease that the leaf renews with
+    every heartbeat (and every protocol message — any sign of life counts).
+    A leaf whose lease outlives ``ttl_s`` without a renewal is *expired*:
+    the root treats it as dead, reassigns its clients, and only re-admits it
+    through the explicit join path. Monotonic clock, injectable for tests.
+    """
+
+    def __init__(self, ttl_s: float = 5.0, clock: Callable[[], float] = time.monotonic):
+        self.ttl_s = float(ttl_s)
+        self._clock = clock
+        self._renewed: Dict[int, float] = {}
+        self._lock = threading.Lock()
+
+    def renew(self, rank: int) -> None:
+        with self._lock:
+            self._renewed[int(rank)] = self._clock()
+
+    def drop(self, rank: int) -> None:
+        with self._lock:
+            self._renewed.pop(int(rank), None)
+
+    def live(self) -> Tuple[int, ...]:
+        now = self._clock()
+        with self._lock:
+            return tuple(sorted(r for r, t in self._renewed.items()
+                                if now - t <= self.ttl_s))
+
+    def expired(self) -> Tuple[int, ...]:
+        """Ranks whose lease lapsed. Does NOT drop them — the caller decides
+        (the root drops only after failover completes, so a verdict is never
+        lost to a race with a late heartbeat)."""
+        now = self._clock()
+        with self._lock:
+            return tuple(sorted(r for r, t in self._renewed.items()
+                                if now - t > self.ttl_s))
+
+    def holds(self, rank: int) -> bool:
+        now = self._clock()
+        with self._lock:
+            t = self._renewed.get(int(rank))
+            return t is not None and now - t <= self.ttl_s
+
+
 # --- fault plan --------------------------------------------------------------
 
 FAULT_ACTIONS = ("drop", "delay", "duplicate", "fail_send")
@@ -332,6 +381,46 @@ class FaultRule:
         return True
 
 
+@dataclasses.dataclass(frozen=True)
+class NetworkPartition:
+    """Seeded network partition: traffic crossing the cut between rank-set
+    A and rank-set B is black-holed during the ``[start, stop)`` round
+    window. ``rate`` < 1.0 models a flaky (lossy, not absolute) cut. The
+    draw key is the canonical rank-set pair + window, so the same partition
+    injects at the same messages regardless of which side evaluates it."""
+
+    ranks_a: FrozenSet[int]
+    ranks_b: FrozenSet[int]
+    rounds: Optional[Tuple[int, int]] = None     # [start, stop) window
+    rate: float = 1.0
+
+    def __post_init__(self):
+        if self.ranks_a & self.ranks_b:
+            raise ValueError(
+                f"partition rank sets overlap: {sorted(self.ranks_a & self.ranks_b)}")
+
+    @property
+    def key(self) -> str:
+        """Canonical identity of this cut: sorted rank-set pair + window
+        (the satellite's sha256 keying contract)."""
+        a, b = sorted(self.ranks_a), sorted(self.ranks_b)
+        lo, hi = (a, b) if a <= b else (b, a)
+        return f"{lo}|{hi}|{self.rounds}"
+
+    def crosses(self, sender: int, receiver: int) -> bool:
+        s, r = int(sender), int(receiver)
+        return ((s in self.ranks_a and r in self.ranks_b)
+                or (s in self.ranks_b and r in self.ranks_a))
+
+    def in_window(self, round_idx: Optional[int]) -> bool:
+        if self.rounds is None:
+            return True
+        if round_idx is None:
+            return False  # round-less traffic skips a windowed cut
+        start, stop = self.rounds
+        return start <= round_idx < stop
+
+
 @dataclasses.dataclass
 class FaultDecision:
     """Resolved plan outcome for one concrete message send."""
@@ -369,7 +458,13 @@ class FaultPlan:
                  byzantine_ranks: Optional[FrozenSet[int]] = None,
                  byzantine_scale: float = 10.0,
                  byzantine_std: float = 1.0,
-                 byzantine_rounds: Optional[Tuple[int, int]] = None):
+                 byzantine_rounds: Optional[Tuple[int, int]] = None,
+                 partition: Optional[NetworkPartition] = None,
+                 leaf_crash_rank: Optional[int] = None,
+                 leaf_crash_at_round: Optional[int] = None,
+                 slow_leaf_ranks: Optional[FrozenSet[int]] = None,
+                 slow_leaf_delay_s: float = 0.5,
+                 slow_leaf_rounds: Optional[Tuple[int, int]] = None):
         self.seed = int(seed)
         self.rules = tuple(rules)
         self.crash_rank = crash_rank if crash_rank is None else int(crash_rank)
@@ -388,13 +483,30 @@ class FaultPlan:
         self.byzantine_rounds = (
             None if byzantine_rounds is None
             else (int(byzantine_rounds[0]), int(byzantine_rounds[1])))
+        # process-level kinds (tiered federation): a partition cut, a leaf
+        # aggregator crash, and a deterministically slow leaf
+        self.partition = partition
+        self.leaf_crash_rank = (leaf_crash_rank if leaf_crash_rank is None
+                                else int(leaf_crash_rank))
+        self.leaf_crash_at_round = (leaf_crash_at_round
+                                    if leaf_crash_at_round is None
+                                    else int(leaf_crash_at_round))
+        self.slow_leaf_ranks = (None if slow_leaf_ranks is None
+                                else frozenset(int(r) for r in slow_leaf_ranks))
+        self.slow_leaf_delay_s = float(slow_leaf_delay_s)
+        self.slow_leaf_rounds = (
+            None if slow_leaf_rounds is None
+            else (int(slow_leaf_rounds[0]), int(slow_leaf_rounds[1])))
         self._seq = {}
         self._lock = threading.Lock()
 
     @property
     def active(self) -> bool:
         return (bool(self.rules) or self.crash_rank is not None
-                or self.byzantine_kind is not None)
+                or self.byzantine_kind is not None
+                or self.partition is not None
+                or self.leaf_crash_rank is not None
+                or self.slow_leaf_ranks is not None)
 
     def _next_seq(self, edge: str) -> int:
         with self._lock:
@@ -422,7 +534,48 @@ class FaultPlan:
                                       min(rule.delay_s, MAX_INJECTED_DELAY_S))
                 elif rule.action == "duplicate":
                     out.duplicate = True
+        if (self.slow_leaf_ranks is not None
+                and int(msg.get_sender_id()) in self.slow_leaf_ranks):
+            start, stop = self.slow_leaf_rounds or (0, None)
+            if rnd is None or (rnd >= start
+                               and (stop is None or rnd < stop)):
+                # a slow leaf delays every message it originates — bounded,
+                # so chaos perturbs ordering without stalling suites
+                out.delay_s = max(out.delay_s, min(self.slow_leaf_delay_s,
+                                                   MAX_INJECTED_DELAY_S))
         return out
+
+    def should_partition(self, msg: Message,
+                         round_hint: Optional[int] = None) -> bool:
+        """Whether this message crosses an active partition cut. Keyed by
+        the canonical rank-set pair + round window + edge + per-edge
+        sequence (its own sequence space, so adding a partition does not
+        reshuffle the wire-fault or byzantine draws).
+
+        ``round_hint`` is the evaluating process's round clock (the max
+        round it has witnessed): a cut-off peer keeps stamping messages with
+        its last-known round, so a windowed cut is judged against
+        ``max(message round, local clock)`` — otherwise stale heartbeats
+        would tunnel through the window and the far side would never detect
+        the partition. Evaluated at the receiver (see
+        ``FaultyCommManager.receive_message``), whose view is fresh whenever
+        either endpoint has advanced past the window."""
+        if self.partition is None:
+            return False
+        sender, receiver = msg.get_sender_id(), msg.get_receiver_id()
+        if not self.partition.crosses(sender, receiver):
+            return False
+        rnd = message_round(msg)
+        if round_hint is not None:
+            rnd = round_hint if rnd is None else max(rnd, round_hint)
+        if not self.partition.in_window(rnd):
+            return False
+        if self.partition.rate >= 1.0:
+            return True
+        edge = f"{sender}->{receiver}:{msg.get_type()}"
+        seq = self._next_seq(f"part:{edge}")
+        return _hash_fraction(self.seed, "partition", self.partition.key,
+                              edge, seq) < self.partition.rate
 
     def should_fail_send(self, msg: Message, seq: int, attempt: int,
                          copy: int = 0) -> bool:
@@ -466,6 +619,20 @@ class FaultPlan:
                 and self.crash_at_round is not None
                 and round_idx >= self.crash_at_round)
 
+    def should_crash_leaf(self, rank: int, round_idx: Optional[int]) -> bool:
+        """Process-level leaf-aggregator crash: a distinct config surface
+        from the flat client crash so a tier drill can kill a leaf without
+        touching the client-crash knobs. :class:`FaultyCommManager` applies
+        it on the SEND path only — the leaf dies mid-generation, after
+        computing (and persisting) its partial but while uploading it, which
+        is the hard failover case (work exists on disk but never reached the
+        root)."""
+        return (self.leaf_crash_rank is not None
+                and rank == self.leaf_crash_rank
+                and round_idx is not None
+                and self.leaf_crash_at_round is not None
+                and round_idx >= self.leaf_crash_at_round)
+
     # --- config surface -----------------------------------------------------
 
     @classmethod
@@ -503,6 +670,29 @@ class FaultPlan:
         byz_rounds = getattr(args, "fault_byzantine_rounds", None)
         if byz_rounds is not None:
             byz_rounds = (int(byz_rounds[0]), int(byz_rounds[1]))
+        partition = None
+        part_a = getattr(args, "fault_partition_ranks_a", None)
+        part_b = getattr(args, "fault_partition_ranks_b", None)
+        if part_a and part_b:
+            part_rounds = getattr(args, "fault_partition_rounds", None)
+            if part_rounds is not None:
+                part_rounds = (int(part_rounds[0]), int(part_rounds[1]))
+            partition = NetworkPartition(
+                ranks_a=frozenset(int(r) for r in part_a),
+                ranks_b=frozenset(int(r) for r in part_b),
+                rounds=part_rounds,
+                rate=float(getattr(args, "fault_partition_rate", 1.0)),
+            )
+        leaf_crash_rank = getattr(args, "fault_leaf_crash_rank", None)
+        leaf_crash_at = getattr(args, "fault_leaf_crash_at_round", None)
+        if leaf_crash_rank is not None and leaf_crash_at is None:
+            leaf_crash_at = 1
+        slow_ranks = getattr(args, "fault_slow_leaf_ranks", None)
+        if slow_ranks is not None:
+            slow_ranks = frozenset(int(r) for r in slow_ranks)
+        slow_rounds = getattr(args, "fault_slow_leaf_rounds", None)
+        if slow_rounds is not None:
+            slow_rounds = (int(slow_rounds[0]), int(slow_rounds[1]))
         plan = cls(
             seed=int(getattr(args, "fault_seed", 0)),
             rules=rules,
@@ -515,6 +705,13 @@ class FaultPlan:
             byzantine_scale=float(getattr(args, "fault_byzantine_scale", 10.0)),
             byzantine_std=float(getattr(args, "fault_byzantine_std", 1.0)),
             byzantine_rounds=byz_rounds,
+            partition=partition,
+            leaf_crash_rank=leaf_crash_rank,
+            leaf_crash_at_round=leaf_crash_at,
+            slow_leaf_ranks=slow_ranks,
+            slow_leaf_delay_s=float(
+                getattr(args, "fault_slow_leaf_delay_s", 0.5)),
+            slow_leaf_rounds=slow_rounds,
         )
         return plan if plan.active else None
 
@@ -547,7 +744,16 @@ class FaultyCommManager(BaseCommunicationManager, Observer):
                                       type(inner).__name__)
         self._observers = []
         self._dead = threading.Event()
+        # max round this process has witnessed in either direction — the
+        # round_hint for windowed partition cuts (see should_partition)
+        self._round_clock: Optional[int] = None
         inner.add_observer(self)
+
+    def _tick_clock(self, rnd: Optional[int]) -> Optional[int]:
+        if rnd is not None and (self._round_clock is None
+                                or rnd > self._round_clock):
+            self._round_clock = rnd
+        return self._round_clock
 
     @property
     def crashed(self) -> bool:
@@ -573,7 +779,10 @@ class FaultyCommManager(BaseCommunicationManager, Observer):
     def send_message(self, msg: Message) -> None:
         if self._dead.is_set():
             return  # a dead process sends nothing
-        if self.plan.should_crash(self.rank, message_round(msg)):
+        rnd = message_round(msg)
+        clock = self._tick_clock(rnd)
+        if (self.plan.should_crash(self.rank, rnd)
+                or self.plan.should_crash_leaf(self.rank, rnd)):
             self._die("send")
             return
         self._maybe_corrupt_upload(msg)
@@ -644,8 +853,24 @@ class FaultyCommManager(BaseCommunicationManager, Observer):
     def receive_message(self, msg_type, msg: Message) -> None:
         if self._dead.is_set():
             return
-        if self.plan.should_crash(self.rank, message_round(msg)):
+        rnd = message_round(msg)
+        clock = self._tick_clock(rnd)
+        if self.plan.should_crash(self.rank, rnd):
             self._die("receive")
+            return
+        if self.plan.should_partition(msg, round_hint=clock):
+            # partitions are enforced at the RECEIVER only. A cut-off peer's
+            # clock is stale (that is what being cut off means), so judging
+            # the window on its send side would black-hole its traffic
+            # forever — the partition could never heal. At the receiver,
+            # max(message round, local clock) is fresh whenever either side
+            # has advanced: the live side's clock ticks with the round, and
+            # its outbound messages carry fresh round stamps that un-stick
+            # the stale side's clock the moment the window closes.
+            telemetry.record_fault("partition")
+            logging.info("fault: partition drops msg type=%r %d->%d",
+                         msg.get_type(), msg.get_sender_id(),
+                         msg.get_receiver_id())
             return
         dispatch_to_observers(msg, self._observers)
 

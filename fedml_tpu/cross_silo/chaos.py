@@ -276,6 +276,170 @@ def run_straggler_drill(min_goodput_ratio: float = 3.0,
     )
 
 
+TIER_DEFAULTS = dict(
+    dataset="mnist",
+    model="lr",
+    debug_small_data=True,
+    client_num_in_total=6,
+    client_num_per_round=4,
+    comm_round=3,
+    learning_rate=0.1,
+    epochs=1,
+    batch_size=8,
+    frequency_of_the_test=1,
+    random_seed=0,
+    # the tier plane: 1 root + 2 leaf aggregators over loopback, aggressive
+    # lease cadence so a killed leaf is detected within the drill's budget
+    hier_num_leaves=2,
+    group_comm_round=2,
+    lease_ttl_s=0.5,
+    lease_heartbeat_s=0.1,
+    hier_round_timeout_s=30.0,
+    hier_join_timeout_s=20.0,
+)
+
+
+@dataclasses.dataclass
+class TierDrillResult:
+    """Outcome of one hierarchical-federation drill (leaf crash or
+    partition): did the run survive the fault, was every surviving client's
+    update committed exactly once, and did the final model stay within the
+    accuracy gate of the fault-free reference?"""
+
+    scenario: str                 # "leaf_crash" | "partition"
+    rounds_completed: int
+    rounds_expected: int
+    failovers: int                # lease expiries that triggered reassignment
+    rehydrations: int             # chunks recovered from a dead leaf's shard
+    committed_updates: int        # client updates folded, across all rounds
+    expected_updates: int         # rounds x cohort — what exactly-once means
+    duplicate_commits: int        # ledger-caught double-folds (must be 0)
+    faults_injected: Dict[str, float]
+    fault_free_acc: float
+    faulted_acc: float
+    elapsed_s: float
+    max_acc_delta: float = 0.02
+    history: List[dict] = dataclasses.field(default_factory=list)
+
+    @property
+    def acc_delta(self) -> float:
+        return self.fault_free_acc - self.faulted_acc
+
+    @property
+    def ok(self) -> bool:
+        return (self.rounds_completed >= self.rounds_expected
+                and self.failovers >= 1          # the fault actually fired
+                and self.duplicate_commits == 0
+                and self.committed_updates == self.expected_updates
+                and self.acc_delta <= self.max_acc_delta)
+
+    def summary(self) -> str:
+        return (
+            f"tier drill [{self.scenario}]: {'PASS' if self.ok else 'FAIL'}"
+            f" — {self.rounds_completed}/{self.rounds_expected} rounds in "
+            f"{self.elapsed_s:.1f}s | failovers={self.failovers} "
+            f"rehydrations={self.rehydrations} | committed "
+            f"{self.committed_updates}/{self.expected_updates} updates, "
+            f"{self.duplicate_commits} duplicates | acc faulted "
+            f"{self.faulted_acc:.4f} vs fault-free {self.fault_free_acc:.4f}"
+            f" (delta {self.acc_delta:+.4f}, gate <={self.max_acc_delta:.2f})"
+        )
+
+    def json_record(self) -> dict:
+        """Same single-reporter contract as :meth:`ChaosDrillResult.
+        json_record` — one JSON-able dict behind ``bench.py --chaos`` and
+        ``fedml-tpu chaos-drill --leaf-crash/--partition --json``."""
+        return {
+            "scenario": self.scenario,
+            "rounds_completed": self.rounds_completed,
+            "rounds_expected": self.rounds_expected,
+            "failovers": self.failovers,
+            "rehydrations": self.rehydrations,
+            "committed_updates": self.committed_updates,
+            "expected_updates": self.expected_updates,
+            "duplicate_commits": self.duplicate_commits,
+            "faults_injected": {k: int(v)
+                                for k, v in sorted(self.faults_injected.items())},
+            "fault_free_acc": round(self.fault_free_acc, 6),
+            "faulted_acc": round(self.faulted_acc, 6),
+            "acc_delta": round(self.acc_delta, 6),
+            "elapsed_s": round(self.elapsed_s, 3),
+            "ok": self.ok,
+        }
+
+
+def run_tier_drill(scenario: str = "leaf_crash",
+                   max_acc_delta: float = 0.02,
+                   **overrides) -> TierDrillResult:
+    """Run one hierarchical-federation failure drill over loopback.
+
+    ``leaf_crash`` kills leaf aggregator 1 mid-generation (it computes and
+    persists its shard, then dies uploading — the rehydration path's exact
+    cut point); ``partition`` cuts root<->leaf-1 for one round window and
+    lets the cut heal. Both run a fault-free single-process reference over
+    the same seed first, so the accuracy gate — and in practice bit-identical
+    params — pins that failover loses no client update and commits none
+    twice."""
+    import tempfile
+    import time as _time
+
+    import fedml_tpu
+    from ..core import telemetry
+    from ..simulation.federation import (build_tiered_simulator,
+                                         run_tiered_federation)
+
+    if scenario not in ("leaf_crash", "partition"):
+        raise ValueError(f"unknown tier drill scenario: {scenario!r}")
+    cfg = dict(TIER_DEFAULTS)
+    cfg.update(overrides)
+    rounds = int(cfg["comm_round"])
+    cohort = int(cfg["client_num_per_round"])
+    t0 = _time.perf_counter()
+
+    # fault-free reference: the single-process driver (same chunks, same
+    # leaf program, same fold — minus the wire and minus the fault plan)
+    ref_sim, ref_apply = build_tiered_simulator(fedml_tpu.init(config=cfg))
+    ref_hist = ref_sim.run(ref_apply, log_fn=None)
+
+    faulted = dict(cfg)
+    if scenario == "leaf_crash":
+        faulted.setdefault("hier_shard_dir", tempfile.mkdtemp(
+            prefix="tier_drill_shards_"))
+        faulted.update(fault_leaf_crash_rank=1, fault_leaf_crash_at_round=1)
+    else:
+        faulted.update(fault_partition_ranks_a=[0],
+                       fault_partition_ranks_b=[1],
+                       fault_partition_rounds=(1, 2))
+
+    registry = telemetry.get_registry()
+    before = registry.snapshot()["counters"] if telemetry.enabled() else {}
+    root = run_tiered_federation(fedml_tpu.init(config=faulted))
+    after = registry.snapshot()["counters"] if telemetry.enabled() else {}
+
+    def delta(name, label=None):
+        a = _label_totals(after, name, label)
+        b = _label_totals(before, name, label)
+        return {k: v - b.get(k, 0.0) for k, v in a.items()}
+
+    ledger = root.state.ledger
+    return TierDrillResult(
+        scenario=scenario,
+        rounds_completed=len(root.history),
+        rounds_expected=rounds,
+        failovers=int(root.failovers),
+        rehydrations=int(root.rehydrations),
+        committed_updates=int(ledger.total_commits),
+        expected_updates=rounds * cohort,
+        duplicate_commits=int(ledger.duplicates),
+        faults_injected=delta("fedml_faults_injected_total", "action"),
+        fault_free_acc=_final_acc(ref_hist),
+        faulted_acc=_final_acc(root.history),
+        elapsed_s=_time.perf_counter() - t0,
+        max_acc_delta=float(max_acc_delta),
+        history=list(root.history),
+    )
+
+
 def _label_totals(counters: Dict[str, float], name: str,
                   label: Optional[str] = None,
                   where: Optional[Dict[str, str]] = None) -> Dict[str, float]:

@@ -20,14 +20,91 @@ inserts, not a DDP hook.
 from __future__ import annotations
 
 import logging
-from typing import Optional
+import threading
+from typing import Callable, Optional
 
 import jax
 import numpy as np
 
+from ..comm.message import Message
+from ..comm.resilience import SendFailure
 from .client_manager import FedMLClientManager
 
 FINISH_SENTINEL = -1
+
+
+class TierMsg:
+    """Message vocabulary of the tiered (root <-> leaf-aggregator) plane.
+
+    A separate namespace from :class:`.message_define.MyMessage` — tier
+    traffic rides the same transports but is a different protocol (leaf
+    aggregators are *processes*, not clients), and keeping the vocabularies
+    disjoint means a flat cross-silo deployment's wire format is untouched
+    by the tier plane existing (failover off ⇒ byte-identical frames).
+    """
+
+    MSG_TYPE_DISPATCH = "tier_dispatch"      # root -> leaf: round work order
+    MSG_TYPE_PARTIAL = "tier_partial"        # leaf -> root: partial aggregate
+    MSG_TYPE_HEARTBEAT = "tier_heartbeat"    # leaf -> root: lease renewal
+    MSG_TYPE_JOIN = "tier_join"              # leaf -> root: (re)join request
+    MSG_TYPE_SYNC = "tier_sync"              # root -> leaf: adoption/re-sync
+    MSG_TYPE_FINISH = "tier_finish"          # root -> leaf: run over
+
+    # the round index rides the same param key the resilience plane reads
+    # (comm.resilience.ROUND_IDX_PARAM), so round-windowed fault rules and
+    # crash plans see tier traffic exactly like flat cross-silo traffic
+    ARG_ROUND_IDX = "round_idx"
+    ARG_MODEL_PARAMS = "model_params"
+    ARG_MODEL_VERSION = "model_version"
+    ARG_COHORT_SIZE = "cohort_size"
+    ARG_CHUNKS = "chunks"                    # list of {lo, client_ids}
+    ARG_PARTIALS = "partials"                # list of partial records
+    ARG_LEAF_RANK = "leaf_rank"
+
+
+class HeartbeatSender:
+    """Daemon thread renewing a leaf's lease at the root.
+
+    Sends one :data:`TierMsg.MSG_TYPE_HEARTBEAT` every ``interval_s``,
+    stamped with the leaf's current round (``round_fn``) so round-windowed
+    chaos (partitions, leaf crashes) applies to heartbeats the same way it
+    applies to protocol traffic. Send failures are swallowed — a heartbeat
+    that cannot reach the root IS the failure signal (the lease lapses)."""
+
+    def __init__(self, send_fn: Callable[[Message], None], rank: int,
+                 root_rank: int = 0, interval_s: float = 0.5,
+                 round_fn: Callable[[], int] = lambda: 0):
+        self._send = send_fn
+        self.rank = int(rank)
+        self.root_rank = int(root_rank)
+        self.interval_s = float(interval_s)
+        self._round_fn = round_fn
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            msg = Message(TierMsg.MSG_TYPE_HEARTBEAT, self.rank,
+                          self.root_rank)
+            msg.add_params(TierMsg.ARG_ROUND_IDX, int(self._round_fn()))
+            try:
+                self._send(msg)
+            except SendFailure:
+                logging.debug("leaf %d: heartbeat to root undeliverable",
+                              self.rank)
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"tier-heartbeat-{self.rank}")
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2 * self.interval_s + 1.0)
+            self._thread = None
 
 
 class SlaveSync:

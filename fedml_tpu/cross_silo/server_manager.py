@@ -26,7 +26,7 @@ from ..comm import codec as comm_codec
 from ..comm.resilience import SendFailure
 from ..comm.utils import log_round_end, log_round_start
 from ..core import telemetry, trace_plane
-from ..utils.checkpoint import RoundStateStore
+from ..utils.checkpoint import RoundStateStore, trim_version_log
 from .message_define import MyMessage
 
 
@@ -93,6 +93,14 @@ class FedMLServerManager(ServerManager):
         self.committed_updates = 0
         self.shed_updates = 0
         self._client_seq: Dict[int, int] = {}
+        # model-version log: one ``[version, n_updates, senders]`` entry per
+        # commit, bounded to the last ``round_store_keep_versions`` entries
+        # (<= 0 = unbounded) — resume only ever consults the tail, so the
+        # checkpoint blob stays O(keep), not O(run length)
+        self._version_log: List[list] = []
+        self._pending_senders: List[int] = []
+        self.keep_versions = int(
+            getattr(args, "round_store_keep_versions", 32) or 0)
         if self.async_mode:
             if float(getattr(args, "watchdog_factor", 0.0) or 0.0) > 0:
                 raise ValueError(
@@ -142,6 +150,8 @@ class FedMLServerManager(ServerManager):
                 self._client_seq = {
                     int(c): int(s)
                     for c, s in (extra.get("client_seq") or {}).items()}
+                self._version_log = [
+                    list(e) for e in (extra.get("version_log") or [])]
                 self.round_idx = self.model_version
             logging.warning(
                 "server: resumed round state from %s — continuing at round "
@@ -531,6 +541,7 @@ class FedMLServerManager(ServerManager):
                     self._adrr.charge(tenant, 1.0)
                     self.aggregator.add_async_result(
                         sender, model_params, local_sample_num, staleness)
+                    self._pending_senders.append(sender)
                     reg = telemetry.get_registry()
                     if reg.enabled:
                         reg.histogram(
@@ -596,6 +607,11 @@ class FedMLServerManager(ServerManager):
                     self.model_version) or {}
         self.model_version += 1
         self.committed_updates += n
+        self._version_log.append([int(self.model_version), int(n),
+                                  sorted(self._pending_senders)])
+        self._pending_senders = []
+        self._version_log = trim_version_log(
+            self._version_log, self.keep_versions)
         # round_idx mirrors the version so FINISH checks, resumed-INIT
         # short-circuits, and log lines all stay meaningful
         self.round_idx = self.model_version
@@ -632,6 +648,7 @@ class FedMLServerManager(ServerManager):
                     "committed_updates": int(self.committed_updates),
                     "client_seq": {str(c): int(s)
                                    for c, s in self._client_seq.items()},
+                    "version_log": self._version_log,
                 })
         return self.model_version >= self.round_num
 

@@ -156,7 +156,8 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
     )
 
     attack_type = getattr(args, "attack_type", None)
-    if attack_type and optimizer_name.lower() in ("hierarchicalfl", "decentralized"):
+    if attack_type and optimizer_name.lower() in (
+            "hierarchicalfl", "tieredfl", "decentralized"):
         raise ValueError(
             f"attack_type is wired into the FedSimulator aggregation path; "
             f"the '{optimizer_name}' engine does not support injected "
@@ -173,6 +174,19 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
             sim_cfg,
             group_num=int(getattr(args, "group_num", 2)),
             group_comm_round=int(getattr(args, "group_comm_round", 2)),
+            mesh=mesh,
+        )
+        return sim, apply_fn
+    if optimizer_name.lower() == "tieredfl":
+        from ..algorithms import make_local_update
+        from .federation import TierConfig, TieredFedSimulator
+
+        sim = TieredFedSimulator(
+            fed_data,
+            make_local_update(apply_fn, cfg, needs_dropout, has_batch_stats),
+            variables,
+            sim_cfg,
+            tier=TierConfig.from_args(args),
             mesh=mesh,
         )
         return sim, apply_fn

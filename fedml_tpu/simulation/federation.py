@@ -1,0 +1,925 @@
+"""Fault-tolerant tiered federation: root + leaf-aggregator processes.
+
+The fused :class:`~.hierarchical.HierarchicalFedSimulator` compiles the whole
+two-tier round into one XLA program — ideal on a single mesh, but it cannot
+survive a process dying mid-round. This module is the *distributed* form of
+the same math: leaf aggregators own contiguous shards of the cohort and run
+their ``group_comm_round`` inner FedAvg rounds locally
+(:func:`~.hierarchical.build_leaf_round`); a root tier folds their partial
+aggregates (:func:`~.hierarchical.fold_partials`). The tiers are real
+processes over any comm backend (loopback threads and gRPC in tier-1;
+``jax.distributed`` with the leaf tier on ICI and the root fold over DCN on
+chips), with a heartbeat/lease protocol on top of ``comm/resilience.py``.
+
+Failure domains (see docs/robustness.md):
+
+- **leaf crash** — the root's :class:`~..comm.resilience.LeaseTable` lapses;
+  the dead leaf's chunk is rehydrated from its
+  :class:`~..utils.checkpoint.LeafShardStore` shard when the shard covers the
+  current round (the leaf died uploading — its work exists on disk), else
+  reassigned to a surviving leaf for a bit-identical recompute. Either way
+  the :class:`CommitLedger` guarantees every client's update folds exactly
+  once.
+- **partition** — traffic across the cut black-holes
+  (:class:`~..comm.resilience.PartitionSpec`); the cut-off leaf looks dead
+  and fails over; when the window closes its heartbeats resume and the root
+  re-adopts it at the next round boundary.
+- **elastic membership** — a brand-new or rejoining leaf sends
+  :data:`~..cross_silo.hierarchical.TierMsg.MSG_TYPE_JOIN` (or simply
+  resumes heartbeating) and is re-synced (params + model version) and woven
+  back into the chunk rotation at the next round boundary.
+
+Determinism contract: a chunk — ``(round_idx, client_ids, cohort offset
+lo)`` — computes bit-identically wherever it runs, because its rng lanes
+come from a stateless per-round lane array (``fold_in(seed, round) →
+split``) sliced at ``lo`` and its batch packing is seeded by ``(seed,
+round, lo)``. Single-process :class:`TieredFedSimulator`, the fault-free
+multi-process run, and every failover recompute therefore produce
+bit-identical global models.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..comm.managers import FedMLCommManager
+from ..comm.message import Message
+from ..comm.resilience import LeaseTable, SendFailure
+from ..core import telemetry, trace_plane
+from ..cross_silo.hierarchical import HeartbeatSender, TierMsg
+from ..data.federated import FederatedData
+from ..utils.checkpoint import LeafShardStore, RoundStateStore, trim_version_log
+from ..utils.seed import set_seeds
+from .fed_sim import SimConfig
+from .hierarchical import build_leaf_round, contiguous_group_split, fold_partials
+from .sampling import sample_clients
+
+PyTree = Any
+
+ROOT_RANK = 0
+
+
+# --- configuration -----------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class TierConfig:
+    """Tier topology + failure-detection knobs (flat ``hier_*``/``lease_*``
+    config keys; see docs/robustness.md for the failure-domain guide)."""
+
+    num_leaves: int = 2              # logical shard count — fixed for a run
+    group_comm_round: int = 2        # inner FedAvg rounds per leaf per round
+    lease_ttl_s: float = 3.0         # missed-heartbeat window before failover
+    heartbeat_s: float = 0.5         # leaf renewal period (< ttl / 2)
+    join_timeout_s: float = 20.0     # root waits this long for initial joins
+    round_timeout_s: float = 30.0    # hard cap on one round's leaf_wait
+    shard_dir: Optional[str] = None  # LeafShardStore root (shared disk)
+    staleness_alpha: float = 0.5     # (1+s)^-alpha weight on stale partials
+    keep_versions: int = 32          # version-log retention (<=0 = unbounded)
+    ckpt_path: Optional[str] = None  # root RoundStateStore path
+
+    @classmethod
+    def from_args(cls, args) -> "TierConfig":
+        return cls(
+            num_leaves=int(getattr(args, "hier_num_leaves", 2)),
+            group_comm_round=int(getattr(args, "group_comm_round", 2)),
+            lease_ttl_s=float(getattr(args, "lease_ttl_s", 3.0)),
+            heartbeat_s=float(getattr(args, "lease_heartbeat_s", 0.5)),
+            join_timeout_s=float(getattr(args, "hier_join_timeout_s", 20.0)),
+            round_timeout_s=float(getattr(args, "hier_round_timeout_s", 30.0)),
+            shard_dir=getattr(args, "hier_shard_dir", None),
+            staleness_alpha=float(getattr(args, "hier_staleness_alpha", 0.5)),
+            keep_versions=int(getattr(args, "round_store_keep_versions", 32)
+                              or 0),
+            ckpt_path=getattr(args, "round_ckpt_path", None),
+        )
+
+
+class CommitLedger:
+    """Exactly-once accounting for folded client updates.
+
+    The root records every ``(round, client)`` it folds; a second record of
+    the same pair (a late partial racing a failover recompute, a replayed
+    shard) is flagged instead of silently double-counting. Thread-safe —
+    the receive loop and the round loop both touch it."""
+
+    def __init__(self):
+        self._committed: Dict[int, Dict[int, int]] = {}
+        self._duplicates = 0
+        self._lock = threading.Lock()
+
+    def record(self, round_idx: int, client_ids) -> List[int]:
+        """Record a fold of ``client_ids`` at ``round_idx``; returns the ids
+        that were ALREADY committed this round (empty = clean commit)."""
+        dups = []
+        with self._lock:
+            per_round = self._committed.setdefault(int(round_idx), {})
+            for cid in client_ids:
+                cid = int(cid)
+                per_round[cid] = per_round.get(cid, 0) + 1
+                if per_round[cid] > 1:
+                    dups.append(cid)
+            self._duplicates += len(dups)
+        return dups
+
+    def committed(self, round_idx: int) -> Tuple[int, ...]:
+        with self._lock:
+            return tuple(sorted(self._committed.get(int(round_idx), {})))
+
+    @property
+    def duplicates(self) -> int:
+        with self._lock:
+            return self._duplicates
+
+    @property
+    def total_commits(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._committed.values())
+
+
+# --- leaf engine -------------------------------------------------------------
+
+
+class LeafEngine:
+    """The compute a leaf aggregator owns: one *chunk* (a contiguous cohort
+    slice) through ``group_comm_round`` compiled inner rounds.
+
+    Stateless across calls — everything a chunk needs is derived from
+    ``(seed, round_idx, lo)``, which is what makes failover recomputes (and
+    the single-process reference) bit-identical to the original placement."""
+
+    def __init__(self, fed_data: FederatedData, local_update: Callable,
+                 cfg: SimConfig, tier: TierConfig):
+        self.fed = fed_data
+        self.cfg = cfg
+        self.tier = tier
+        sizes = [len(v) for v in fed_data.train_data_local_dict.values()]
+        self.num_local_batches = max(1, -(-max(sizes) // cfg.batch_size))
+        self._leaf_round = build_leaf_round(local_update, tier.group_comm_round)
+
+    def rng_lanes(self, round_idx: int, cohort_size: int):
+        """Cohort-global per-round rng lane array, shape ``(T, C, 2)``.
+        Chunks slice ``lanes[:, lo:lo+n]`` — the lane a client gets depends
+        only on its cohort position, never on which leaf computes it."""
+        rk = jax.random.fold_in(
+            jax.random.PRNGKey(self.cfg.seed), int(round_idx))
+        round_rngs = jax.random.split(rk, self.tier.group_comm_round)
+        return jax.vmap(
+            lambda r: jax.random.split(r, int(cohort_size)))(round_rngs)
+
+    def compute_chunk(self, params: PyTree, round_idx: int, chunk: dict,
+                      cohort_size: int, model_version: Optional[int] = None) -> dict:
+        """Run one chunk; returns the wire-ready partial record (host numpy
+        throughout — the msgpack codec round-trips it losslessly)."""
+        ids = np.asarray(chunk["client_ids"], dtype=np.int64)
+        lo = int(chunk["lo"])
+        pack_rng = np.random.default_rng(
+            [int(self.cfg.seed), int(round_idx), lo])
+        batches = self.fed.pack_clients(
+            ids, self.cfg.batch_size, self.num_local_batches, rng=pack_rng)
+        cohort = {
+            "x": jnp.asarray(batches.x),
+            "y": jnp.asarray(batches.y),
+            "mask": jnp.asarray(batches.mask),
+            "num_samples": jnp.asarray(batches.num_samples),
+        }
+        lanes = self.rng_lanes(round_idx, cohort_size)[:, lo:lo + len(ids)]
+        leaf_params, w_last, metrics = self._leaf_round(params, cohort, lanes)
+        metrics = jax.device_get(metrics)
+        return {
+            "lo": lo,
+            "client_ids": [int(c) for c in ids],
+            "partial": jax.device_get(leaf_params),
+            "weight": float(jax.device_get(w_last)),
+            "model_version": int(round_idx if model_version is None
+                                 else model_version),
+            "loss_sum": float(np.sum(metrics["train_loss"])),
+            "loss_n": int(np.size(metrics["train_loss"])),
+            "correct": float(np.sum(metrics["train_correct"])),
+            "valid": float(np.sum(metrics["train_valid"])),
+        }
+
+
+def round_chunks(cfg: SimConfig, tier: TierConfig, round_idx: int):
+    """The round's logical shards: the sampled cohort split into
+    ``tier.num_leaves`` contiguous chunks. The shard count is FIXED for the
+    run (membership elasticity changes which process computes a chunk, never
+    the chunk boundaries) — that is what keeps every membership history
+    bit-identical to the single-process reference."""
+    client_ids = sample_clients(
+        cfg.seed, round_idx, cfg.client_num_in_total, cfg.client_num_per_round)
+    parts, _ = contiguous_group_split(client_ids, tier.num_leaves)
+    chunks, lo = [], 0
+    for part in parts:
+        chunks.append({"lo": lo, "client_ids": [int(c) for c in part]})
+        lo += len(part)
+    return client_ids, chunks
+
+
+# --- shared fold/commit state ------------------------------------------------
+
+
+class _FoldState:
+    """The root-tier model state both drivers share: fold partials →
+    advance the model version → append the version log → (optionally)
+    checkpoint. One implementation so the single-process reference and the
+    multi-process root cannot drift."""
+
+    def __init__(self, init_params: PyTree, tier: TierConfig):
+        self.params = init_params
+        self.tier = tier
+        self.model_version = 0
+        self.version_log: List[list] = []
+        self.ledger = CommitLedger()
+        self._fold = jax.jit(fold_partials)
+        self.round_store = (RoundStateStore(tier.ckpt_path)
+                            if tier.ckpt_path else None)
+        self.start_round = 0
+        if self.round_store is not None and self.round_store.exists():
+            state = self.round_store.load()
+            self.params = state["params"]
+            self.start_round = int(state["round_idx"])
+            extra = state.get("extra") or {}
+            self.model_version = int(extra.get("model_version",
+                                               self.start_round))
+            self.version_log = [list(e)
+                                for e in (extra.get("version_log") or [])]
+            logging.info("tier root: resumed at round %d (model version %d)",
+                         self.start_round, self.model_version)
+
+    def fold_commit(self, round_idx: int, records: List[dict]) -> dict:
+        """Fold one round's partial records (sorted by cohort offset so the
+        stack order never depends on arrival order). Stale partials —
+        ``model_version`` behind the fold — are down-weighted by
+        ``(1+s)^-alpha``, the PR-13 staleness rule. Returns the round's
+        metric sums."""
+        recs = sorted(records, key=lambda r: int(r["lo"]))
+        dups = self.ledger.record(
+            round_idx, [c for r in recs for c in r["client_ids"]])
+        if dups:
+            # the ledger caught a double-fold attempt — surface loudly, the
+            # exactly-once invariant is the whole point of this plane
+            trace_plane.record_instant(
+                "tier_duplicate_commit", round_idx=round_idx,
+                attrs={"clients": dups[:8], "n": len(dups)})
+            logging.error("tier root: duplicate commit of %d client(s) at "
+                          "round %d: %s", len(dups), round_idx, dups[:8])
+        alpha = self.tier.staleness_alpha
+        weights = np.asarray([
+            r["weight"] * (1.0 + max(0, self.model_version
+                                     - int(r["model_version"]))) ** (-alpha)
+            for r in recs], dtype=np.float32)
+        stacked = jax.tree.map(
+            lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]),
+            *[r["partial"] for r in recs])
+        self.params = self._fold(stacked, jnp.asarray(weights))
+        self.model_version += 1
+        self.version_log.append([
+            int(self.model_version),
+            sum(len(r["client_ids"]) for r in recs),
+            sorted(c for r in recs for c in r["client_ids"]),
+        ])
+        self.version_log = trim_version_log(
+            self.version_log, self.tier.keep_versions)
+        return {
+            "loss_sum": sum(r["loss_sum"] for r in recs),
+            "loss_n": sum(r["loss_n"] for r in recs),
+            "correct": sum(r["correct"] for r in recs),
+            "valid": sum(r["valid"] for r in recs),
+        }
+
+    def checkpoint(self, next_round: int) -> None:
+        if self.round_store is None:
+            return
+        self.round_store.save(next_round, jax.device_get(self.params), extra={
+            "model_version": int(self.model_version),
+            "version_log": self.version_log,
+        })
+
+
+def _metrics_rec(round_idx: int, sums: dict, t0: float) -> Dict[str, float]:
+    return {
+        "round": round_idx,
+        "round_time": time.perf_counter() - t0,
+        "train_loss": sums["loss_sum"] / max(sums["loss_n"], 1),
+        "train_acc": sums["correct"] / max(sums["valid"], 1.0),
+    }
+
+
+def _drain_phases(rec: dict, phase_acc: List[Tuple[str, float]]) -> None:
+    """Exact per-round phase attribution (the fed_sim contract): named
+    phases plus a ``host_other`` remainder, so the sum equals round_time."""
+    phases: Dict[str, float] = {}
+    for name, dt in phase_acc:
+        phases[name] = phases.get(name, 0.0) + dt
+    phases["host_other"] = max(
+        0.0, rec["round_time"] - sum(phases.values()))
+    rec["phases"] = phases
+    reg = telemetry.get_registry()
+    if reg.enabled:
+        reg.counter("fedml_rounds_total").inc()
+        reg.histogram("fedml_round_seconds").observe(rec["round_time"])
+        for name, dt in phases.items():
+            reg.histogram(
+                "fedml_round_phase_seconds", phase=name).observe(dt)
+    phase_acc.clear()
+
+
+# --- single-process reference driver -----------------------------------------
+
+
+class TieredFedSimulator:
+    """Single-process reference for the tiered plane: the same chunks, the
+    same leaf program, the same fold — minus the wire. Multi-process runs
+    (fault-free OR with failover recomputes/rehydrations) must match this
+    driver bit-for-bit; tests pin that."""
+
+    def __init__(self, fed_data: FederatedData, local_update: Callable,
+                 init_variables: PyTree, cfg: SimConfig,
+                 tier: Optional[TierConfig] = None, mesh=None):
+        self.fed = fed_data
+        self.local_update = local_update
+        self.cfg = cfg
+        self.tier = tier or TierConfig()
+        self.mesh = mesh
+        self.engine = LeafEngine(fed_data, local_update, cfg, self.tier)
+        self.state = _FoldState(init_variables, self.tier)
+        self.history: List[Dict[str, float]] = []
+
+    @property
+    def params(self) -> PyTree:
+        return self.state.params
+
+    @property
+    def ledger(self) -> CommitLedger:
+        return self.state.ledger
+
+    def run(self, apply_fn=None, log_fn=print) -> List[Dict[str, float]]:
+        cfg = self.cfg
+        phase_acc: List[Tuple[str, float]] = []
+        for round_idx in range(self.state.start_round, cfg.comm_round):
+            t0 = time.perf_counter()
+            client_ids, chunks = round_chunks(cfg, self.tier, round_idx)
+            records = []
+            t = time.perf_counter()
+            for chunk in chunks:
+                records.append(self.engine.compute_chunk(
+                    self.state.params, round_idx, chunk, len(client_ids),
+                    model_version=self.state.model_version))
+            phase_acc.append(("device", time.perf_counter() - t))
+            t = time.perf_counter()
+            sums = self.state.fold_commit(round_idx, records)
+            phase_acc.append(("fold", time.perf_counter() - t))
+            rec = _metrics_rec(round_idx, sums, t0)
+            if apply_fn is not None and (
+                round_idx % cfg.frequency_of_the_test == 0
+                or round_idx == cfg.comm_round - 1
+            ):
+                t = time.perf_counter()
+                rec.update(_evaluate(self.fed, apply_fn, self.state.params))
+                phase_acc.append(("eval", time.perf_counter() - t))
+            t = time.perf_counter()
+            self.state.checkpoint(round_idx + 1)
+            phase_acc.append(("checkpoint", time.perf_counter() - t))
+            rec["round_time"] = time.perf_counter() - t0
+            _drain_phases(rec, phase_acc)
+            self.history.append(rec)
+            if log_fn:
+                log_fn(f"[tier-round {round_idx}] " + " ".join(
+                    f"{k}={v:.4f}" if isinstance(v, float) else f"{k}={v}"
+                    for k, v in rec.items() if k not in ("round", "phases")))
+        return self.history
+
+
+def _evaluate(fed: FederatedData, apply_fn, params) -> Dict[str, float]:
+    test = fed.test_data_global
+    logits = apply_fn(params, jnp.asarray(test.x), train=False)
+    pred = jnp.argmax(logits, -1)
+    return {"test_acc": float((pred == jnp.asarray(test.y)).mean())}
+
+
+# --- multi-process actors ----------------------------------------------------
+
+
+class LeafWorker(FedMLCommManager):
+    """A leaf-aggregator process: joins the root, heartbeats its lease,
+    computes dispatched chunks, persists its shard, uploads partials."""
+
+    def __init__(self, args, engine: LeafEngine, rank: int, size: int,
+                 backend: str = "LOOPBACK", **kw):
+        super().__init__(args, rank=rank, size=size, backend=backend, **kw)
+        self.engine = engine
+        self.tier = engine.tier
+        self.shard_store = (LeafShardStore(self.tier.shard_dir, rank)
+                            if self.tier.shard_dir else None)
+        # written by the receive-loop handlers, read by the heartbeat thread
+        self._round = 0
+        self._round_lock = threading.Lock()
+        self._hb = HeartbeatSender(
+            self.send_message, rank, root_rank=ROOT_RANK,
+            interval_s=self.tier.heartbeat_s,
+            round_fn=self._current_round)
+
+    def _current_round(self) -> int:
+        with self._round_lock:
+            return self._round
+
+    def _set_round(self, round_idx: int) -> None:
+        with self._round_lock:
+            self._round = round_idx
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            TierMsg.MSG_TYPE_DISPATCH, self._on_dispatch)
+        self.register_message_receive_handler(
+            TierMsg.MSG_TYPE_SYNC, self._on_sync)
+        self.register_message_receive_handler(
+            TierMsg.MSG_TYPE_FINISH, lambda _msg: self.finish())
+
+    def run(self) -> None:
+        self.register_message_receive_handlers()
+        self._send_join()
+        self._hb.start()
+        try:
+            self.com_manager.handle_receive_message()
+        finally:
+            self._hb.stop()
+
+    def _send_join(self) -> None:
+        msg = Message(TierMsg.MSG_TYPE_JOIN, self.rank, ROOT_RANK)
+        msg.add_params(TierMsg.ARG_LEAF_RANK, self.rank)
+        try:
+            self.send_message(msg)
+        except SendFailure:
+            logging.warning("leaf %d: join undeliverable (root down?)",
+                            self.rank)
+
+    def _on_sync(self, msg: Message) -> None:
+        round_idx = int(msg.get(TierMsg.ARG_ROUND_IDX,
+                                self._current_round()))
+        self._set_round(round_idx)
+        logging.info("leaf %d: synced to round %d (model version %s)",
+                     self.rank, round_idx,
+                     msg.get(TierMsg.ARG_MODEL_VERSION))
+
+    def _on_dispatch(self, msg: Message) -> None:
+        round_idx = int(msg.get(TierMsg.ARG_ROUND_IDX))
+        self._set_round(round_idx)
+        params = msg.get(TierMsg.ARG_MODEL_PARAMS)
+        version = int(msg.get(TierMsg.ARG_MODEL_VERSION, round_idx))
+        cohort_size = int(msg.get(TierMsg.ARG_COHORT_SIZE))
+        records = [
+            self.engine.compute_chunk(params, round_idx, chunk, cohort_size,
+                                      model_version=version)
+            for chunk in msg.get(TierMsg.ARG_CHUNKS)
+        ]
+        if self.shard_store is not None:
+            # persist BEFORE the upload: if this process dies mid-send (the
+            # leaf_crash drill's exact cut point), the root rehydrates this
+            # shard instead of recomputing
+            self.shard_store.save(round_idx, {
+                "model_version": version,
+                "partials": records,
+            })
+        reply = Message(TierMsg.MSG_TYPE_PARTIAL, self.rank, ROOT_RANK)
+        reply.add_params(TierMsg.ARG_ROUND_IDX, round_idx)
+        reply.add_params(TierMsg.ARG_LEAF_RANK, self.rank)
+        reply.add_params(TierMsg.ARG_PARTIALS, records)
+        try:
+            self.send_message(reply)
+        except SendFailure:
+            logging.warning("leaf %d: partial for round %d undeliverable",
+                            self.rank, round_idx)
+
+
+class RootCoordinator(FedMLCommManager):
+    """The root tier: dispatches chunks to live leaves, folds their partials,
+    and owns the failure story (lease expiry → rehydrate or reassign;
+    join/heartbeat from an unknown leaf → adopt at the round boundary)."""
+
+    def __init__(self, args, sim: TieredFedSimulator, size: int,
+                 backend: str = "LOOPBACK", apply_fn=None, **kw):
+        super().__init__(args, rank=ROOT_RANK, size=size, backend=backend, **kw)
+        self.sim = sim
+        self.tier = sim.tier
+        self.state = sim.state
+        self.engine = sim.engine
+        self.apply_fn = apply_fn
+        self.history: List[Dict[str, float]] = []
+        self.lease = LeaseTable(ttl_s=self.tier.lease_ttl_s)
+        self._live: set = set()
+        self._pending_joins: set = set()
+        self._membership_lock = threading.Lock()
+        self._partials_q: "queue.Queue[tuple]" = queue.Queue()
+        self._rx_thread: Optional[threading.Thread] = None
+        self.failovers = 0
+        self.rehydrations = 0
+
+    # --- receive side (runs on the comm receive-loop thread) ----------------
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            TierMsg.MSG_TYPE_HEARTBEAT, self._on_heartbeat)
+        self.register_message_receive_handler(
+            TierMsg.MSG_TYPE_JOIN, self._on_join)
+        self.register_message_receive_handler(
+            TierMsg.MSG_TYPE_PARTIAL, self._on_partial)
+
+    def _note_alive(self, rank: int) -> None:
+        self.lease.renew(rank)
+        with self._membership_lock:
+            if rank not in self._live:
+                # a heartbeat from a non-member IS a rejoin request — a leaf
+                # on the far side of a healed partition never re-sends JOIN
+                self._pending_joins.add(rank)
+
+    def _on_heartbeat(self, msg: Message) -> None:
+        self._note_alive(int(msg.get_sender_id()))
+
+    def _on_join(self, msg: Message) -> None:
+        self._note_alive(int(msg.get_sender_id()))
+
+    def _on_partial(self, msg: Message) -> None:
+        sender = int(msg.get_sender_id())
+        self.lease.renew(sender)
+        self._partials_q.put((sender,
+                              int(msg.get(TierMsg.ARG_ROUND_IDX)),
+                              msg.get(TierMsg.ARG_PARTIALS)))
+
+    # --- run loop ------------------------------------------------------------
+
+    def run(self) -> List[Dict[str, float]]:
+        self.register_message_receive_handlers()
+        self._rx_thread = threading.Thread(
+            target=self.com_manager.handle_receive_message,
+            daemon=True, name="tier-root-rx")
+        self._rx_thread.start()
+        try:
+            self._await_initial_joins()
+            phase_acc: List[Tuple[str, float]] = []
+            for round_idx in range(self.state.start_round,
+                                   self.cfg.comm_round):
+                self._run_round(round_idx, phase_acc)
+                self._adopt_pending(round_idx + 1)
+            self._broadcast_finish()
+        finally:
+            self.com_manager.stop_receive_message()
+            if self._rx_thread is not None:
+                self._rx_thread.join(timeout=5.0)
+        return self.history
+
+    @property
+    def cfg(self) -> SimConfig:
+        return self.sim.cfg
+
+    def _await_initial_joins(self) -> None:
+        deadline = time.monotonic() + self.tier.join_timeout_s
+        while time.monotonic() < deadline:
+            with self._membership_lock:
+                self._live |= self._pending_joins
+                self._pending_joins.clear()
+                if len(self._live) >= self.tier.num_leaves:
+                    break
+            time.sleep(0.02)
+        with self._membership_lock:
+            joined = sorted(self._live)
+        logging.info("tier root: starting with leaves %s (wanted %d)",
+                     joined, self.tier.num_leaves)
+
+    def _adopt_pending(self, next_round: int) -> None:
+        """Round-boundary membership changes: sync and admit joiners."""
+        with self._membership_lock:
+            joiners = sorted(self._pending_joins - self._live)
+            self._pending_joins.clear()
+            self._live |= set(joiners)
+        for rank in joiners:
+            trace_plane.record_instant(
+                "tier_leaf_join", round_idx=next_round,
+                attrs={"leaf": rank})
+            telemetry.record_fault("leaf_join")
+            msg = Message(TierMsg.MSG_TYPE_SYNC, ROOT_RANK, rank)
+            msg.add_params(TierMsg.ARG_ROUND_IDX, int(next_round))
+            msg.add_params(TierMsg.ARG_MODEL_VERSION,
+                           int(self.state.model_version))
+            try:
+                self.send_message(msg)
+            except SendFailure:
+                logging.warning("tier root: sync to joining leaf %d failed",
+                                rank)
+            logging.info("tier root: adopted leaf %d for round %d",
+                         rank, next_round)
+
+    def _dispatch(self, rank: int, round_idx: int, chunks: List[dict],
+                  cohort_size: int, params_host) -> bool:
+        msg = Message(TierMsg.MSG_TYPE_DISPATCH, ROOT_RANK, rank)
+        msg.add_params(TierMsg.ARG_ROUND_IDX, int(round_idx))
+        msg.add_params(TierMsg.ARG_MODEL_PARAMS, params_host)
+        msg.add_params(TierMsg.ARG_MODEL_VERSION,
+                       int(self.state.model_version))
+        msg.add_params(TierMsg.ARG_COHORT_SIZE, int(cohort_size))
+        msg.add_params(TierMsg.ARG_CHUNKS, chunks)
+        try:
+            self.send_message(msg)
+            return True
+        except SendFailure:
+            logging.warning("tier root: dispatch to leaf %d failed", rank)
+            return False
+
+    def _run_round(self, round_idx: int,
+                   phase_acc: List[Tuple[str, float]]) -> None:
+        t0 = time.perf_counter()
+        client_ids, chunks = round_chunks(self.cfg, self.tier, round_idx)
+        cohort_size = len(client_ids)
+        params_host = jax.device_get(self.state.params)
+
+        with self._membership_lock:
+            live = sorted(self._live)
+        # chunk -> leaf assignment: logical shards rotate over live leaves
+        assignment: Dict[int, List[dict]] = {r: [] for r in live}
+        orphans: List[dict] = []
+        for i, chunk in enumerate(chunks):
+            if live:
+                assignment[live[i % len(live)]].append(chunk)
+            else:
+                orphans.append(chunk)
+
+        t = time.perf_counter()
+        pending: Dict[int, List[dict]] = {}
+        for rank, assigned in assignment.items():
+            if not assigned:
+                continue
+            if self._dispatch(rank, round_idx, assigned, cohort_size,
+                              params_host):
+                pending[rank] = assigned
+            else:
+                orphans.extend(assigned)
+        phase_acc.append(("dispatch", time.perf_counter() - t))
+
+        got: Dict[int, dict] = {}  # chunk lo -> partial record
+        # no live leaf (or dispatch failed): the root absorbs the chunks —
+        # progress is never hostage to the leaf tier
+        for chunk in orphans:
+            self._absorb_chunk(round_idx, chunk, cohort_size, got)
+
+        t = time.perf_counter()
+        deadline = time.monotonic() + self.tier.round_timeout_s
+        want = {int(c["lo"]) for c in chunks}
+        while set(got) != want:
+            try:
+                sender, rnd, records = self._partials_q.get(timeout=0.05)
+            except queue.Empty:
+                sender, rnd, records = None, None, None
+            if records is not None and rnd == round_idx:
+                self._accept(sender, round_idx, records, got, want)
+                pending.pop(sender, None)
+            elif records is not None:
+                logging.info("tier root: ignoring stale partial from leaf "
+                             "%s (round %s != %s)", sender, rnd, round_idx)
+            self._check_failover(round_idx, pending, got, cohort_size,
+                                 deadline)
+        phase_acc.append(("leaf_wait", time.perf_counter() - t))
+
+        t = time.perf_counter()
+        sums = self.state.fold_commit(round_idx, list(got.values()))
+        phase_acc.append(("fold", time.perf_counter() - t))
+        rec = _metrics_rec(round_idx, sums, t0)
+        if self.apply_fn is not None and (
+            round_idx % self.cfg.frequency_of_the_test == 0
+            or round_idx == self.cfg.comm_round - 1
+        ):
+            t = time.perf_counter()
+            rec.update(_evaluate(self.sim.fed, self.apply_fn,
+                                 self.state.params))
+            phase_acc.append(("eval", time.perf_counter() - t))
+        t = time.perf_counter()
+        self.state.checkpoint(round_idx + 1)
+        phase_acc.append(("checkpoint", time.perf_counter() - t))
+        rec["round_time"] = time.perf_counter() - t0
+        _drain_phases(rec, phase_acc)
+        self.history.append(rec)
+        logging.info("[tier-root round %d] %s", round_idx, {
+            k: v for k, v in rec.items() if k != "phases"})
+
+    def _accept(self, sender, round_idx: int, records: List[dict],
+                got: Dict[int, dict], want: set) -> None:
+        for rec in records:
+            lo = int(rec["lo"])
+            if lo in got or lo not in want:
+                # late duplicate (e.g. the original leaf's upload racing a
+                # failover recompute) — first result wins, never fold twice
+                trace_plane.record_instant(
+                    "tier_duplicate_partial", round_idx=round_idx,
+                    attrs={"leaf": sender, "lo": lo})
+                logging.info("tier root: discarding duplicate partial "
+                             "lo=%d from leaf %s", lo, sender)
+                continue
+            got[lo] = rec
+
+    def _absorb_chunk(self, round_idx: int, chunk: dict, cohort_size: int,
+                      got: Dict[int, dict]) -> None:
+        trace_plane.record_instant(
+            "tier_root_absorb", round_idx=round_idx,
+            attrs={"lo": int(chunk["lo"])})
+        got[int(chunk["lo"])] = self.engine.compute_chunk(
+            self.state.params, round_idx, chunk, cohort_size,
+            model_version=self.state.model_version)
+
+    def _check_failover(self, round_idx: int, pending: Dict[int, List[dict]],
+                        got: Dict[int, dict], cohort_size: int,
+                        deadline: float) -> None:
+        expired = set(self.lease.expired())
+        if time.monotonic() > deadline:
+            # hard round timeout: whatever is still pending is dead to us
+            expired |= set(pending)
+        dead = sorted(expired & set(pending))
+        for rank in dead:
+            chunks_lost = [c for c in pending.pop(rank)
+                           if int(c["lo"]) not in got]
+            self.failovers += 1
+            telemetry.record_fault("leaf_failover")
+            trace_plane.record_instant(
+                "tier_lease_expired", round_idx=round_idx, rank=rank,
+                attrs={"chunks": [int(c["lo"]) for c in chunks_lost]})
+            logging.warning("tier root: leaf %d lease expired at round %d "
+                            "(%d chunk(s) lost)", rank, round_idx,
+                            len(chunks_lost))
+            with self._membership_lock:
+                self._live.discard(rank)
+            self.lease.drop(rank)
+            chunks_lost = self._try_rehydrate(rank, round_idx, chunks_lost,
+                                              got)
+            if not chunks_lost:
+                continue
+            with self._membership_lock:
+                survivors = sorted(self._live)
+            # prefer an idle survivor (already replied this round), else the
+            # least-loaded busy one (ties -> lowest rank): deterministic
+            # given the same membership history
+            idle = [r for r in survivors if r not in pending]
+            if idle:
+                target = idle[0]
+            elif pending:
+                target = min(pending, key=lambda r: (len(pending[r]), r))
+            else:
+                target = None
+            if target is not None and self._dispatch(
+                    target, round_idx, chunks_lost, cohort_size,
+                    jax.device_get(self.state.params)):
+                pending.setdefault(target, []).extend(chunks_lost)
+                trace_plane.record_instant(
+                    "tier_failover", round_idx=round_idx,
+                    attrs={"from": rank, "to": target,
+                           "chunks": [int(c["lo"]) for c in chunks_lost]})
+                logging.warning("tier root: reassigned %d chunk(s) from "
+                                "leaf %d to leaf %d", len(chunks_lost),
+                                rank, target)
+            else:
+                for chunk in chunks_lost:
+                    self._absorb_chunk(round_idx, chunk, cohort_size, got)
+
+    def _try_rehydrate(self, rank: int, round_idx: int,
+                       chunks_lost: List[dict],
+                       got: Dict[int, dict]) -> List[dict]:
+        """Recover a dead leaf's committed-but-undelivered work from its
+        shard store. Only a shard covering the CURRENT round is usable (an
+        older shard's chunks belong to an already-folded round — replaying
+        them would double-count); within it, only records matching a lost
+        chunk's exact client set are taken. Returns the chunks still
+        missing."""
+        if self.shard_dir is None:
+            return chunks_lost
+        data = LeafShardStore(self.shard_dir, rank).load()
+        if not data or int(data.get("round_idx", -1)) != round_idx:
+            return chunks_lost
+        by_lo = {int(r["lo"]): r for r in data.get("partials") or []}
+        still = []
+        for chunk in chunks_lost:
+            rec = by_lo.get(int(chunk["lo"]))
+            if rec is not None and list(rec["client_ids"]) == list(
+                    chunk["client_ids"]):
+                got[int(chunk["lo"])] = rec
+                self.rehydrations += 1
+                telemetry.record_fault("leaf_rehydrate")
+                trace_plane.record_instant(
+                    "tier_rehydrate", round_idx=round_idx, rank=rank,
+                    attrs={"lo": int(chunk["lo"]),
+                           "version": int(rec["model_version"])})
+                logging.warning("tier root: rehydrated chunk lo=%d from "
+                                "leaf %d's shard", int(chunk["lo"]), rank)
+            else:
+                still.append(chunk)
+        return still
+
+    @property
+    def shard_dir(self) -> Optional[str]:
+        return self.tier.shard_dir
+
+    def _broadcast_finish(self) -> None:
+        with self._membership_lock:
+            live = sorted(self._live)
+        for rank in live:
+            msg = Message(TierMsg.MSG_TYPE_FINISH, ROOT_RANK, rank)
+            msg.add_params(TierMsg.ARG_ROUND_IDX, int(self.cfg.comm_round))
+            try:
+                self.send_message(msg)
+            except SendFailure:
+                logging.warning("tier root: finish to leaf %d failed", rank)
+        self.finish()
+
+
+# --- deployment helpers ------------------------------------------------------
+
+
+def build_tiered_simulator(args, mesh=None) -> Tuple[TieredFedSimulator, Callable]:
+    """Assemble a :class:`TieredFedSimulator` from flat config (the
+    ``federated_optimizer: "TieredFL"`` path of ``build_simulator``)."""
+    import copy
+
+    from . import build_simulator
+
+    args = copy.copy(args)
+    args.federated_optimizer = "TieredFL"
+    # Every tier process loads (and partitions) the dataset independently;
+    # the partitioner runs on the GLOBAL numpy RNG (reference parity), so
+    # without pinning it here two processes would derive different client
+    # partitions — and the root's chunk manifests would name data the leaves
+    # don't hold. Pinning also makes the single-process reference
+    # reproducible run-to-run (the bit-identity contract's precondition).
+    set_seeds(int(getattr(args, "random_seed", 0)))
+    return build_simulator(args, mesh=mesh)
+
+
+def run_tiered_federation(args, backend: str = "LOOPBACK",
+                          apply_fn_eval: bool = True,
+                          **kw) -> RootCoordinator:
+    """One tiered run, leaves as in-process actors (loopback threads share a
+    hub; gRPC actors each bind a localhost port). Returns the finished root
+    (``.history``, ``.sim.params``, ``.ledger`` via ``.state``). This is the
+    tier-1 deployment shape; multi-host chip runs use
+    :func:`run_distributed_federation`."""
+    sim, apply_fn = build_tiered_simulator(args)
+    tier = sim.tier
+    size = tier.num_leaves + 1
+    if str(backend).upper() == "LOOPBACK" and "hub" not in kw:
+        from ..comm.loopback import LoopbackHub
+
+        kw["hub"] = LoopbackHub()
+    root = RootCoordinator(args, sim, size=size, backend=backend,
+                           apply_fn=apply_fn if apply_fn_eval else None, **kw)
+    leaves = []
+    for rank in range(1, size):
+        engine = LeafEngine(sim.fed, sim.local_update, sim.cfg, tier)
+        leaves.append(LeafWorker(args, engine, rank=rank, size=size,
+                                 backend=backend, **kw))
+    threads = [threading.Thread(target=leaf.run, daemon=True,
+                                name=f"tier-leaf-{leaf.rank}")
+               for leaf in leaves]
+    for th in threads:
+        th.start()
+    try:
+        root.run()
+    finally:
+        for leaf in leaves:
+            leaf.finish()
+        for th in threads:
+            th.join(timeout=5.0)
+    return root
+
+
+def run_distributed_federation(args, apply_fn_eval: bool = True,
+                               **kw) -> Optional[RootCoordinator]:
+    """Chip-shaped deployment: one tier actor per ``jax.distributed``
+    process — process 0 is the root (its fold rides DCN), every other
+    process a leaf aggregator whose chunk compute stays on its local ICI
+    slice. Needs ``jax.distributed`` initialized (scripts/launch_multihost.sh
+    or the run_*_worker harnesses) and a real wire backend (gRPC ip-config
+    spanning the hosts). Returns the root on process 0, ``None`` on leaves."""
+    n_proc = jax.process_count()
+    if n_proc < 2:
+        raise RuntimeError(
+            "run_distributed_federation needs an initialized jax.distributed "
+            "world of >= 2 processes; single-process runs should use "
+            "run_tiered_federation (loopback threads)")
+    rank = jax.process_index()
+    sim, apply_fn = build_tiered_simulator(args)
+    size = n_proc
+    backend = kw.pop("backend", "GRPC")
+    if rank == ROOT_RANK:
+        root = RootCoordinator(args, sim, size=size, backend=backend,
+                               apply_fn=apply_fn if apply_fn_eval else None,
+                               **kw)
+        root.run()
+        return root
+    engine = LeafEngine(sim.fed, sim.local_update, sim.cfg, sim.tier)
+    LeafWorker(args, engine, rank=rank, size=size, backend=backend,
+               **kw).run()
+    return None

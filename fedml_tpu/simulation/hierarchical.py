@@ -32,6 +32,79 @@ from .sampling import sample_clients
 PyTree = Any
 
 
+# --- shared tier math --------------------------------------------------------
+#
+# The fused single-XLA-program simulator below and the fault-tolerant tiered
+# plane (simulation/federation.py) must agree on three things: how a cohort
+# splits into groups/leaves, how one leaf advances its model over its own
+# clients, and how the root tier folds per-leaf models. These helpers are
+# that shared contract.
+
+
+def contiguous_group_split(client_ids, num_groups: int):
+    """Cohort -> group map: ``np.array_split`` parts (one per group, in
+    cohort order) plus the flat per-client group-id vector. Both tiers of
+    the plane key their work off this one split."""
+    ids = np.asarray(client_ids)
+    parts = np.array_split(ids, num_groups)
+    group_ids = np.concatenate([
+        np.full(len(part), g, np.int32) for g, part in enumerate(parts)
+    ]) if len(ids) else np.zeros((0,), np.int32)
+    return parts, group_ids
+
+
+def fold_partials(stacked_params: PyTree, weights):
+    """Root tier: sample-weighted mean over the leading (group/leaf) axis.
+    float32 accumulation, cast back to the param dtype — identical math in
+    the fused program and the multi-process root fold."""
+    w = weights.astype(jnp.float32)
+    total = jnp.maximum(w.sum(), 1.0)
+    return jax.tree.map(
+        lambda p: jnp.tensordot(
+            w / total, p.astype(jnp.float32), axes=(0, 0)
+        ).astype(p.dtype),
+        stacked_params,
+    )
+
+
+def build_leaf_round(local_update: Callable, group_comm_round: int) -> Callable:
+    """Compile the per-leaf program: ``group_comm_round`` inner FedAvg
+    rounds over ONE leaf's clients, starting from the broadcast params.
+
+    ``leaf_round(params, cohort, rngs)`` with ``rngs`` of shape
+    ``(T, n_clients, 2)`` returns ``(leaf_params, last_round_weight,
+    metrics)``. The rng lanes come in from the caller (sliced out of the
+    cohort-global lane array), so a chunk of clients produces bit-identical
+    results wherever it is computed — the property leaf failover's
+    recompute path relies on."""
+    T = int(group_comm_round)
+
+    def leaf_round(params, cohort, rngs):
+        C = cohort["num_samples"].shape[0]
+
+        def one_round(p, round_rngs):
+            client_params = jax.tree.map(
+                lambda q: jnp.broadcast_to(q[None], (C,) + q.shape), p)
+            outs = cohort_local_update(
+                local_update, client_params, (), cohort, round_rngs,
+                params_axis=0, state_axis=None)
+            w = outs.weight.astype(jnp.float32)
+            wsum = jnp.maximum(w.sum(), 1.0)
+            agg = jax.tree.map(
+                lambda u: (
+                    (u.astype(jnp.float32)
+                     * w.reshape((-1,) + (1,) * (u.ndim - 1))).sum(0) / wsum
+                ).astype(u.dtype),
+                outs.update,
+            )
+            return tree_add(p, agg), (outs.metrics, w.sum())
+
+        params, (metrics, wsums) = jax.lax.scan(one_round, params, rngs)
+        return params, wsums[-1], metrics
+
+    return jax.jit(leaf_round)
+
+
 class HierarchicalFedSimulator:
     """FedAvg with an intermediate group tier.
 
@@ -101,15 +174,9 @@ class HierarchicalFedSimulator:
             group_params, (metrics, w_group) = jax.lax.scan(
                 group_round, group_params, jax.random.split(rng, T)
             )
-            # global tier: sample-weighted mean of group models (last round's weights)
-            wg = w_group[-1]
-            total = jnp.maximum(wg.sum(), 1.0)
-            new_params = jax.tree.map(
-                lambda p: jnp.tensordot(
-                    wg / total, p.astype(jnp.float32), axes=(0, 0)
-                ).astype(p.dtype),
-                group_params,
-            )
+            # global tier: sample-weighted mean of group models (last round's
+            # weights) — the same fold the multi-process root runs
+            new_params = fold_partials(group_params, w_group[-1])
             return new_params, metrics
 
         if self.mesh is not None:
@@ -134,10 +201,7 @@ class HierarchicalFedSimulator:
                 cfg.client_num_in_total, cfg.client_num_per_round,
             )
             # contiguous even split of the cohort into groups
-            group_ids = np.concatenate([
-                np.full(len(part), g, np.int32)
-                for g, part in enumerate(np.array_split(client_ids, self.group_num))
-            ])
+            _, group_ids = contiguous_group_split(client_ids, self.group_num)
             batches = self.fed.pack_clients(
                 client_ids, cfg.batch_size, self.num_local_batches, rng=pack_rng
             )

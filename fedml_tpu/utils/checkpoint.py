@@ -59,6 +59,78 @@ class CheckpointManager:
         self.manager.close()
 
 
+def _atomic_write_blob(path: str, blob: bytes) -> None:
+    """Temp file + ``os.replace`` + parent-directory fsync: a crash at any
+    point leaves either the previous file or the new one, never a torn
+    write (and the rename itself is durable, not just the data blocks)."""
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    if not hasattr(os, "O_DIRECTORY"):  # e.g. Windows
+        return
+    try:
+        fd = os.open(d, os.O_DIRECTORY | os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def trim_version_log(log, keep: int):
+    """Retain the last ``keep`` model-version-log entries (``<= 0`` =
+    unbounded). The log is append-only per commit, so without a bound a
+    long async run grows its checkpoint blob linearly; dedup only ever
+    consults recent versions (a client can't be staler than the retention
+    window once the window exceeds the max observed staleness), so the tail
+    is the only part resume needs."""
+    entries = list(log or ())
+    if keep is None or int(keep) <= 0:
+        return entries
+    return entries[-int(keep):]
+
+
+class LeafShardStore:
+    """Crash-safe per-leaf arena-shard state for the tiered federation
+    plane (same atomic msgpack discipline as :class:`RoundStateStore`).
+
+    Each leaf aggregator persists, after computing a round's partial
+    aggregate, the shard a failover needs: the round index, the model
+    version the partial was computed against, its client ids, the partial
+    aggregate and its weight. The root rehydrates from this file (shared
+    disk in tier-1; an object store on chip deployments) when the leaf's
+    lease lapses — a committed update is replayed from here exactly once,
+    staleness-weighted if the fold has moved on.
+    """
+
+    def __init__(self, root_dir: str, leaf_rank: int):
+        self.leaf_rank = int(leaf_rank)
+        self.path = os.path.join(str(root_dir), f"leaf_shard_{leaf_rank}.msgpack")
+
+    def exists(self) -> bool:
+        return os.path.exists(self.path)
+
+    def save(self, round_idx: int, payload: dict) -> None:
+        from ..comm.message import pack_payload
+
+        blob = pack_payload({"round_idx": int(round_idx), **payload})
+        _atomic_write_blob(self.path, blob)
+
+    def load(self) -> Optional[dict]:
+        from ..comm.message import unpack_payload
+
+        if not self.exists():
+            return None
+        with open(self.path, "rb") as f:
+            return unpack_payload(f.read())
+
+
 class RoundStateStore:
     """Crash-safe cross-silo *server* round state (orbax-free: the comm
     plane's msgpack codec, one file, atomic replace).
@@ -98,32 +170,7 @@ class RoundStateStore:
             "rng_state": [s[0], s[1], int(s[2]), int(s[3]), float(s[4])],
             **({"extra": extra} if extra is not None else {}),
         })
-        d = os.path.dirname(os.path.abspath(self.path))
-        os.makedirs(d, exist_ok=True)
-        tmp = self.path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(blob)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)
-        self._fsync_dir(d)
-
-    @staticmethod
-    def _fsync_dir(d: str) -> None:
-        """Durably persist the rename itself: fsync on the temp file only
-        covers the data blocks — until the PARENT DIRECTORY entry is synced,
-        a power cut after ``os.replace`` can still resurface the old file
-        (or none). POSIX-only; best-effort elsewhere."""
-        if not hasattr(os, "O_DIRECTORY"):  # e.g. Windows
-            return
-        try:
-            fd = os.open(d, os.O_DIRECTORY | os.O_RDONLY)
-        except OSError:
-            return
-        try:
-            os.fsync(fd)
-        finally:
-            os.close(fd)
+        _atomic_write_blob(self.path, blob)
 
     def load(self, restore_rng: bool = True) -> dict:
         """Returns ``{"round_idx", "params", "rng_state"}``; by default also

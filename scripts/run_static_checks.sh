@@ -17,9 +17,14 @@
 #      mtime-stale, then verifies the source hash baked into the binary
 #      matches fedml_native.cpp (skipped when no toolchain; the runtime
 #      falls back to numpy there anyway).
+#   4. tier_smoke — a tiny 1-root + 2-leaf loopback hierarchy run that
+#      must be bit-identical to the single-process reference with an
+#      exact commit ledger; the cheapest end-to-end probe of the tier
+#      wire protocol.
 #
-# The checks are pure-AST / host-compile and run in seconds on CPU; no JAX
-# devices, network, or model downloads are involved.
+# Checks 1-3 are pure-AST / host-compile; check 4 runs JAX on CPU
+# (debug-small dataset, a few seconds). No network or model downloads
+# are involved.
 set -u
 
 REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
@@ -42,6 +47,9 @@ else
     # a stale .so cannot silently serve wrong code — skip rather than fail
     echo "(skipped: native toolchain unavailable)"
 fi
+
+echo "== tiered federation loopback smoke =="
+JAX_PLATFORMS=cpu "$PY" scripts/tier_smoke.py || rc=1
 
 if [ "$rc" -ne 0 ]; then
     echo "static checks FAILED (see above)" >&2

@@ -1,0 +1,47 @@
+"""Fixture: the hazards the tiered-federation modules must not grow
+(fed to the checkers under the simulation/federation relpath). A leaf's
+heartbeat thread reads the round counter that the receive-loop handlers
+write with no common lock, and the root nests its lease-table and
+commit-ledger locks in opposite orders on the dispatch and failover
+paths — the races/deadlocks thread-hazard and lock-order guard."""
+
+import threading
+import time
+
+
+class BadLeafWorker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._round = 0
+
+    def start(self):
+        t = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        t.start()
+
+    def _heartbeat_loop(self):
+        while True:
+            self._send_heartbeat(self._round)   # unlocked read from thread
+
+    def on_dispatch(self, msg):
+        self._round = msg.round_idx             # unlocked main-thread write
+
+    def _send_heartbeat(self, round_idx):
+        return None
+
+
+class BadRootCoordinator:
+    def __init__(self):
+        self._lease_lock = threading.Lock()
+        self._ledger_lock = threading.Lock()
+
+    def dispatch(self, round_idx):
+        with self._lease_lock:
+            with self._ledger_lock:
+                time.sleep(0.1)                 # blocking under both locks
+
+    def failover(self, dead_rank):
+        # opposite nesting order from dispatch() — AB/BA deadlock when a
+        # lease expiry races a round dispatch
+        with self._ledger_lock:
+            with self._lease_lock:
+                pass

@@ -1,0 +1,53 @@
+"""Fixture: the safe twin of federation_bad — the round counter is only
+touched through lock-guarded accessors shared by both threads, and the
+root takes lease-table before commit-ledger on every path, sleeping
+outside the critical section."""
+
+import threading
+import time
+
+
+class CleanLeafWorker:
+    def __init__(self):
+        self._round_lock = threading.Lock()
+        self._round = 0
+
+    def start(self):
+        t = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        t.start()
+
+    def _heartbeat_loop(self):
+        while True:
+            self._send_heartbeat(self._current_round())
+
+    def on_dispatch(self, msg):
+        self._set_round(msg.round_idx)
+
+    def _current_round(self):
+        with self._round_lock:
+            return self._round
+
+    def _set_round(self, round_idx):
+        with self._round_lock:
+            self._round = round_idx
+
+    def _send_heartbeat(self, round_idx):
+        return None
+
+
+class CleanRootCoordinator:
+    def __init__(self):
+        self._lease_lock = threading.Lock()
+        self._ledger_lock = threading.Lock()
+
+    def dispatch(self, round_idx):
+        with self._lease_lock:
+            with self._ledger_lock:
+                pass
+        time.sleep(0.1)
+
+    def failover(self, dead_rank):
+        # same nesting order as dispatch()
+        with self._lease_lock:
+            with self._ledger_lock:
+                pass

@@ -333,6 +333,186 @@ def test_chaos_disabled_fault_config_is_byte_identical():
     assert baseline == with_keys
 
 
+def test_chaos_sync_duplicate_upload_commits_once():
+    """A client that re-sends its round upload (it rejoined mid-round after
+    already sending) must not advance the barrier or double-count in the
+    fold — slot-keyed uploads make duplicates structurally idempotent."""
+    args = _args(comm_round=1)
+    hub = LoopbackHub()
+    server = FedML_Horizontal(args, 0, 2, backend="LOOPBACK", hub=hub)
+    server.register_message_receive_handlers()
+    server.start()
+    server.receive_message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, _online(1))
+    server.receive_message(MyMessage.MSG_TYPE_C2S_CLIENT_STATUS, _online(2))
+    server.receive_message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
+                           _upload(server, 1))
+    assert server.history == []  # round open, waiting on client 2
+    server.receive_message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
+                           _upload(server, 1))  # duplicate
+    assert server.history == []  # the duplicate must NOT close the barrier
+    assert server.aggregator.received_count == 1
+    server.receive_message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
+                           _upload(server, 2))
+    assert len(server.history) == 1
+    # a post-commit re-send of the same round is stale and ignored
+    server.receive_message(MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER,
+                           _upload(server, 1))
+    assert len(server.history) == 1
+
+
+def test_chaos_async_server_restart_no_duplicate_commits(tmp_path):
+    """Async (FedBuff-style) server dies mid-run and restarts from the
+    round-state checkpoint while its free-running clients keep going. The
+    in-flight uploads that raced the crash are replayed to the fresh
+    incarnation AND re-sent by the rejoining clients — the per-sender
+    sequence numbers resumed from the checkpoint must commit every update
+    exactly once, and the version log must stay retention-bounded."""
+    cfg = dict(
+        dataset="mnist", model="lr", debug_small_data=True,
+        client_num_in_total=2, client_num_per_round=2, comm_round=4,
+        learning_rate=0.1, epochs=1, batch_size=8, frequency_of_the_test=1,
+        random_seed=0, async_mode=True, async_buffer_size=2,
+        round_ckpt_path=str(tmp_path / "round_state.msgpack"),
+        ckpt_every_rounds=1, round_store_keep_versions=2,
+    )
+    # phase 1: the incarnation that dies once it touches version-2 traffic —
+    # after at least one commit is checkpointed, before the run finishes.
+    args_a = fedml_tpu.init(config={**cfg, "fault_crash_rank": 0,
+                                    "fault_crash_at_round": 2})
+    hub = LoopbackHub()
+    server_a = FedML_Horizontal(args_a, 0, 2, backend="LOOPBACK", hub=hub)
+    clients = [FedML_Horizontal(args_a, rank, 2, backend="LOOPBACK", hub=hub)
+               for rank in (1, 2)]
+    client_threads = [threading.Thread(target=c.run, daemon=True)
+                      for c in clients]
+    for t in client_threads:
+        t.start()
+    server_a.start()
+    thread_a = threading.Thread(target=server_a.run, daemon=True)
+    thread_a.start()
+    thread_a.join(timeout=60)
+    assert not thread_a.is_alive(), "crashed server's loop did not exit"
+    assert server_a.com_manager.crashed
+    assert 1 <= server_a.model_version < 4  # died mid-run, post-commit
+
+    # phase 2: fresh incarnation on the same hub + checkpoint. The dead
+    # server's queue holds the uploads that raced the crash — replay them
+    # (real transports redeliver; the rejoining clients will ALSO re-send
+    # theirs after the resumed INIT, so both duplicate paths are exercised).
+    stale = hub.register(0)
+    in_flight = []
+    while not stale.empty():
+        data = stale.get_nowait()
+        if data is not None:
+            m = Message.from_bytes(data)
+            if m.get_type() == MyMessage.MSG_TYPE_C2S_SEND_MODEL_TO_SERVER:
+                in_flight.append(data)
+    args_b = fedml_tpu.init(config=cfg)
+    server_b = FedML_Horizontal(args_b, 0, 2, backend="LOOPBACK", hub=hub)
+    assert server_b.model_version == server_a.model_version  # resumed
+    assert server_b.committed_updates == 2 * server_a.model_version
+    for data in in_flight:
+        hub.post(0, data)
+    thread_b = threading.Thread(target=server_b.run, daemon=True)
+    thread_b.start()
+    server_b.start()  # re-probes; the still-running clients answer ONLINE
+    thread_b.join(timeout=90)
+    assert not thread_b.is_alive(), "resumed server did not finish"
+    for t in client_threads:
+        t.join(timeout=10)
+        assert not t.is_alive()
+
+    # exactly-once across both incarnations: 4 commits of K=2, no update
+    # lost to the crash and none committed twice despite the replays
+    assert server_b.model_version == 4
+    assert server_b.committed_updates == 8
+    assert server_b.shed_updates == 0
+    # every commit folded exactly K updates (a free-running client may land
+    # two consecutive sequences in one commit — that is not a duplicate;
+    # exactly-once is per (sender, sequence), pinned by the totals above)
+    assert all(e[1] == 2 and len(e[2]) == 2 for e in server_b._version_log)
+    # retention: the log carries only the last keep_versions commits
+    assert [e[0] for e in server_b._version_log] == [3, 4]
+
+
+# --- hierarchical-federation drills (leaf crash / partition) ------------------
+
+
+def test_tier_drill_leaf_crash_exactly_once():
+    """A leaf aggregator killed mid-generation (shard persisted, upload
+    lost): the root must rehydrate the dead leaf's chunk, every client's
+    update commits exactly once, and the final model matches the fault-free
+    reference within the accuracy gate."""
+    from fedml_tpu.cross_silo.chaos import run_tier_drill
+
+    result = run_tier_drill(scenario="leaf_crash")
+    assert result.ok, result.summary()
+    assert result.failovers == 1
+    assert result.rehydrations == 1
+    assert result.duplicate_commits == 0
+    assert result.committed_updates == result.expected_updates
+    rec = result.json_record()
+    assert rec["ok"] and rec["scenario"] == "leaf_crash"
+
+
+def test_tier_drill_partition_heals():
+    """A root<->leaf cut for one round window: the orphaned chunk recomputes
+    on a survivor (no shard store in this drill), the cut heals after the
+    window, and the exactly-once + accuracy gates hold."""
+    from fedml_tpu.cross_silo.chaos import run_tier_drill
+
+    result = run_tier_drill(scenario="partition")
+    assert result.ok, result.summary()
+    assert result.failovers == 1
+    assert result.rehydrations == 0  # no shard dir -> recompute path
+    assert result.duplicate_commits == 0
+    assert result.faults_injected.get("partition", 0) >= 1
+
+
+# --- version-log retention boundary (tiered plane, satellite) ----------------
+
+
+def test_tier_version_log_retention_resume_is_bit_exact(tmp_path):
+    """Resume a tiered run from a checkpoint taken PAST the version-log
+    retention boundary (more commits than keep_versions): the resumed run
+    must finish bit-identical to an uninterrupted one, and the trimmed log
+    must keep exactly the last-N window through the restart."""
+    import jax
+
+    from fedml_tpu.simulation.federation import build_tiered_simulator
+
+    cfg = dict(
+        dataset="mnist", model="lr", debug_small_data=True,
+        client_num_in_total=6, client_num_per_round=4, comm_round=5,
+        learning_rate=0.05, epochs=1, batch_size=8, frequency_of_the_test=1,
+        random_seed=0, hier_num_leaves=2, group_comm_round=2,
+        round_store_keep_versions=2,
+    )
+    ref, _ = build_tiered_simulator(fedml_tpu.init(config=cfg))
+    ref.run(None, log_fn=None)
+    assert [e[0] for e in ref.state.version_log] == [4, 5]  # trimmed to 2
+
+    ckpt = str(tmp_path / "tier_state.msgpack")
+    part, _ = build_tiered_simulator(fedml_tpu.init(
+        config={**cfg, "comm_round": 3, "round_ckpt_path": ckpt}))
+    part.run(None, log_fn=None)
+    # 3 commits > keep 2: the checkpointed log already lost version 1
+    assert [e[0] for e in part.state.version_log] == [2, 3]
+
+    resumed, _ = build_tiered_simulator(fedml_tpu.init(
+        config={**cfg, "round_ckpt_path": ckpt}))
+    assert resumed.state.start_round == 3
+    assert resumed.state.model_version == 3
+    assert [e[0] for e in resumed.state.version_log] == [2, 3]
+    resumed.run(None, log_fn=None)
+    assert [e[0] for e in resumed.state.version_log] == [4, 5]
+
+    for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(ref.params)),
+                    jax.tree_util.tree_leaves(
+                        jax.device_get(resumed.params))):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_straggler_drill_gates_goodput_and_accuracy():
     """The buffered-async straggler drill (PR 14 acceptance): under 10×
     seeded heavy-tail skew the async engine's goodput (committed updates

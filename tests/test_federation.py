@@ -1,0 +1,200 @@
+"""Tiered federation (tentpole): the single-process reference driver vs the
+real multi-process tier plane — bit-identity over loopback AND grpc, exact
+phase accounting, leaf-crash failover with shard rehydration, partition
+healing with re-adoption, and fixed logical shards under elastic membership.
+"""
+
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.core import telemetry
+from fedml_tpu.cross_silo.chaos import TIER_DEFAULTS
+from fedml_tpu.simulation.federation import (
+    TierConfig,
+    build_tiered_simulator,
+    round_chunks,
+    run_tiered_federation,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.configure(enabled=True, reset=True)
+    yield
+    telemetry.configure(enabled=True, reset=True)
+
+
+def _cfg(**overrides):
+    cfg = dict(TIER_DEFAULTS)
+    cfg.update(overrides)
+    return cfg
+
+
+def _leaves(params):
+    import jax
+
+    return [np.asarray(x) for x in jax.tree_util.tree_leaves(params)]
+
+
+def _assert_params_equal(a, b):
+    la, lb = _leaves(a), _leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(x, y)
+
+
+def _reference(cfg):
+    sim, apply_fn = build_tiered_simulator(fedml_tpu.init(config=cfg))
+    hist = sim.run(apply_fn, log_fn=None)
+    return sim, hist
+
+
+def _train_metrics(history):
+    return [(r["round"], r["train_loss"], r["train_acc"]) for r in history]
+
+
+# --- bit-identity: reference vs the wire --------------------------------------
+
+
+def test_single_process_reference_is_repeatable():
+    cfg = _cfg(comm_round=2)
+    sim1, hist1 = _reference(cfg)
+    sim2, hist2 = _reference(cfg)
+    _assert_params_equal(sim1.params, sim2.params)
+    assert _train_metrics(hist1) == _train_metrics(hist2)
+
+
+def test_loopback_tier_bit_identical_to_reference():
+    cfg = _cfg(comm_round=3)
+    ref_sim, ref_hist = _reference(cfg)
+    root = run_tiered_federation(fedml_tpu.init(config=cfg))
+    assert len(root.history) == cfg["comm_round"]
+    _assert_params_equal(root.sim.params, ref_sim.params)
+    assert _train_metrics(root.history) == _train_metrics(ref_hist)
+    # exactly-once over the wire: every cohort member committed, no dups
+    ledger = root.state.ledger
+    assert int(ledger.total_commits) == (cfg["comm_round"]
+                                         * cfg["client_num_per_round"])
+    assert int(ledger.duplicates) == 0
+    assert root.failovers == 0 and root.rehydrations == 0
+
+
+def test_grpc_tier_bit_identical_to_reference():
+    cfg = _cfg(comm_round=2, grpc_base_port=27890)
+    ref_sim, ref_hist = _reference(cfg)
+    root = run_tiered_federation(fedml_tpu.init(config=cfg), backend="GRPC")
+    _assert_params_equal(root.sim.params, ref_sim.params)
+    assert _train_metrics(root.history) == _train_metrics(ref_hist)
+    assert int(root.state.ledger.duplicates) == 0
+
+
+# --- phase accounting ---------------------------------------------------------
+
+
+def test_reference_phase_sums_are_exact():
+    _, hist = _reference(_cfg(comm_round=2))
+    for rec in hist:
+        phases = rec["phases"]
+        assert {"device", "fold", "checkpoint", "host_other"} <= set(phases)
+        assert abs(sum(phases.values()) - rec["round_time"]) < 1e-9
+
+
+def test_root_phase_sums_are_exact():
+    root = run_tiered_federation(fedml_tpu.init(config=_cfg(comm_round=2)))
+    for rec in root.history:
+        phases = rec["phases"]
+        assert {"dispatch", "leaf_wait", "fold",
+                "checkpoint", "host_other"} <= set(phases)
+        assert abs(sum(phases.values()) - rec["round_time"]) < 1e-9
+        # the wait for leaf partials dominates a wire round; it must be
+        # attributed, not lumped into host_other
+        assert phases["leaf_wait"] >= 0.0
+
+
+# --- failure story ------------------------------------------------------------
+
+
+def test_leaf_crash_failover_rehydrates_and_stays_bit_identical(tmp_path):
+    cfg = _cfg(comm_round=3)
+    ref_sim, ref_hist = _reference(cfg)
+    faulted = _cfg(comm_round=3, hier_shard_dir=str(tmp_path),
+                   fault_leaf_crash_rank=1, fault_leaf_crash_at_round=1)
+    root = run_tiered_federation(fedml_tpu.init(config=faulted))
+    # the leaf dies on the SEND path — its partial exists on disk and the
+    # root recovers it from the shard store instead of recomputing
+    assert root.failovers >= 1
+    assert root.rehydrations >= 1
+    ledger = root.state.ledger
+    assert int(ledger.duplicates) == 0
+    assert int(ledger.total_commits) == (cfg["comm_round"]
+                                         * cfg["client_num_per_round"])
+    _assert_params_equal(root.sim.params, ref_sim.params)
+    assert _train_metrics(root.history) == _train_metrics(ref_hist)
+
+
+def test_partition_heals_and_leaf_is_readopted():
+    cfg = _cfg(comm_round=4)
+    ref_sim, ref_hist = _reference(cfg)
+    # cut root<->leaf1 for round 1 only; leaf 2 is made deterministically
+    # slow so rounds outlast the heartbeat interval — the healed leaf's
+    # heartbeats need wall-clock room to land before the run ends
+    faulted = _cfg(comm_round=4,
+                   fault_partition_ranks_a=[0], fault_partition_ranks_b=[1],
+                   fault_partition_rounds=(1, 2),
+                   fault_slow_leaf_ranks=[2], fault_slow_leaf_delay_s=0.3)
+    root = run_tiered_federation(fedml_tpu.init(config=faulted))
+    assert root.failovers >= 1  # the cut round was recovered by the root
+    counters = telemetry.get_registry().snapshot()["counters"]
+    assert counters.get("fedml_faults_injected_total{action=partition}", 0) > 0
+    # elastic membership, both directions: leaf 1 was expelled during the
+    # window and re-adopted (heartbeat-as-rejoin) after it closed
+    assert counters.get("fedml_faults_injected_total{action=leaf_join}",
+                        0) >= 1
+    with root._membership_lock:
+        assert root._live == {1, 2}
+    # and none of it moved the math
+    ledger = root.state.ledger
+    assert int(ledger.duplicates) == 0
+    _assert_params_equal(root.sim.params, ref_sim.params)
+    assert _train_metrics(root.history) == _train_metrics(ref_hist)
+
+
+# --- fixed logical shards -----------------------------------------------------
+
+
+def test_round_chunks_are_membership_independent():
+    """The cohort is always split into ``num_leaves`` chunks at the same
+    offsets — membership elasticity changes which process computes a chunk,
+    never the chunk boundaries. That invariant is what makes every
+    membership history bit-identical to the reference."""
+    sim, _ = build_tiered_simulator(
+        fedml_tpu.init(config=_cfg(comm_round=1)))
+    cfg, tier = sim.cfg, sim.tier
+    ids_a, chunks_a = round_chunks(cfg, tier, 0)
+    ids_b, chunks_b = round_chunks(cfg, tier, 0)
+    assert list(ids_a) == list(ids_b)
+    assert chunks_a == chunks_b
+    assert len(chunks_a) == tier.num_leaves
+    # chunks tile the cohort contiguously, no gaps or overlaps
+    flat = [c for chunk in chunks_a for c in chunk["client_ids"]]
+    assert flat == [int(c) for c in ids_a]
+    assert [c["lo"] for c in chunks_a] == [
+        sum(len(chunks_a[j]["client_ids"]) for j in range(i))
+        for i in range(len(chunks_a))]
+    # a different round resamples the cohort
+    ids_c, _ = round_chunks(cfg, tier, 1)
+    assert list(ids_c) != list(ids_a)
+
+
+def test_tier_config_from_args_reads_hier_keys():
+    args = fedml_tpu.init(config=_cfg(
+        comm_round=1, hier_num_leaves=3, lease_ttl_s=2.5,
+        lease_heartbeat_s=0.7, hier_staleness_alpha=0.25,
+        round_store_keep_versions=4))
+    tier = TierConfig.from_args(args)
+    assert tier.num_leaves == 3
+    assert tier.lease_ttl_s == 2.5
+    assert tier.heartbeat_s == 0.7
+    assert tier.staleness_alpha == 0.25
+    assert tier.keep_versions == 4

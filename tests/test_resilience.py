@@ -3,6 +3,7 @@ the per-backend failure-context satellites (grpc context, mqtt_s3 orphan
 blob, observer isolation, round-state store)."""
 
 import threading
+import time
 from types import SimpleNamespace
 
 import numpy as np
@@ -15,6 +16,8 @@ from fedml_tpu.comm.resilience import (
     FaultPlan,
     FaultRule,
     FaultyCommManager,
+    LeaseTable,
+    NetworkPartition,
     RetryPolicy,
     SendFailure,
     TransientSendError,
@@ -263,6 +266,173 @@ def test_faulty_wrapper_crash_blackholes_both_directions():
     mgr.send_message(_msg(3, 1, 0, round_idx=1))  # a dead process sends nothing
     assert hub.register(0).qsize() == 1
     assert _counters().get("fedml_faults_injected_total{action=crash}") == 1
+
+
+# --- network partitions (tiered-federation satellite) -------------------------
+
+
+def test_network_partition_key_is_canonical():
+    a = NetworkPartition(frozenset({0}), frozenset({1, 2}), rounds=(1, 3))
+    b = NetworkPartition(frozenset({1, 2}), frozenset({0}), rounds=(1, 3))
+    assert a.key == b.key  # which side is "A" is not part of the identity
+    c = NetworkPartition(frozenset({0}), frozenset({1, 2}), rounds=(2, 4))
+    assert c.key != a.key  # the round window is
+
+
+def test_network_partition_overlapping_sides_rejected():
+    with pytest.raises(ValueError):
+        NetworkPartition(frozenset({0, 1}), frozenset({1, 2}))
+
+
+def test_network_partition_window_is_half_open():
+    p = NetworkPartition(frozenset({0}), frozenset({1}), rounds=(1, 3))
+    assert not p.in_window(0)
+    assert p.in_window(1) and p.in_window(2)
+    assert not p.in_window(3)  # [start, stop)
+    assert not p.in_window(None)  # round-less traffic skips a windowed cut
+    assert NetworkPartition(frozenset({0}), frozenset({1})).in_window(None)
+
+
+def test_partition_drops_only_cut_crossing_traffic():
+    plan = FaultPlan(seed=0, partition=NetworkPartition(
+        frozenset({0, 2}), frozenset({1})))
+    assert plan.active
+    assert plan.should_partition(_msg(3, 1, 0))
+    assert plan.should_partition(_msg(3, 0, 1))  # both directions
+    assert not plan.should_partition(_msg(3, 2, 0))  # same side passes
+
+
+def test_partition_round_hint_unsticks_stale_stamps():
+    """A cut-off peer keeps stamping its last-known round; the receiver
+    judges the window against max(stamp, its own clock), so the cut holds
+    while the window is open and heals the moment the receiver's clock
+    leaves it."""
+    plan = FaultPlan(seed=0, partition=NetworkPartition(
+        frozenset({0}), frozenset({1}), rounds=(1, 3)))
+    stale = _msg(3, 1, 0, round_idx=1)
+    assert plan.should_partition(stale, round_hint=2)   # clock still inside
+    assert not plan.should_partition(stale, round_hint=3)  # healed
+    # the hint alone drives round-less traffic (heartbeats) into the window
+    assert plan.should_partition(_msg(3, 1, 0), round_hint=1)
+    assert not plan.should_partition(_msg(3, 1, 0), round_hint=0)
+
+
+def test_flaky_partition_replays_identically():
+    def draws(seed):
+        plan = FaultPlan(seed=seed, partition=NetworkPartition(
+            frozenset({0}), frozenset({1}), rate=0.5))
+        return [plan.should_partition(_msg(3, 1, 0)) for _ in range(80)]
+
+    a = draws(7)
+    assert a == draws(7)  # sha256-derived: bit-identical replay
+    assert any(a) and not all(a)  # lossy, not absolute
+    assert draws(8) != a  # a different seed reshuffles the cut
+
+
+def test_partition_sequence_space_isolated_from_wire_faults():
+    """Adding a partition must not reshuffle the wire-fault draws — each
+    consumes its own per-edge sequence space."""
+    rules = (FaultRule("drop", 0.5),)
+    with_cut = FaultPlan(seed=7, rules=rules, partition=NetworkPartition(
+        frozenset({5}), frozenset({6}), rate=0.5))
+    without = FaultPlan(seed=7, rules=rules)
+    a, b = [], []
+    for _ in range(40):
+        a.append(with_cut.decide(_msg(3, 1, 0)).drop)
+        with_cut.should_partition(_msg(3, 5, 6))  # burns only part: sequence
+        b.append(without.decide(_msg(3, 1, 0)).drop)
+    assert a == b
+
+
+def test_fault_plan_from_args_partition():
+    plan = FaultPlan.from_args(SimpleNamespace(
+        fault_partition_ranks_a=[0], fault_partition_ranks_b=[1, 2],
+        fault_partition_rounds=(1, 2)))
+    assert plan is not None and plan.active
+    assert plan.partition.ranks_a == frozenset({0})
+    assert plan.partition.ranks_b == frozenset({1, 2})
+    assert plan.partition.rounds == (1, 2) and plan.partition.rate == 1.0
+    # one side alone configures nothing (the byte-parity contract)
+    assert FaultPlan.from_args(
+        SimpleNamespace(fault_partition_ranks_a=[0])) is None
+
+
+@pytest.mark.parametrize("backend", ["loopback", "grpc", "trpc", "mqtt_s3"])
+def test_partition_composes_with_wrapper_on_every_backend(backend):
+    """The windowed cut drops crossing traffic at the wrapped RECEIVER on
+    every transport, and heals once the receiver's round clock leaves the
+    window — even for a stale-stamped straggler."""
+    if backend == "loopback":
+        hub = LoopbackHub()
+        inner = LoopbackCommManager(rank=0, size=2, hub=hub)
+        sender = LoopbackCommManager(rank=1, size=2, hub=hub)
+    elif backend == "grpc":
+        from fedml_tpu.comm.grpc_backend import GRPCCommManager
+
+        inner = GRPCCommManager(rank=0, size=2, base_port=26890)
+        sender = GRPCCommManager(rank=1, size=2, base_port=26890)
+    elif backend == "trpc":
+        from fedml_tpu.comm.trpc_backend import TRPCCommManager
+
+        inner = TRPCCommManager(rank=0, size=2, base_port=26990)
+        sender = TRPCCommManager(rank=1, size=2, base_port=26990)
+    else:
+        from fedml_tpu.comm import (InMemoryBlobStore, InProcessBroker,
+                                    MqttS3CommManager)
+
+        broker, store = InProcessBroker(), InMemoryBlobStore()
+        inner = MqttS3CommManager(broker, store, rank=0, size=2)
+        sender = MqttS3CommManager(broker, store, rank=1, size=2)
+
+    plan = FaultPlan(seed=0, partition=NetworkPartition(
+        frozenset({0}), frozenset({1}), rounds=(1, 3)))
+    mgr = FaultyCommManager(inner, plan, rank=0, retry_policy=FAST)
+    got = []
+    mgr.add_observer(SimpleNamespace(
+        receive_message=lambda t, m: got.append(m.get("round_idx"))))
+    loop = threading.Thread(target=mgr.handle_receive_message, daemon=True)
+    loop.start()
+    try:
+        sender.send_message(_msg(3, 1, 0, round_idx=0))  # pre-window
+        sender.send_message(_msg(3, 1, 0, round_idx=1))  # cut
+        sender.send_message(_msg(3, 1, 0, round_idx=2))  # cut
+        sender.send_message(_msg(3, 1, 0, round_idx=3))  # window closed
+        # stale straggler: the receiver's clock is already at 3, so the cut
+        # stays healed for a round-1 stamp
+        sender.send_message(_msg(3, 1, 0, round_idx=1))
+        deadline = time.time() + 10
+        while len(got) < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        assert got == [0, 3, 1]
+        assert _counters().get(
+            "fedml_faults_injected_total{action=partition}") == 2
+    finally:
+        mgr.stop_receive_message()
+        sender.stop_receive_message()
+        loop.join(timeout=5)
+
+
+# --- lease table (tiered-federation tentpole) ---------------------------------
+
+
+def test_lease_table_expiry_renewal_and_drop():
+    now = [0.0]
+    lt = LeaseTable(ttl_s=1.0, clock=lambda: now[0])
+    lt.renew(1)
+    lt.renew(2)
+    assert lt.live() == (1, 2) and lt.expired() == ()
+    assert lt.holds(1)
+    now[0] = 0.9
+    lt.renew(2)
+    now[0] = 1.5
+    assert lt.live() == (2,)  # 1's lease lapsed, 2's was renewed in time
+    assert lt.expired() == (1,)
+    assert not lt.holds(1) and lt.holds(2)
+    # expired() leaves the verdict to the caller: a late heartbeat re-admits
+    lt.renew(1)
+    assert lt.expired() == () and lt.live() == (1, 2)
+    lt.drop(1)
+    assert lt.live() == (2,) and not lt.holds(1)
 
 
 # --- observer isolation (satellite) ------------------------------------------

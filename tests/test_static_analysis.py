@@ -363,6 +363,43 @@ def test_async_engine_scope_silent_on_clean_fixture():
         relpath=_ASYNC_ENGINE) == []
 
 
+# ------------------------------------- tiered federation (thread + locks)
+
+_FEDERATION = "fedml_tpu/simulation/federation.py"
+_HIERARCHICAL = "fedml_tpu/simulation/hierarchical.py"
+
+
+def test_federation_scope_fires_on_bad_fixture():
+    # the tiered-federation modules are in both checkers' scope: a
+    # heartbeat thread reading the round counter the receive handlers
+    # write unguarded must fire thread-hazard, and opposite lease/ledger
+    # lock nesting on the dispatch vs failover paths must fire lock-order
+    hazards = _run_on_fixture(
+        ThreadHazardChecker, "federation_bad.py", relpath=_FEDERATION)
+    assert "hazard:BadLeafWorker._round" in {f.key for f in hazards}
+    locks = _run_on_fixture(
+        LockOrderChecker, "federation_bad.py", relpath=_FEDERATION)
+    msgs = "\n".join(f.message for f in locks)
+    assert "lock acquisition cycle" in msgs
+    assert "time.sleep" in msgs
+
+
+def test_federation_scope_silent_on_clean_fixture():
+    # lock-guarded round accessors + a single lease-before-ledger order
+    # (sleep outside the critical section): both checkers stay quiet, so
+    # the real modules' discipline is the enforced shape
+    for relpath in (_FEDERATION, _HIERARCHICAL):
+        assert _run_on_fixture(
+            ThreadHazardChecker, "federation_clean.py", relpath=relpath) == []
+        assert _run_on_fixture(
+            LockOrderChecker, "federation_clean.py", relpath=relpath) == []
+
+
+def test_federation_fixture_out_of_scope_by_default():
+    assert _run_on_fixture(ThreadHazardChecker, "federation_bad.py") == []
+    assert _run_on_fixture(LockOrderChecker, "federation_bad.py") == []
+
+
 # ----------------------------------------------------------- suppression
 
 def _no_print_over(tmp_path, source):
