@@ -686,6 +686,152 @@ def agg_sweep_bench(cohorts=(1000, 10000), codecs=("none", "q4"),
     return 0 if ok else 1
 
 
+def round_scan_bench(cohorts=(1000, 10000), scan_rs=(1, 2, 8, 32),
+                     pool: int = 12000, measured_blocks: int = 2,
+                     out_path: str = "BENCH_r09.json") -> int:
+    """``--round-scan``: compiled multi-round dispatch sweep — rounds/sec
+    per (cohort, rounds_per_dispatch) cell on the BENCH_r07 10k workload
+    (FedAvg lr on synthetic blobs, sanitizer on, krum cell config), with
+    the exact per-phase attribution asserted per round. The R=1 cell runs
+    the classic per-round engine with prefetch off — the same protocol
+    BENCH_r07's 6.92 r/s sync krum/none baseline used — so the speedup
+    column is like for like.
+
+    Two findings ride in the JSON: ``glue_s_per_round`` (pack_wait +
+    scan_pack + host_other, the host-orchestration cost the scan
+    amortizes — ~137 ms/round in BENCH_r07, sub-millisecond at R>=8) and
+    a note that r07's ~50 us ``device`` phase was an async-dispatch
+    measurement artifact: with the host glue gone, the round's genuine
+    XLA compute (local-training GEMMs + gather + sanitize) is exposed as
+    the new floor, so single-core CPU speedup saturates well below the
+    glue-amortization factor."""
+    import math
+
+    import numpy as np
+
+    import jax
+    import fedml_tpu
+    from fedml_tpu.data.federated import ArrayPair, build_federated_data
+    from fedml_tpu.simulation import build_simulator
+
+    spc, dim, class_num = 8, 64, 2
+    rng = np.random.default_rng(0)
+    n = pool * spc
+    y = (np.arange(n) % class_num).astype(np.int64)
+    x = rng.normal(size=(n, dim)).astype(np.float32) \
+        + 2.0 * y[:, None].astype(np.float32)
+    net_map = {c: list(range(c * spc, (c + 1) * spc)) for c in range(pool)}
+    fed = build_federated_data(
+        ArrayPair(x, y), ArrayPair(x[:64], y[:64]), net_map, class_num)
+
+    def _run_cell(per_round, scan_r):
+        # no apply_fn and an out-of-range eval frequency → no hook cuts, so
+        # the plan is pure R-blocks; a round count that is an exact multiple
+        # of R avoids a short tail block (which would compile a second
+        # program inside the measured window). Skip the first block — it
+        # carries the one compile — and measure the steady-state blocks.
+        warmup = scan_r
+        rounds = scan_r * (1 + measured_blocks)
+        args = fedml_tpu.init(config=dict(
+            dataset="synthetic_blobs", model="lr",
+            client_num_in_total=pool, client_num_per_round=int(per_round),
+            comm_round=rounds, learning_rate=0.1, epochs=1, batch_size=spc,
+            frequency_of_the_test=10_000, random_seed=0,
+            federated_optimizer="FedAvg",
+            defense_type="krum", byzantine_n=2,
+            sanitize_updates=True,
+            rounds_per_dispatch=int(scan_r),
+            # R=1 replays BENCH_r07's sync protocol exactly; fused blocks
+            # run with the block prefetcher engaged (its intended mode)
+            prefetch=scan_r > 1,
+        ))
+        sim, _ = build_simulator(args, fed_data=fed)
+        hist = sim.run(apply_fn=None, log_fn=None)
+        recs = hist[warmup:]
+        wall = sum(r["round_time"] for r in recs)
+        acc, sums_ok = {}, True
+        for r in recs:
+            ps = r["phases"]
+            sums_ok = sums_ok and math.isclose(
+                sum(ps.values()), r["round_time"],
+                rel_tol=1e-6, abs_tol=1e-9)
+            for k, v in ps.items():
+                acc[k] = acc.get(k, 0.0) + v
+        per = {k: v / len(recs) for k, v in acc.items()}
+        glue = per.get("pack_wait", 0.0) + per.get("scan_pack", 0.0) \
+            + per.get("host_other", 0.0)
+        return {
+            "cohort": int(per_round),
+            "rounds_per_dispatch": int(scan_r),
+            "measured_rounds": len(recs),
+            "rounds_per_sec": round(len(recs) / wall, 4) if wall else None,
+            "glue_s_per_round": round(glue, 6),
+            "phase_breakdown_s": {k: round(v, 6)
+                                  for k, v in sorted(per.items())},
+            "phase_sum_equals_round_time": bool(sums_ok),
+        }
+
+    try:
+        with open("BENCH_r07.json") as f:
+            r07 = json.load(f)
+        base = next(c["unfused"]["rounds_per_sec"] for c in r07["results"]
+                    if c["cohort"] == 10000 and c["defense"] == "krum"
+                    and c["codec"] == "none")
+    except Exception:  # noqa: BLE001 — missing artifact must not kill the run
+        base = None
+
+    results = []
+    for per_round in cohorts:
+        for scan_r in scan_rs:
+            cell = _run_cell(per_round, scan_r)
+            results.append(cell)
+            print(f"round-scan: cohort={per_round} R={scan_r} "
+                  f"{cell['rounds_per_sec']} r/s "
+                  f"glue={cell['glue_s_per_round'] * 1e3:.2f} ms/round "
+                  f"sums_exact={cell['phase_sum_equals_round_time']}",
+                  file=sys.stderr, flush=True)
+
+    all_sums = all(c["phase_sum_equals_round_time"] for c in results)
+    best_10k = max((c["rounds_per_sec"] or 0.0) for c in results
+                   if c["cohort"] == 10000 and c["rounds_per_dispatch"] >= 8)
+    speedup = round(best_10k / base, 3) if base else None
+    r1_10k = next((c for c in results if c["cohort"] == 10000
+                   and c["rounds_per_dispatch"] == 1), None)
+    line = {
+        "metric": "round_scan_dispatch",
+        "unit": (f"rounds/sec per (cohort, rounds_per_dispatch) cell, "
+                 f"FedAvg lr on synthetic blobs ({pool}-client pool, "
+                 f"{spc} samples x dim {dim}), sanitizer on, BENCH_r07 "
+                 f"krum/none cell protocol; R=1 sync prefetch-off"),
+        "backend": jax.default_backend(),
+        "results": results,
+        "baseline_r07_10k_rounds_per_sec": base,
+        "speedup_10k_scan_vs_r07": speedup,
+        "glue_amortized_10k_s": (r1_10k or {}).get("glue_s_per_round"),
+        "phase_sums_exact": bool(all_sums),
+        "note": ("BENCH_r07's ~50us 'device' phase was an async-dispatch "
+                 "artifact: XLA round compute hid inside pack_wait's "
+                 "timeslices. With packing device-side and host glue "
+                 "amortized over the block, the genuine per-round XLA "
+                 "compute (local-update GEMMs + data gather + sanitize) "
+                 "is the exposed floor, so rounds/sec saturates at that "
+                 "floor on a single-core CPU host."),
+    }
+    print(json.dumps(line), flush=True)
+    try:
+        with open(out_path, "w") as f:
+            json.dump(line, f, indent=1)
+            f.write("\n")
+    except OSError as e:
+        print(f"round-scan: could not write {out_path}: {e}",
+              file=sys.stderr, flush=True)
+    print(f"round-scan: phase_sums_exact={all_sums} "
+          f"best_10k_scan={best_10k} r/s vs r07 {base} "
+          f"(speedup={speedup}) -> {out_path}",
+          file=sys.stderr, flush=True)
+    return 0 if all_sums else 1
+
+
 def model_sweep_bench(model_axes=(1, 2, 4), rounds: int = 3) -> int:
     """``--model-sweep``: CPU-only memory-scaling sweep of the 2-D federated
     mesh — the same SCAFFOLD mnist/lr round loop on a fixed client axis (2)
@@ -1153,4 +1299,9 @@ if __name__ == "__main__":
         # check-in overload drill — host threads + codec only, no chip
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         sys.exit(loadgen_bench())
+    if "--round-scan" in sys.argv:
+        # compiled multi-round dispatch frontier — CPU backend; exits
+        # nonzero if any round's phase breakdown fails the exactness check
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        sys.exit(round_scan_bench())
     sys.exit(main())

@@ -23,7 +23,13 @@ sync anywhere in them stalls the aggregation hot path by construction.
 The walk deliberately does NOT descend into phase-boundary planes, where
 readback is the point: input-building/packing (``build_round_inputs``,
 ``_build_*``), eval/test, checkpoint/snapshot/restore/export, and
-reporting helpers. Known-deliberate syncs inside hot functions (the
+reporting helpers. Functions handed to structured-control-flow HOFs are
+the exception: a callback passed to ``lax.scan``/``lax.fori_loop``/
+``lax.while_loop`` is rooted directly even when its *definition site* is
+a cold ``_build_*`` factory — the compiled multi-round dispatch builds
+its scanned round body inside such a factory, and a host round-trip
+inside that body would stall (or constant-fold) the whole fused block,
+not just one round. Known-deliberate syncs inside hot functions (the
 self-heal verdict that gates the round, the deferred metrics readback)
 carry inline ``# graftcheck: disable=host-sync`` suppressions with their
 rationale — new ones should be argued for the same way.
@@ -72,9 +78,43 @@ _PLACEMENT = {"device_put", "device_put_sharded", "device_put_replicated",
 
 _REDUCTIONS = {"mean", "sum", "max", "min", "prod"}
 
+# structured-control-flow HOFs whose callback arguments execute inside the
+# compiled region: positional indices of the function-valued arguments
+# (lax.scan(f, ...), lax.fori_loop(lo, hi, body, init),
+# lax.while_loop(cond, body, init)) plus their keyword spellings
+_HOF_CALLBACKS: Dict[str, Tuple[Tuple[int, ...], Tuple[str, ...]]] = {
+    "scan": ((0,), ("f",)),
+    "fori_loop": ((2,), ("body_fun",)),
+    "while_loop": ((0, 1), ("cond_fun", "body_fun")),
+}
+
 
 def _is_cold(name: str) -> bool:
     return name.startswith(_COLD_PREFIXES)
+
+
+def _hof_body_names(tree: ast.AST) -> Dict[str, int]:
+    """Names of local functions passed as lax.scan/fori_loop/while_loop
+    callbacks anywhere in the module (cold factories included), mapped to
+    the HOF call's line. Only plain-name callbacks are collected — a
+    lambda body has no def to root (its sinks would be caught at the
+    lambda's enclosing function if that is hot)."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = dotted_name(node.func) or ""
+        parts = fname.split(".")
+        spec = _HOF_CALLBACKS.get(parts[-1])
+        if spec is None or parts[0] not in ("jax", "lax"):
+            continue
+        pos, kws = spec
+        cands = [node.args[i] for i in pos if i < len(node.args)]
+        cands += [kw.value for kw in node.keywords if kw.arg in kws]
+        for arg in cands:
+            if isinstance(arg, ast.Name):
+                out.setdefault(arg.id, node.lineno)
+    return out
 
 
 class HostSyncChecker(Checker):
@@ -108,10 +148,23 @@ class HostSyncChecker(Checker):
                 # kernels AND wrappers: every top-level def in a kernel
                 # module is on the compiled round step's dispatch path
                 roots.append(f)
-        if not roots:
+        hof_roots = []
+        hof_bodies = _hof_body_names(module.tree)
+        for f in funcs:
+            if f.simple in hof_bodies and f not in roots:
+                hof_roots.append(f)
+        if not roots and not hof_roots:
             return []
 
-        reachable = self._reach(funcs, by_simple, roots)
+        reachable = self._reach(funcs, by_simple, roots) if roots else {}
+        if hof_roots:
+            sub = self._reach(funcs, by_simple, hof_roots)
+            for f in hof_roots:
+                sub[f] = (f"compiled-region callback {f.qualname}, passed "
+                          f"to lax control flow at line "
+                          f"{hof_bodies[f.simple]}")
+            for f, why in sub.items():
+                reachable.setdefault(f, why)
         findings: List[Finding] = []
         for info, why in reachable.items():
             findings.extend(self._scan(module, info, why))

@@ -140,4 +140,21 @@ def load_arguments(
                           ("LOCAL_RANK", "local_rank")):
         if env_key in os.environ:
             setattr(args, attr, int(os.environ[env_key]))
+
+    # engine-selection knobs are validated at config load so a YAML typo
+    # fails naming the key, not as a TypeError deep in SimConfig
+    rpd = getattr(args, "rounds_per_dispatch", None)
+    if rpd is not None:
+        try:
+            args.rounds_per_dispatch = int(rpd)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"rounds_per_dispatch must be a positive integer, got {rpd!r}"
+            ) from None
+        if args.rounds_per_dispatch < 1:
+            raise ValueError(
+                "rounds_per_dispatch must be >= 1 "
+                f"(got {args.rounds_per_dispatch}); 1 is the classic "
+                "per-round engine, >1 fuses rounds into one lax.scan "
+                "dispatch")
     return args

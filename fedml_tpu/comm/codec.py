@@ -602,6 +602,16 @@ def build_stacked_roundtrip(spec, seed: int, update_shardings=None,
     cross-silo uplink. Residual leaves are f32 mirrors of the update leaves;
     leaves too small to compress pass through with residuals untouched.
 
+    Both traced arguments are load-bearing for the fused engine
+    (``rounds_per_dispatch > 1``): the roundtrip is traced once into a
+    ``lax.scan`` body where ``round_u32`` and ``cids_u32`` arrive as scan
+    inputs and the residual tree threads through the scan carry. Because
+    the quantization RNG derives only from ``(seed, round_u32, cids_u32,
+    leaf path)`` — never from trace-time Python state — the EF residual
+    carried across a scan iteration is bit-identical to one carried across
+    a separate per-round dispatch, which is what lets a block boundary
+    land between any two rounds without perturbing the codec stream.
+
     ``update_shardings`` (optional, a pytree of shardings matching the
     update) re-pins the decoded update AND the new residuals to that layout
     inside a sharded jit: the top-k scatter/argsort are per-row ops, but on

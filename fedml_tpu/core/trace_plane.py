@@ -484,6 +484,23 @@ def _recompile_delta(key: Tuple[str, int]) -> float:
     return 0.0 if prev is None else max(0.0, total - prev)
 
 
+def absorb_planned_compiles(rank: int = 0) -> None:
+    """Fold a PLANNED compilation into the recompile detector's baseline.
+
+    The multi-round scan engine compiles one program per block length, so
+    the first dispatch of a new length (a plan's short tail block, a
+    resume that re-anchors mid-block) legitimately triggers XLA after
+    warmup. The engine calls this right after such a dispatch, so
+    ``fedml_recompiles_post_warmup_total`` keeps meaning "unexpected
+    shape/donation instability" whether rounds are fused or not."""
+    if not _plane.active or not telemetry.enabled():
+        return
+    total = telemetry.get_registry().counter_total(
+        "fedml_jax_compilation_events_total")
+    _plane.compile_baseline[
+        (telemetry.current_tenant() or "", int(rank))] = total
+
+
 def on_round_record(rec: Dict[str, Any], rank: int = 0) -> None:
     """Fold one finished round into the trace plane: emit a phase record
     (the Chrome export's phase slices), run anomaly + recompile detection

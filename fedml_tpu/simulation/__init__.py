@@ -153,6 +153,7 @@ def build_simulator(args, fed_data=None, model=None, mesh=None) -> tuple:
         async_delay_base_s=float(getattr(args, "async_delay_base_s", 1.0)),
         async_delay_skew=float(getattr(args, "async_delay_skew", 0.0) or 0.0),
         async_delay_jitter=float(getattr(args, "async_delay_jitter", 0.2)),
+        rounds_per_dispatch=int(getattr(args, "rounds_per_dispatch", 1)),
     )
 
     attack_type = getattr(args, "attack_type", None)

@@ -226,6 +226,51 @@ class ClientStateArena:
         self._last_used[put_slots] = self._clock
         return jax.tree_util.tree_unflatten(self._treedef, out)
 
+    # ----------------------------------------- scanned-block residency API
+
+    def ensure_block(self, ids_rounds: np.ndarray) -> Optional[np.ndarray]:
+        """Make the UNION of a scanned block's cohorts resident at once and
+        return per-round slot matrices aligned to ``ids_rounds`` (shape
+        ``(rounds, cohort)``, duplicates allowed within and across rounds).
+
+        The compiled multi-round dispatch gathers/scatters arena rows
+        *inside* the scan, so every client any round of the block touches
+        must stay resident for the whole block — one residency transaction
+        here replaces ``rounds`` gather calls. Returns ``None`` (arena
+        untouched) when the union exceeds ``capacity``; the caller falls
+        back to per-round dispatch, where the LRU tier can spill between
+        rounds.
+        """
+        ids_rounds = np.asarray(ids_rounds, dtype=np.int64)
+        flat = ids_rounds.ravel()
+        uniq, first = np.unique(flat, return_index=True)
+        if len(uniq) > self.capacity:
+            return None
+        # first-seen order, matching what per-round _ensure calls would load
+        first_seen = uniq[np.argsort(first)]
+        slots_fs = self._ensure(first_seen)
+        order = np.argsort(first_seen, kind="stable")
+        # first_seen[order] == uniq (sorted) → searchsorted lut
+        pos = np.searchsorted(first_seen[order], flat)
+        return slots_fs[order][pos].reshape(ids_rounds.shape)
+
+    def take_leaves(self) -> List[Any]:
+        """The raw device leaves, for handing to a donated scan program.
+        The caller OWNS them afterwards (donation consumes the buffers) and
+        must follow up with :meth:`set_leaves`."""
+        leaves, self._leaves = self._leaves, None
+        return leaves
+
+    def set_leaves(self, new_leaves, slots_rounds: np.ndarray) -> None:
+        """Install the scan program's output leaves and replay the block's
+        per-round LRU touches (``slots_rounds``: the real — unpadded — slot
+        matrix, one row per scanned round) so eviction order is identical
+        to having run the rounds one by one."""
+        self._leaves = list(new_leaves)
+        for slots in np.asarray(slots_rounds, dtype=np.int64):
+            self._clock += 1
+            self._last_used[np.unique(slots)] = self._clock
+
     def state_of(self, client_id: int) -> PyTree:
         """One client's current state as host numpy (test/debug helper —
         this is the slow per-client path the arena exists to avoid)."""

@@ -53,7 +53,12 @@ from ..parallel.sharding import (
     tree_shardings,
 )
 from .client_store import ClientStateArena, cohort_local_update
-from .sampling import reference_client_sampling, sample_clients  # noqa: F401 (re-export)
+from .sampling import (  # noqa: F401 (re-export)
+    client_permutation_list,
+    client_permutations,
+    reference_client_sampling,
+    sample_clients,
+)
 
 PyTree = Any
 
@@ -240,6 +245,20 @@ class SimConfig:
     async_delay_base_s: float = 1.0
     async_delay_skew: float = 0.0
     async_delay_jitter: float = 0.2
+    # --- compiled multi-round dispatch ---------------------------------
+    # fuse this many consecutive rounds into ONE donated jit containing a
+    # lax.scan over the round index: the whole block's cohort tensors are
+    # staged in a single upload, per-client arena state and codec EF
+    # residuals are carried device-side between the scanned rounds, and
+    # pack_wait/dispatch are paid once per block instead of once per round.
+    # Blocks split automatically so eval/checkpoint hooks fire on exact
+    # round indices (those rounds run the per-round program). Histories are
+    # bit-exact vs rounds_per_dispatch=1; 1 (default) keeps the per-round
+    # path byte-identical to previous releases. Incompatible features
+    # (watchdog, custom aggregates, attack transforms, disk-spill arena,
+    # packed/bucketed schedules, async mode, host-resident data or dict
+    # state backends) raise ScanIncompatibleError at construction.
+    rounds_per_dispatch: int = 1
 
 
 @dataclasses.dataclass
@@ -254,6 +273,27 @@ class RoundInputs:
     kind: str  # "even" | "bucketed" | "packed"
     payload: Any
     pack_time: float  # host seconds spent building (wherever it ran)
+
+
+class ScanIncompatibleError(ValueError):
+    """``rounds_per_dispatch > 1`` combined with a feature the scanned block
+    cannot carry. Raised at construction (or at ``run`` for runtime-only
+    conflicts like the multi-tenant gate) — the engine refuses rather than
+    silently running a different path, mirroring the mesh refusals."""
+
+
+@dataclasses.dataclass
+class BlockInputs:
+    """One scanned block's host-built tensors: ``rounds_per_dispatch``
+    consecutive rounds' cohort index rectangles stacked along a leading
+    round axis. Pure in (seed, rounds) — built on the prefetch worker.
+    Arena slot vectors are NOT here: residency is mutable simulator state,
+    assigned on the main thread at dispatch."""
+
+    rounds: tuple  # consecutive round indices
+    ids: np.ndarray  # (L, client_num_per_round) sampled cohorts, pre-pad
+    xs: Dict[str, np.ndarray]  # stacked scan inputs (idx/num_samples/round…)
+    pack_time: float
 
 
 def _gather_from_device(data: Dict[str, Any], x_all, y_all) -> Dict[str, Any]:
@@ -503,6 +543,14 @@ class FedSimulator:
                 "stacked cohort (use 'even' or 'auto')")
         if force_even:
             schedule = "even"
+        if int(cfg.rounds_per_dispatch) > 1:
+            if schedule in ("packed", "bucketed"):
+                raise ScanIncompatibleError(
+                    f"cohort_schedule='{schedule}' cannot run inside a "
+                    "scanned block — its lane/bucket plans are rebuilt on "
+                    "the host every round; use 'even'/'auto' or "
+                    "rounds_per_dispatch=1")
+            schedule = "even"  # auto resolves to the rectangular program
         if schedule == "auto":
             counts = np.asarray(list(self._batch_counts.values()))
             skewed = counts.max() >= 2 * max(np.median(counts), 1)
@@ -585,6 +633,47 @@ class FedSimulator:
                 axis_name=cfg.cohort_shard_axis,
                 # EF residual rows are params-shaped: same model layout
                 row_specs=self._param_specs)
+        # --- compiled multi-round dispatch: eligibility ------------------
+        self._scan_rounds = int(cfg.rounds_per_dispatch)
+        if self._scan_rounds < 1:
+            raise ValueError(
+                f"rounds_per_dispatch={cfg.rounds_per_dispatch} "
+                "(expected >= 1)")
+        if self._scan_rounds > 1:
+            why = None
+            if cfg.async_mode:
+                why = ("the buffered-async engine commits on update "
+                       "arrival, not on a fixed round barrier to fuse")
+            elif cfg.watchdog_factor > 0:
+                why = ("the divergence watchdog needs each round's verdict "
+                       "on the host before the next round may dispatch")
+            elif update_transform is not None:
+                why = ("injected attack/update transforms are host-"
+                       "supplied closures the engine cannot audit for "
+                       "scan-safety")
+            elif (algorithm.aggregate is not None
+                  and getattr(algorithm, "robust", None) is None):
+                why = ("a custom aggregate is host-supplied code; only the "
+                       "built-in robust defenses are known scan-safe")
+            elif cfg.client_state_spill_dir:
+                why = ("the disk-spill arena tier moves rows through the "
+                       "host between rounds, but a scanned block carries "
+                       "them device-side")
+            elif (self._client_state_proto != ()
+                  and cfg.client_state_backend != "arena"):
+                why = ("client_state_backend='dict' keeps per-client state "
+                       "in host Python between rounds")
+            elif not self._use_device_data:
+                why = ("device-resident data is required — a block ships "
+                       "index rectangles, not R full cohort batches")
+            if why is not None:
+                raise ScanIncompatibleError(
+                    f"rounds_per_dispatch={self._scan_rounds}: {why} — "
+                    "run with rounds_per_dispatch=1")
+        # compiled scan steps keyed by block length (hook-boundary splits
+        # produce a handful of distinct lengths; each compiles once)
+        self._scan_steps: Dict[int, Callable] = {}
+        self._idx_registry = None  # lazy (rows, sizes, lut) for block packs
         self._round_step = self._build_round_step()
         if self._packed:
             self._packed_step = self._build_packed_step()
@@ -594,7 +683,12 @@ class FedSimulator:
 
     # --- compiled pieces ---------------------------------------------------
 
-    def _build_round_step(self) -> Callable:
+    def _make_round_body(self) -> Callable:
+        """The traced math of ONE round (local train -> codec roundtrip ->
+        attack -> sanitize/defense -> aggregate -> server update), shared
+        verbatim between the per-round jit (``_build_round_step``) and the
+        multi-round scan body (``_build_scan_step``) so the two paths cannot
+        drift numerically."""
         alg = self.alg
         transform = self._update_transform
         detect = self._detect
@@ -811,6 +905,19 @@ class FedSimulator:
                 ret += (codec_res,)
             return ret
 
+        return round_body
+
+    def _build_round_step(self) -> Callable:
+        round_body = self._make_round_body()
+        mesh = self.mesh
+        codec_rt = self._codec_rt
+        codec_ef = self._codec_arena is not None
+        detect = self._detect
+        mdl = self._model_axis is not None
+        update_sh = self._update_sh
+        cohort_sh = (shard_along(mesh, self.cfg.cohort_shard_axis, 0)
+                     if mesh is not None else None)
+
         if self._use_device_data:
             # device-resident path: the cohort carries only an index
             # rectangle (host->device per round = a few KB of indices)
@@ -864,6 +971,136 @@ class FedSimulator:
                 donate_argnums=(0, 1),
             )
         return jax.jit(round_step, donate_argnums=(0, 1))
+
+    def _build_scan_step(self, block_len: int) -> Callable:
+        """ONE donated jit running ``block_len`` consecutive rounds as a
+        ``lax.scan`` over the round index.
+
+        The scan body is the SAME ``round_body`` the per-round jit traces —
+        plus, moved device-side, everything the host round loop used to do
+        between dispatches: the cohort mask is rebuilt from ``num_samples``,
+        per-round RNG keys fold inside the program, and per-client arena
+        state / codec EF residuals are carried as full arena leaves with an
+        in-scan gather (``leaves[slots]``) and scatter
+        (``leaves.at[slots].set``) per round — bit-identical to the
+        ``ClientStateArena`` take/put jits, so a block boundary can land
+        anywhere without changing a single carried bit. Params, server
+        state, and both arenas' leaves are donated: the block updates the
+        model and arenas in place, and the only per-block host traffic is
+        the stacked index rectangles in and an (L, 2) metrics vector (+ the
+        (L, 2, C) sanitize readback) out.
+        """
+        round_body = self._make_round_body()
+        cfg = self.cfg
+        mesh = self.mesh
+        pad = self._cohort_pad
+        c_real = int(cfg.client_num_per_round)
+        cohort_n = c_real + pad
+        nb, bs = self.num_local_batches, cfg.batch_size
+        cap = nb * bs
+        detect = self._detect
+        codec_rt = self._codec_rt
+        codec_ef = self._codec_arena is not None
+        stateful = self._arena is not None
+        prepare = self.alg.prepare_client_state
+        state_treedef = self._arena._treedef if stateful else None
+        res_treedef = self._codec_arena._treedef if codec_ef else None
+        pos_np = np.arange(cohort_n, dtype=np.uint32)
+        x_all, y_all = self._x_dev, self._y_dev
+
+        def body(carry, x):
+            params, server_state, arena_leaves, codec_leaves, base_rng = carry
+            ns = x["num_samples"]
+            # bit-identical to the host packer's mask: row-major position <
+            # num_samples (dropped clients ship num_samples=0, pad rows too)
+            mask = (jnp.arange(cap, dtype=jnp.int32)[None, :]
+                    < ns[:, None])
+            cohort = {
+                "idx": x["idx"],
+                "mask": mask.astype(jnp.float32).reshape(cohort_n, nb, bs),
+                "num_samples": ns,
+                "pos": jnp.asarray(pos_np),
+            }
+            data = _gather_from_device(cohort, x_all, y_all)
+            # same fold as the host loop's per-round step_rng
+            rng = jax.random.fold_in(base_rng, x["round"])
+            if stateful:
+                slots = x["slots"]
+                states = jax.tree_util.tree_unflatten(
+                    state_treedef, [l[slots] for l in arena_leaves])
+                if prepare is not None:
+                    states = jax.vmap(prepare, in_axes=(None, 0))(
+                        server_state, states)
+            else:
+                states = ()
+            codec_res, cids_u32, round_u32 = (), None, None
+            if codec_rt is not None:
+                cids_u32, round_u32 = x["cids_u32"], x["round"]
+                if codec_ef:
+                    cslots = x["codec_slots"]
+                    codec_res = jax.tree_util.tree_unflatten(
+                        res_treedef, [l[cslots] for l in codec_leaves])
+            out = round_body(params, server_state, data, states, rng,
+                             codec_res, cids_u32, round_u32)
+            if codec_ef:
+                *out, new_res = out
+            if detect:
+                *out, qz = out
+            params, server_state, new_states = out[0], out[1], out[2]
+            metrics_vec = out[3]
+            if stateful:
+                # only real rows scatter back (pad rows duplicate the last
+                # client's slot — writing them would race its real row)
+                wslots = slots[:c_real]
+                arena_leaves = [
+                    l.at[wslots].set(r[:c_real]) for l, r in zip(
+                        arena_leaves,
+                        jax.tree_util.tree_leaves(new_states))]
+            if codec_ef:
+                wc = cslots[:c_real]
+                codec_leaves = [
+                    l.at[wc].set(r[:c_real]) for l, r in zip(
+                        codec_leaves, jax.tree_util.tree_leaves(new_res))]
+            ys = (metrics_vec,) + ((qz,) if detect else ())
+            return ((params, server_state, arena_leaves, codec_leaves,
+                     base_rng), ys)
+
+        def scan_step(params, server_state, arena_leaves, codec_leaves,
+                      base_rng, xs):
+            carry = (params, server_state, arena_leaves, codec_leaves,
+                     base_rng)
+            carry, ys = jax.lax.scan(body, carry, xs, length=block_len)
+            params, server_state, arena_leaves, codec_leaves, _ = carry
+            return params, server_state, arena_leaves, codec_leaves, ys
+
+        if mesh is not None:
+            rep = replicated(mesh)
+            mdl = self._model_axis is not None
+            p_sh = self._param_sh if mdl else rep
+            s_sh = (self._server_sh if (mdl and self._server_sh is not None)
+                    else rep)
+            arena_sh = list(self._arena._row_sh or []) if stateful else []
+            if stateful and not arena_sh:
+                arena_sh = [rep] * len(self._arena._leaves)
+            codec_sh = (list(self._codec_arena._row_sh or [])
+                        if codec_ef else [])
+            if codec_ef and not codec_sh:
+                codec_sh = [rep] * len(self._codec_arena._leaves)
+            blk = shard_along(mesh, cfg.cohort_shard_axis, 1)
+            xs_sh = {"idx": blk, "num_samples": blk, "round": rep}
+            if stateful:
+                xs_sh["slots"] = blk
+            if codec_rt is not None:
+                xs_sh["cids_u32"] = blk
+                if codec_ef:
+                    xs_sh["codec_slots"] = blk
+            in_sh = (p_sh, s_sh, arena_sh, codec_sh, rep, xs_sh)
+            out_sh = (p_sh, s_sh, arena_sh, codec_sh,
+                      (rep,) + ((rep,) if detect else ()))
+            return jax.jit(scan_step, in_shardings=in_sh,
+                           out_shardings=out_sh,
+                           donate_argnums=(0, 1, 2, 3))
+        return jax.jit(scan_step, donate_argnums=(0, 1, 2, 3))
 
     def _build_packed_step(self) -> Callable:
         """ONE compiled program per round: lanes of back-to-back clients.
@@ -1177,6 +1414,20 @@ class FedSimulator:
             self._run_selfheal(rounds, base_rng, apply_fn, ckpt, log_fn)
             # end-of-run drain: wall-clock must cover in-flight device work
             # — graftcheck: disable=host-sync
+            jax.block_until_ready(self.params)
+            if ckpt is not None:
+                ckpt.close()
+            telemetry.flush()
+            return self.history
+        if self._scan_rounds > 1:
+            if self._round_gate is not None:
+                raise ScanIncompatibleError(
+                    "rounds_per_dispatch > 1 under the multi-tenant round "
+                    "gate — fair mesh sharing needs per-round dispatches; "
+                    "run with rounds_per_dispatch=1")
+            self._run_scan(rounds, base_rng, apply_fn, ckpt, log_fn)
+            # end-of-run drain, same contract as the per-round loop —
+            # graftcheck: disable=host-sync
             jax.block_until_ready(self.params)
             if ckpt is not None:
                 ckpt.close()
@@ -1558,13 +1809,15 @@ class FedSimulator:
 
     def _client_perms(self, client_ids, round_idx: int):
         """Per-client local-epoch shuffles, seeded by (run seed, round,
-        client id) — identical whichever order/schedule packs the cohort."""
-        return [
-            np.random.default_rng(
-                [self.cfg.seed, round_idx, int(c)]
-            ).permutation(len(self.fed.train_data_local_dict[int(c)]))
-            for c in client_ids
-        ]
+        client id) — identical whichever order/schedule packs the cohort.
+        Drawn by ``sampling.client_permutations``, the vectorized bit-exact
+        reimplementation of ``default_rng([seed, round, cid]).permutation``
+        (constructing 10k Generators per round cost ~200 ms of host time;
+        the vectorized streams cost ~10 ms and self-verify per call)."""
+        sizes = [len(self.fed.train_data_local_dict[int(c)])
+                 for c in client_ids]
+        return client_permutation_list(
+            self.cfg.seed, round_idx, np.asarray(client_ids), sizes)
 
     # --- pure round-input builders (prefetchable host side) -----------------
 
@@ -1652,6 +1905,332 @@ class FedSimulator:
         payload["num_samples"] = samples_np
         payload["pos"] = np.arange(len(client_ids) + pad, dtype=np.uint32)
         return payload
+
+    # --- compiled multi-round dispatch (rounds_per_dispatch > 1) -----------
+
+    def _ensure_idx_registry(self):
+        """Dense (rows, sizes, id->row lut) view of the per-client global
+        index lists — built once, so a block packer can gather every round's
+        index rectangle with bulk numpy ops instead of a 10k-iteration
+        per-client list walk."""
+        if self._idx_registry is None:
+            gi = self.fed._global_index
+            keys = np.fromiter(gi.keys(), dtype=np.int64, count=len(gi))
+            sizes = np.fromiter((len(gi[int(k)]) for k in keys),
+                                dtype=np.int64, count=len(keys))
+            max_len = int(sizes.max()) if len(keys) else 0
+            reg = np.zeros((len(keys), max(max_len, 1)), dtype=np.int64)
+            for row, k in enumerate(keys):
+                ix = gi[int(k)]
+                reg[row, : len(ix)] = ix
+            lut = np.full(int(keys.max()) + 1 if len(keys) else 1, -1,
+                          dtype=np.int64)
+            lut[keys] = np.arange(len(keys))
+            self._idx_registry = (reg, sizes, lut)
+        return self._idx_registry
+
+    def build_block_inputs(self, rounds) -> BlockInputs:
+        """The host side of one scanned block, pure in ``(seed, rounds)``:
+        every round's cohort sample, dropout mask, per-client shuffles, and
+        index rectangle, stacked along a leading round axis. Produces
+        tensors bit-identical to ``build_round_inputs`` round by round
+        (``tests/test_round_scan.py`` pins that equivalence), built with
+        the vectorized permutation streams and one registry gather per
+        round instead of per-client Python loops."""
+        cfg = self.cfg
+        t0 = time.perf_counter()
+        with self._span("host_pack", f"{rounds[0]}+{len(rounds)}"):
+            reg, sizes_all, lut = self._ensure_idx_registry()
+            rounds = tuple(int(r) for r in rounds)
+            L = len(rounds)
+            pad = self._cohort_pad
+            c_real = int(cfg.client_num_per_round)
+            cohort_n = c_real + pad
+            nb, bs = self.num_local_batches, cfg.batch_size
+            cap = nb * bs
+            idx = np.zeros((L, cohort_n, nb, bs), np.int32)
+            ns_out = np.zeros((L, cohort_n), np.int32)
+            ids = np.empty((L, c_real), np.int64)
+            arange_cap = np.arange(cap, dtype=np.int64)
+            for k, r in enumerate(rounds):
+                cids = np.asarray(sample_clients(
+                    cfg.seed, r, cfg.client_num_in_total, c_real))
+                ids[k] = cids
+                rows = lut[cids]
+                csz = sizes_all[rows]
+                n_c = np.minimum(csz, cap)
+                # same streams as the per-round packer: one permutation per
+                # client from default_rng([seed, round, cid]), trimmed to
+                # the batch-rectangle capacity
+                perm = client_permutations(cfg.seed, r, cids, csz, cap=cap)
+                r_idx = np.zeros((c_real, cap), np.int64)
+                w = perm.shape[1]
+                if w:
+                    r_idx[:, :w] = np.take_along_axis(
+                        reg[rows][:, : max(w, 1)], perm, axis=1)
+                r_idx[arange_cap[None, :] >= n_c[:, None]] = 0
+                n_eff = n_c.astype(np.int32)
+                if cfg.client_dropout_rate > 0.0:
+                    pack_rng = np.random.default_rng([cfg.seed, r])
+                    drop = (pack_rng.random(c_real)
+                            < cfg.client_dropout_rate)
+                    if drop.all():
+                        drop[0] = False  # at least one survivor
+                    n_eff = n_eff * (~drop)
+                idx[k, :c_real] = r_idx.reshape(
+                    c_real, nb, bs).astype(np.int32)
+                ns_out[k, :c_real] = n_eff
+            xs = {"idx": idx, "num_samples": ns_out,
+                  "round": np.asarray(rounds, np.uint32)}
+            if self._codec_rt is not None:
+                gids = ids if not pad else np.concatenate(
+                    [ids, np.repeat(ids[:, -1:], pad, axis=1)], axis=1)
+                xs["cids_u32"] = gids.astype(np.uint32)
+        return BlockInputs(rounds, ids, xs, time.perf_counter() - t0)
+
+    def _build_block(self, block: tuple):
+        """Prefetchable builder for one block plan entry: length-1 blocks
+        (hook boundaries) reuse the per-round builder + program."""
+        if len(block) == 1:
+            return self.build_round_inputs(block[0])
+        return self.build_block_inputs(block)
+
+    def _plan_blocks(self, rounds, do_eval: bool, do_ckpt: bool):
+        """Partition the round range into runs of at most
+        ``rounds_per_dispatch`` consecutive rounds, cutting after every
+        round that fires a host hook (eval/checkpoint) — hooks run on exact
+        round indices with that round's own params, never mid-scan."""
+        blocks, cur = [], []
+        for r in rounds:
+            cur.append(r)
+            if ((do_eval and self._should_eval(r))
+                    or (do_ckpt and self._should_checkpoint(r))
+                    or len(cur) >= self._scan_rounds):
+                blocks.append(tuple(cur))
+                cur = []
+        if cur:
+            blocks.append(tuple(cur))
+        return blocks
+
+    def _run_scan(self, rounds, base_rng, apply_fn, ckpt, log_fn) -> None:
+        """Round loop for ``rounds_per_dispatch > 1``: iterate the block
+        plan, dispatching each multi-round block as one scanned program and
+        each length-1 block (hook boundary, remainder) on the unchanged
+        per-round program. Resume lands on any round index — the plan is
+        re-derived from the resumed start round, and every carried bit
+        (arena rows, EF residuals) is identical whichever side of a block
+        boundary a round falls on."""
+        cfg = self.cfg
+        blocks = self._plan_blocks(
+            rounds, apply_fn is not None, ckpt is not None)
+        if cfg.prefetch and blocks:
+            from .prefetch import RoundPrefetcher
+
+            self._prefetcher = RoundPrefetcher(
+                self._build_block, blocks, depth=cfg.prefetch_depth,
+                name="block-prefetch")
+        self._last_round_end = time.perf_counter()
+        try:
+            for block in blocks:
+                t0 = time.perf_counter()
+                if self._prefetcher is not None:
+                    inputs = self._prefetcher.get(block)
+                else:
+                    inputs = self._build_block(block)
+                pack_wait = time.perf_counter() - t0
+                self._phase_acc.append(("pack_wait", pack_wait))
+                if len(block) == 1:
+                    self._run_one_round(inputs, t0, pack_wait, base_rng,
+                                        apply_fn, ckpt, log_fn)
+                else:
+                    self._dispatch_scan_block(inputs, t0, base_rng,
+                                              apply_fn, ckpt, log_fn)
+        finally:
+            self._pregathered_state = self._pregathered_codec = None
+            if self._prefetcher is not None:
+                self._prefetcher.close()
+                self._prefetcher = None
+
+    def _run_one_round(self, inputs: RoundInputs, t0, pack_wait, base_rng,
+                       apply_fn, ckpt, log_fn) -> None:
+        """One round on the per-round program inside the scan loop —
+        hook boundaries and capacity fallbacks. Finalized synchronously
+        (these rounds evaluate/checkpoint, which are sync points anyway)."""
+        r = inputs.round_idx
+        step_rng = jax.random.fold_in(base_rng, r)
+        t_disp = time.perf_counter()
+        n_acc = len(self._phase_acc)
+        with self._span("round_dispatch", str(r)):
+            metrics_vec = self._dispatch_even(inputs, step_rng)
+        t_inner = sum(dt for _, dt in self._phase_acc[n_acc:])
+        self._phase_acc.append(
+            ("dispatch", time.perf_counter() - t_disp - t_inner))
+        rec = {
+            "round": r,
+            "dispatch_time": time.perf_counter() - t0,
+            "_mvec": metrics_vec,
+            "pack_time": inputs.pack_time,
+            "pack_wait": pack_wait,
+            "overlap": (max(0.0, 1.0 - pack_wait / inputs.pack_time)
+                        if inputs.pack_time > 0 else 0.0),
+        }
+        if self._last_qz is not None:
+            rec["_qz"] = self._last_qz
+            rec["_cohort_ids"] = self._last_cohort_ids
+            self._last_qz = self._last_cohort_ids = None
+        self._finalize_rec(rec, apply_fn, ckpt, log_fn)
+
+    def _dispatch_scan_block(self, inputs: BlockInputs, t0, base_rng,
+                             apply_fn, ckpt, log_fn) -> None:
+        """Dispatch one multi-round block: block-wide arena residency, one
+        stacked upload, one donated scan call, one metric readback — then
+        per-round records with amortized phases that still sum exactly to
+        each round's ``round_time``."""
+        cfg = self.cfg
+        block = inputs.rounds
+        L = len(block)
+        pad = self._cohort_pad
+        c_real = int(cfg.client_num_per_round)
+        ids = inputs.ids
+        gids = ids if not pad else np.concatenate(
+            [ids, np.repeat(ids[:, -1:], pad, axis=1)], axis=1)
+        xs = dict(inputs.xs)
+        slots = cslots = None
+        if self._arena is not None or self._codec_arena is not None:
+            t = time.perf_counter()
+            if self._arena is not None:
+                slots = self._arena.ensure_block(gids)
+            if self._codec_arena is not None:
+                cslots = self._codec_arena.ensure_block(gids)
+            self._phase_acc.append(
+                ("state_gather", time.perf_counter() - t))
+            if ((self._arena is not None and slots is None)
+                    or (self._codec_arena is not None and cslots is None)):
+                # the block's cohort union exceeds the arena capacity: the
+                # LRU tier must spill between rounds, so run this block's
+                # rounds on the per-round program (bit-identical history)
+                if log_fn:
+                    log_fn(f"[scan] block @{block[0]}+{L}: cohort union "
+                           "exceeds client_state_capacity — running "
+                           "per-round")
+                for r in block:
+                    t_r = time.perf_counter()
+                    inp = self.build_round_inputs(r)
+                    pw = time.perf_counter() - t_r
+                    self._phase_acc.append(("pack_wait", pw))
+                    self._run_one_round(inp, t_r, pw, base_rng, apply_fn,
+                                        ckpt, log_fn)
+                return
+        if slots is not None:
+            xs["slots"] = slots.astype(np.int32)
+        if cslots is not None:
+            xs["codec_slots"] = cslots.astype(np.int32)
+        step = self._scan_steps.get(L)
+        fresh_program = step is None
+        if fresh_program:
+            step = self._build_scan_step(L)
+            self._scan_steps[L] = step
+        # one staged upload per block (a few KB/round of indices)
+        t = time.perf_counter()
+        if self.mesh is not None:
+            blk_sh = shard_along(self.mesh, cfg.cohort_shard_axis, 1)
+            rep = replicated(self.mesh)
+            xs_dev = {k: jax.device_put(v, rep if v.ndim == 1 else blk_sh)
+                      for k, v in xs.items()}
+        else:
+            xs_dev = {k: jnp.asarray(v) for k, v in xs.items()}
+        self._phase_acc.append(("scan_pack", time.perf_counter() - t))
+        arena_leaves = (self._arena.take_leaves()
+                        if self._arena is not None else [])
+        codec_leaves = (self._codec_arena.take_leaves()
+                        if self._codec_arena is not None else [])
+        t_disp = time.perf_counter()
+        with self._span("round_dispatch", f"{block[0]}+{L}"):
+            (self.params, self.server_state, new_arena, new_codec, ys) = step(
+                self.params, self.server_state, arena_leaves,
+                codec_leaves, base_rng, xs_dev)
+            if self._arena is not None:
+                self._arena.set_leaves(new_arena, slots[:, :c_real])
+            if self._codec_arena is not None:
+                self._codec_arena.set_leaves(new_codec, cslots[:, :c_real])
+        self._phase_acc.append(("dispatch", time.perf_counter() - t_disp))
+        if fresh_program:
+            # the first block of a given length compiles its own program —
+            # a planned event, not the recompile detector's business
+            trace_plane.absorb_planned_compiles()
+        dispatch_time = (time.perf_counter() - t0) / L
+        if self._codec_rt is not None:
+            raw, coded = self._codec_wire
+            self._codec_record(
+                "encode", raw * c_real * L, coded * c_real * L, 0.0)
+        mvec_dev = ys[0]
+        qz_dev = ys[1] if self._detect else None
+        # ONE blocking readback per block; the wait IS the device phase
+        # (deliberate sync point, same contract as _finalize_rec) —
+        # graftcheck: disable=host-sync
+        t_dev = time.perf_counter()
+        mvec = np.asarray(mvec_dev)  # graftcheck: disable=host-sync
+        qz = (np.asarray(qz_dev)  # graftcheck: disable=host-sync
+              if qz_dev is not None else None)
+        self._phase_acc.append(("device", time.perf_counter() - t_dev))
+        now = time.perf_counter()
+        span = now - self._last_round_end
+        self._last_round_end = now
+        # amortized attribution: each interval the host spent on this block
+        # splits evenly over its rounds; the remainder is host_other, so
+        # every round's phases sum exactly to its round_time (= span / L)
+        acc: Dict[str, float] = {}
+        for name, dt in self._phase_acc:
+            acc[name] = acc.get(name, 0.0) + dt
+        self._phase_acc.clear()
+        per_round = {k: v / L for k, v in acc.items()}
+        rt = span / L
+        per_round["host_other"] = max(0.0, rt - sum(per_round.values()))
+        reg = telemetry.get_registry()
+        if reg.enabled:
+            reg.counter("fedml_scan_blocks_total").inc()
+        trace_plane.record_instant(
+            "scan_block", round_idx=block[0],
+            attrs={"rounds": L, "span_s": span})
+        pack_time = inputs.pack_time / L
+        pw = per_round.get("pack_wait", 0.0)
+        for k, r in enumerate(block):
+            rec = {
+                "round": r,
+                "dispatch_time": dispatch_time,
+                "pack_time": pack_time,
+                "pack_wait": pw,
+                "overlap": (max(0.0, 1.0 - pw / pack_time)
+                            if pack_time > 0 else 0.0),
+                "scan_rounds": L,
+                "train_loss": float(mvec[k, 0]),
+                "train_acc": float(mvec[k, 1]),
+                "round_time": rt,
+                "phases": dict(per_round),
+            }
+            if qz is not None:
+                qzk = qz[k][:, :c_real] if pad else qz[k]
+                quarantined = sorted(
+                    {int(ids[k][i]) for i in np.nonzero(qzk[0] > 0)[0]})
+                rec["quarantined"] = quarantined
+                if quarantined:
+                    if reg.enabled:
+                        reg.counter("fedml_quarantined_total").inc(
+                            len(quarantined))
+                    trace_plane.record_instant(
+                        "quarantine", round_idx=r,
+                        attrs={"clients": quarantined})
+            if reg.enabled:
+                reg.counter("fedml_rounds_total").inc()
+                reg.histogram("fedml_round_seconds").observe(rt)
+                for name, dt in rec["phases"].items():
+                    reg.histogram(
+                        "fedml_round_phase_seconds", phase=name).observe(dt)
+                if pack_time:
+                    reg.histogram(
+                        "fedml_host_pack_seconds").observe(pack_time)
+            trace_plane.on_round_record(rec)
+            self._post_round(rec, r, apply_fn, ckpt, log_fn)
 
     def _dispatch_even(self, inputs: RoundInputs, step_rng):
         if self.mesh is not None:

@@ -11,13 +11,20 @@ any number of rounds ahead without changing a single bit of the result.
 bounded handoff queue, shrinking the round loop's host critical path to a
 queue pop.
 
+The work keys are opaque: the classic engine feeds round indices through
+``build_round_inputs``, while the fused engine (``rounds_per_dispatch > 1``)
+feeds *block-plan tuples* of consecutive round indices through
+``FedSimulator._build_block`` — one queue item per scanned block, so the
+worker stays exactly one dispatch ahead regardless of how many rounds one
+dispatch covers. Nothing here inspects the key beyond equality.
+
 Contracts:
 
-- **Ordering**: rounds are built and delivered strictly in sequence;
-  ``get(round_idx)`` checks the popped round matches.
-- **Exception propagation**: a builder exception is enqueued in round order
-  and re-raised from ``get`` on the round that failed (not swallowed on the
-  worker, not raised early for rounds that already built cleanly).
+- **Ordering**: keys are built and delivered strictly in the sequence
+  given; ``get(key)`` checks the popped key matches.
+- **Exception propagation**: a builder exception is enqueued in key order
+  and re-raised from ``get`` on the key that failed (not swallowed on the
+  worker, not raised early for keys that already built cleanly).
 - **Clean shutdown**: ``close`` is idempotent, unblocks a worker stuck on a
   full queue, and joins the thread; the thread is a daemon as a backstop.
 - **Sync points**: ``paused()`` guarantees the worker is quiescent (not
@@ -46,13 +53,14 @@ from typing import Any, Callable, Iterable
 
 
 class RoundPrefetcher:
-    """Runs ``build_fn(round_idx)`` for each round on a background thread,
-    ``depth`` rounds ahead of the consumer."""
+    """Runs ``build_fn(key)`` for each work key (a round index, or a block
+    tuple under the fused engine) on a background thread, ``depth`` keys
+    ahead of the consumer."""
 
     def __init__(
         self,
-        build_fn: Callable[[int], Any],
-        rounds: Iterable[int],
+        build_fn: Callable[[Any], Any],
+        rounds: Iterable[Any],
         depth: int = 2,
         name: str = "round-prefetch",
     ):
@@ -100,9 +108,9 @@ class RoundPrefetcher:
 
     # --- consumer side ------------------------------------------------------
 
-    def get(self, round_idx: int):
-        """Pop the next round's inputs (blocking); re-raises a worker
-        exception on the round it occurred."""
+    def get(self, key):
+        """Pop the next key's inputs (blocking); re-raises a worker
+        exception on the key it occurred."""
         if self._closed:
             raise RuntimeError("RoundPrefetcher is closed")
         while True:
@@ -112,22 +120,22 @@ class RoundPrefetcher:
             except queue.Empty:
                 if not self._thread.is_alive():
                     raise RuntimeError(
-                        "prefetch worker exited without producing round "
-                        f"{round_idx}") from None
+                        "prefetch worker exited without producing "
+                        f"{key!r}") from None
         if exc is not None:
             self.close()
             raise exc
-        if r != round_idx:
+        if r != key:
             self.close()
             raise RuntimeError(
-                f"prefetch out of order: expected round {round_idx}, got {r}")
+                f"prefetch out of order: expected {key!r}, got {r!r}")
         return item
 
-    def peek(self, round_idx: int):
-        """Non-blocking look at the next round's inputs without consuming
-        them: the item for ``round_idx`` if the worker has already built it,
+    def peek(self, key):
+        """Non-blocking look at the next key's inputs without consuming
+        them: the item for ``key`` if the worker has already built it,
         else ``None``. Never raises — a queued worker exception is left in
-        place for ``get`` to surface on the proper round.
+        place for ``get`` to surface on the proper key.
 
         The round loop uses this to start moving round r+1's arena state
         while round r's device step is still in flight (double-buffered
@@ -141,7 +149,7 @@ class RoundPrefetcher:
             if not self._q.queue:
                 return None
             r, item, exc = self._q.queue[0]
-        if exc is not None or r != round_idx:
+        if exc is not None or r != key:
             return None
         return item
 

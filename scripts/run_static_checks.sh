@@ -21,10 +21,13 @@
 #      must be bit-identical to the single-process reference with an
 #      exact commit ledger; the cheapest end-to-end probe of the tier
 #      wire protocol.
+#   5. scan_smoke — the same loopback federation on the classic
+#      per-round engine vs rounds_per_dispatch=4; final parameters and
+#      history must be bitwise identical (the fused-lax.scan invariant).
 #
-# Checks 1-3 are pure-AST / host-compile; check 4 runs JAX on CPU
-# (debug-small dataset, a few seconds). No network or model downloads
-# are involved.
+# Checks 1-3 are pure-AST / host-compile; checks 4-5 run JAX on CPU
+# (debug-small dataset, a few seconds each). No network or model
+# downloads are involved.
 set -u
 
 REPO_ROOT="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
@@ -50,6 +53,9 @@ fi
 
 echo "== tiered federation loopback smoke =="
 JAX_PLATFORMS=cpu "$PY" scripts/tier_smoke.py || rc=1
+
+echo "== multi-round scan bit-identity smoke =="
+JAX_PLATFORMS=cpu "$PY" scripts/scan_smoke.py || rc=1
 
 if [ "$rc" -ne 0 ]; then
     echo "static checks FAILED (see above)" >&2

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Loopback bit-identity smoke for compiled multi-round dispatch.
+
+Runs the same tiny SCAFFOLD + sanitizer federation twice — once on the
+classic per-round engine, once with ``rounds_per_dispatch=4`` — and
+demands bitwise-equal final parameters and an identical round history
+(timing fields aside). This is the cheap CI tripwire for the invariant
+the full parity suite (tests/test_round_scan.py) checks exhaustively:
+fusing rounds into one ``lax.scan`` region must never change a single
+bit of the training trajectory.
+
+Exits 0 on bitwise identity, 1 on any mismatch.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+TIMING_KEYS = {"round_time", "dispatch_time", "pack_time", "pack_wait",
+               "overlap", "phases", "scan_rounds"}
+
+
+def _run(rounds_per_dispatch: int):
+    import numpy as np
+
+    import jax
+
+    import fedml_tpu
+    from fedml_tpu.simulation import build_simulator
+
+    args = fedml_tpu.init(config=dict(
+        dataset="cifar10", model="lr", partition_method="hetero",
+        partition_alpha=0.3, debug_small_data=True,
+        client_num_in_total=10, client_num_per_round=5, comm_round=6,
+        learning_rate=0.05, epochs=1, batch_size=16,
+        frequency_of_the_test=100, random_seed=0,
+        federated_optimizer="SCAFFOLD", sanitize_updates=True,
+        rounds_per_dispatch=rounds_per_dispatch,
+    ))
+    sim, apply_fn = build_simulator(args)
+    hist = sim.run(apply_fn, log_fn=None)
+    flat = np.concatenate([np.asarray(l, np.float64).ravel()
+                           for l in jax.tree.leaves(sim.params)])
+    stripped = [{k: v for k, v in r.items() if k not in TIMING_KEYS}
+                for r in hist]
+    return flat, stripped, hist
+
+
+def main() -> int:
+    import numpy as np
+
+    p1, h1, _ = _run(1)
+    p4, h4, raw4 = _run(4)
+    fused = sum(1 for r in raw4 if "scan_rounds" in r)
+    ok = True
+    if not fused:
+        print("scan_smoke: FAIL — no round ran on the fused path",
+              file=sys.stderr)
+        ok = False
+    if not np.array_equal(p1, p4):
+        bad = int(np.sum(p1 != p4))
+        print(f"scan_smoke: FAIL — {bad}/{p1.size} final parameter "
+              f"entries differ between R=1 and R=4", file=sys.stderr)
+        ok = False
+    if h1 != h4:
+        diff = [r["round"] for a, b in zip(h1, h4) if a != b
+                for r in (a,)] or ["length"]
+        print(f"scan_smoke: FAIL — history diverges at round(s) {diff}",
+              file=sys.stderr)
+        ok = False
+    if ok:
+        print(f"scan_smoke: OK — R=4 bit-identical to per-round over "
+              f"{len(h1)} rounds ({fused} fused)", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

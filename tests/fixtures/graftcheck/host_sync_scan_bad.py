@@ -1,0 +1,44 @@
+"""Fixture: host round-trips inside a scanned round body. The body is
+built by a cold ``_build_*`` factory (the walk never enters those), but
+it is passed to ``lax.scan`` — the HOF-callback rule must root it
+anyway, plus the fori/while callbacks (fed under the fed_sim.py
+relpath)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class FedSimulator:
+    def _build_scan_step(self, block_len):
+        def scan_round(carry, xs):
+            params, state = carry
+            out = self._round_math(params, xs)
+            np.asarray(out)                     # device->host inside scan
+            jax.block_until_ready(out)          # sync inside scan
+            return (params, state), out
+
+        def step(params, state, xs):
+            return jax.lax.scan(scan_round, (params, state), xs,
+                                length=block_len)
+
+        return jax.jit(step)
+
+    def _round_math(self, params, xs):
+        # reachable FROM the scanned body via a plain call edge
+        loss = jnp.mean(xs)
+        return loss.item()                      # scalar readback
+
+
+def _build_loops(n):
+    def body_fun(i, val):
+        return val + jax.device_get(val)        # bulk readback inside fori
+
+    def cond_fun(val):
+        return float(val.sum()) < 3.0           # scalar readback inside while
+
+    def while_body(val):
+        return val * 2
+
+    out = jax.lax.fori_loop(0, n, body_fun, jnp.zeros(()))
+    return jax.lax.while_loop(cond_fun, while_body, out)

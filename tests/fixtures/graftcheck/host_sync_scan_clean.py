@@ -1,0 +1,42 @@
+"""Fixture: a device-resident scanned round body — the HOF-callback rule
+roots it, finds nothing, and the surrounding cold ``_build_*`` factory's
+own host staging stays unflagged (fed under the fed_sim.py relpath)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class FedSimulator:
+    def _build_scan_step(self, block_len, host_idx):
+        # host staging in the cold factory itself is fine: the walk roots
+        # only the callback, not its definition site
+        xs_host = np.asarray(host_idx)
+
+        def scan_round(carry, xs):
+            params, state = carry
+            grads = self._round_math(params, xs)
+            return (params, jax.tree.map(jnp.add, state, grads)), grads
+
+        def step(params, state, xs):
+            return jax.lax.scan(scan_round, (params, state), xs,
+                                length=block_len)
+
+        return jax.jit(step), xs_host
+
+    def _round_math(self, params, xs):
+        return jnp.mean(xs)
+
+
+def _build_loops(n):
+    def body_fun(i, val):
+        return val + jnp.float32(i)
+
+    def cond_fun(val):
+        return val < 3.0
+
+    def while_body(val):
+        return val * 2
+
+    out = jax.lax.fori_loop(0, n, body_fun, jnp.zeros(()))
+    return jax.lax.while_loop(cond_fun, while_body, out)

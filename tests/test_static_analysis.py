@@ -289,6 +289,36 @@ def test_host_sync_pallas_fixture_out_of_scope_by_default():
     assert _run_on_fixture(HostSyncChecker, "agg_pallas_bad.py") == []
 
 
+def test_host_sync_roots_scanned_round_body():
+    # callbacks handed to lax.scan/fori_loop/while_loop are hot even when
+    # defined inside a cold _build_* factory (the compiled multi-round
+    # dispatch builds its round body exactly that way)
+    findings = _run_on_fixture(
+        HostSyncChecker, "host_sync_scan_bad.py", relpath=_FED_SIM)
+    keys = {f.key for f in findings}
+    assert "FedSimulator._build_scan_step.scan_round:np.asarray:out" in keys
+    assert "FedSimulator._build_scan_step.scan_round:block_until_ready" in keys
+    # call edges OUT of the scanned body are followed
+    assert "FedSimulator._round_math:item:loss" in keys
+    # fori/while callbacks root the same way
+    assert "_build_loops.body_fun:device_get" in keys
+    assert "_build_loops.cond_fun:float()" in keys
+
+
+def test_host_sync_scan_body_why_names_the_hof():
+    findings = _run_on_fixture(
+        HostSyncChecker, "host_sync_scan_bad.py", relpath=_FED_SIM)
+    body = [f for f in findings
+            if f.key.startswith("FedSimulator._build_scan_step.scan_round")]
+    assert body and all("compiled-region callback" in f.message for f in body)
+
+
+def test_host_sync_silent_on_clean_scan_fixture():
+    # a device-resident scanned body plus host staging in its cold factory
+    assert _run_on_fixture(
+        HostSyncChecker, "host_sync_scan_clean.py", relpath=_FED_SIM) == []
+
+
 # ----------------------------------------------------- collective-deadlock
 
 def test_collective_deadlock_fires_on_bad_fixture():

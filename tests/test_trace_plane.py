@@ -322,6 +322,29 @@ def test_recompile_detector_flags_post_warmup_compiles():
     assert counters.get("fedml_recompiles_post_warmup_total") == 2
 
 
+def test_absorb_planned_compiles_quiets_detector():
+    # the scan engine compiles a NEW program for each block length — e.g. a
+    # plan's short tail block lands after warmup by design; absorbing it
+    # must keep the recompile counter at zero while a genuinely unplanned
+    # compile right after still fires
+    trace_plane.configure(anomaly_detection=True, anomaly_warmup=2,
+                          anomaly_window=16)
+    reg = telemetry.get_registry()
+    for i in range(4):
+        rec = {"round": i, "round_time": 0.1, "phases": {"dispatch": 0.1}}
+        trace_plane.on_round_record(rec)
+    reg.counter("fedml_jax_compilation_events_total", event="jit").inc(3)
+    trace_plane.absorb_planned_compiles()
+    rec = {"round": 4, "round_time": 0.1, "phases": {"dispatch": 0.1}}
+    trace_plane.on_round_record(rec)
+    assert "recompile_events" not in rec
+    assert reg.counter_total("fedml_recompiles_post_warmup_total") == 0
+    reg.counter("fedml_jax_compilation_events_total", event="jit").inc()
+    rec = {"round": 5, "round_time": 0.1, "phases": {"dispatch": 0.1}}
+    trace_plane.on_round_record(rec)
+    assert rec["recompile_events"] == 1
+
+
 def test_simulator_run_annotates_anomalies_when_quiet():
     """A clean small run must complete with the detector armed and produce
     zero anomaly annotations (the detector must not cry wolf)."""
