@@ -1255,6 +1255,166 @@ def loadgen_bench(duration_s: float = 2.0, seed: int = 0) -> int:
     return 0 if (report.ok and rate_ok and shed_visible) else 1
 
 
+def serve_bench(rounds: int = 30, producers: int = 2,
+                target_rate: float = 40_000.0, seed: int = 0) -> int:
+    """``--serve``: the train/serve overlap gate. A real simulator trains
+    (mnist/lr, debug data, every round committing a version through the
+    canary-gated serving plane) while producer threads hammer the inference
+    server; the serving window opens at the FIRST published version and
+    stays open through every hot-swap until training ends and the queue
+    drains.
+
+    Gates: >= 10k requests/s served on CPU while training commits
+    underneath; zero admitted requests dropped; >= 5 hot-swaps observed;
+    and — the BENCH_r07 artifact fix — the per-round phase sums (stamped
+    with ``bench_sync_device_phase``, which blocks on the committed params
+    before the completion timestamp) must re-add to the round_time sum
+    within 2%, with the ``device`` and ``publish`` phases both attributed
+    instead of leaking into host_other."""
+    import threading
+
+    import jax
+    import numpy as np
+
+    import fedml_tpu
+    from fedml_tpu.core import telemetry
+    from fedml_tpu.cross_silo.chaos import TIER_DEFAULTS
+    from fedml_tpu.serving import (InferenceServer, ServeConfig,
+                                   held_out_batches)
+    from fedml_tpu.simulation import build_simulator
+
+    telemetry.configure(enabled=True)
+    base = {k: v for k, v in TIER_DEFAULTS.items()
+            if not k.startswith(("hier_", "group_", "lease_"))}
+    args = fedml_tpu.init(config=dict(
+        base, comm_round=rounds, random_seed=seed, frequency_of_the_test=1,
+        prefetch=False, bench_sync_device_phase=True, serve_enabled=True))
+    sim, apply_fn = build_simulator(args)
+    cfg = ServeConfig.from_args(args)
+
+    # fixed-shape jitted predict: every batch pads to batch_max so the
+    # serve path compiles ONCE and a drain chunk of any size reuses it
+    jpred = jax.jit(lambda p, x: apply_fn(p, x, train=False))
+    bm = int(cfg.batch_max)
+
+    def predict(params, x):
+        x = np.asarray(x)
+        n = int(x.shape[0])
+        if n == bm:
+            return np.asarray(jpred(params, x))
+        xp = np.zeros((bm,) + tuple(x.shape[1:]), x.dtype)
+        xp[:n] = x
+        return np.asarray(jpred(params, xp))[:n]
+
+    test = sim.fed.test_data_global
+    server = InferenceServer(
+        predict, cfg,
+        eval_batches=held_out_batches(test.x, test.y, cfg.canary))
+    first_pub = threading.Event()
+
+    def publish(version, params):
+        status = server.publish(version, params)
+        first_pub.set()
+        return status
+
+    sim.attach_publisher(publish)
+
+    x_pool = np.asarray(test.x)
+    stop = threading.Event()
+    per_rate = float(target_rate) / max(1, int(producers))
+
+    def produce(worker: int) -> None:
+        t0 = time.perf_counter()
+        i = 0
+        n_pool = len(x_pool)
+        while not stop.is_set():
+            server.submit(x_pool[(worker + i) % n_pool],
+                          request_id=(worker, i))
+            i += 1
+            if i % 64 == 0:
+                ahead = i / per_rate - (time.perf_counter() - t0)
+                if ahead > 0.001:
+                    time.sleep(min(ahead, 0.05))
+
+    trainer = threading.Thread(target=lambda: sim.run(apply_fn, log_fn=None),
+                               daemon=True, name="serve-bench-train")
+    server.start()
+    trainer.start()
+    first_pub.wait(timeout=120.0)
+    threads = [threading.Thread(target=produce, args=(w,), daemon=True,
+                                name=f"serve-bench-p{w}")
+               for w in range(max(1, int(producers)))]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    trainer.join()
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    server.stop(drain=True)
+    elapsed = time.perf_counter() - t0
+
+    st = server.stats()
+    served_rate = st["served"] / elapsed if elapsed > 0 else 0.0
+    dropped = st["admitted"] - st["served"]
+    swaps = st["store"]["swaps"]
+
+    # corrected phase attribution (satellite of the serving PR): with
+    # bench_sync_device_phase the completion stamp waits on the committed
+    # params, so device time stops leaking into host_other
+    hist = sim.history
+    phase_sums = {}
+    for rec in hist:
+        for k, v in (rec.get("phases") or {}).items():
+            phase_sums[k] = phase_sums.get(k, 0.0) + float(v)
+    round_time_sum = sum(float(r.get("round_time", 0.0)) for r in hist)
+    phase_total = sum(phase_sums.values())
+    phase_drift = (abs(phase_total - round_time_sum) / round_time_sum
+                   if round_time_sum > 0 else 1.0)
+
+    rate_ok = served_rate >= 10_000.0
+    drop_ok = dropped == 0 and st["served"] == st["admitted"]
+    swap_ok = swaps >= 5
+    phase_ok = (phase_drift <= 0.02 and phase_sums.get("device", 0.0) > 0
+                and phase_sums.get("publish", 0.0) > 0)
+    ok = rate_ok and drop_ok and swap_ok and phase_ok
+
+    line = {
+        "metric": "serve_requests_per_sec_under_training",
+        "unit": (f"inference requests/s served while {rounds} training "
+                 f"rounds commit versions through the canary gate "
+                 f"(mnist/lr debug data, {producers} producers, "
+                 f"batch_max {bm}, seed={seed}), CPU"),
+        "elapsed_s": round(elapsed, 4),
+        "served": st["served"],
+        "served_per_sec": round(served_rate, 1),
+        "admitted": st["admitted"],
+        "submitted": st["submitted"],
+        "shed": st["submitted"] - st["admitted"],
+        "dropped": dropped,
+        "canary_served": st["canary_served"],
+        "swaps": swaps,
+        "rollbacks": st["store"]["rollbacks"],
+        "versions_served": len(st["served_by_version"]),
+        "max_queue_depth": st["queue"]["max_depth"],
+        "queue_maxsize": st["queue"]["maxsize"],
+        "phase_sums_s": {k: round(v, 4)
+                         for k, v in sorted(phase_sums.items())},
+        "round_time_sum_s": round(round_time_sum, 4),
+        "phase_drift_fraction": round(phase_drift, 4),
+        "pass_10k_per_sec": bool(rate_ok),
+        "pass_zero_dropped": bool(drop_ok),
+        "pass_5_hot_swaps": bool(swap_ok),
+        "pass_phase_sums_within_2pct": bool(phase_ok),
+        "ok": bool(ok),
+    }
+    print(json.dumps(line), flush=True)
+    print(f"serve: {'OK' if ok else 'FAIL'} — {served_rate:,.0f} req/s, "
+          f"{swaps} swaps, dropped {dropped}, phase drift "
+          f"{phase_drift:.2%}", file=sys.stderr, flush=True)
+    return 0 if ok else 1
+
+
 if __name__ == "__main__":
     if "--host-pack" in sys.argv:
         # host-side measurement only — never wait on (or measure) the chip
@@ -1299,6 +1459,10 @@ if __name__ == "__main__":
         # check-in overload drill — host threads + codec only, no chip
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         sys.exit(loadgen_bench())
+    if "--serve" in sys.argv:
+        # train/serve overlap gate — CPU simulator + host serving threads
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        sys.exit(serve_bench())
     if "--round-scan" in sys.argv:
         # compiled multi-round dispatch frontier — CPU backend; exits
         # nonzero if any round's phase breakdown fails the exactness check

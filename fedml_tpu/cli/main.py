@@ -310,6 +310,13 @@ def run(config_file, backend, flight_record):
               help="Hierarchical drill variant: cut root<->leaf for one "
                    "round window, verify the cut heals and the same "
                    "exactly-once + accuracy gates hold.")
+@click.option("--rollout", is_flag=True,
+              help="Run the poisoned-rollout drill instead: corrupt one "
+                   "published model version (--byzantine sign_flip/nan/"
+                   "scale/gauss) and gate that the serving canary blocks "
+                   "the promotion, rolls back to last-good within "
+                   "--max-acc-delta of served accuracy, and pins the "
+                   "version against re-promotion.")
 @click.option("--skew", default=10.0, type=float,
               help="Straggler drill: slowest/fastest client speed ratio.")
 @click.option("--buffer-size", default=2, type=int,
@@ -322,7 +329,7 @@ def chaos_drill(seed, rounds, clients, drop_rate, duplicate_rate,
                 fail_send_rate, crash_rank, crash_at_round, byzantine_kind,
                 byzantine_rate, byzantine_scale, defend, codec, timeout,
                 tenant, flight_record, flight_dir, as_json, straggler,
-                tier_scenario, skew, buffer_size, min_goodput_ratio,
+                tier_scenario, rollout, skew, buffer_size, min_goodput_ratio,
                 max_acc_delta):
     """Stand up a full cross-silo deployment (server + clients, real codec,
     real round FSM) under the given fault plan and verify every round still
@@ -336,6 +343,20 @@ def chaos_drill(seed, rounds, clients, drop_rate, duplicate_rate,
         result = run_tier_drill(
             scenario=tier_scenario, max_acc_delta=max_acc_delta,
             random_seed=seed, comm_round=rounds)
+        click.echo(json.dumps(result.json_record()) if as_json
+                   else result.summary())
+        if not result.ok:
+            raise SystemExit(1)
+        return
+
+    if rollout:
+        from ..cross_silo.chaos import run_rollout_drill
+
+        kw = dict(random_seed=seed, max_acc_delta=max_acc_delta)
+        if byzantine_kind is not None:
+            kw.update(rollout_poison_kind=byzantine_kind,
+                      rollout_poison_scale=byzantine_scale)
+        result = run_rollout_drill(**kw)
         click.echo(json.dumps(result.json_record()) if as_json
                    else result.summary())
         if not result.ok:

@@ -45,6 +45,30 @@ def global_norm(tree: PyTree, weights_only: bool = False) -> jax.Array:
     return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves))
 
 
+def tree_finite(tree: PyTree) -> jax.Array:
+    """Scalar bool: every entry of every leaf is finite. The shared
+    last-good gate of the self-healing plane — the divergence watchdog
+    rejects a round whose output params fail it, and the serving canary
+    refuses to promote a committed version that fails it (a non-finite
+    model would serve NaN scores to every request). jit-able; callers on
+    a hot path wrap it in ``jax.jit`` once and reuse the executable."""
+    return jax.tree_util.tree_reduce(
+        lambda a, x: jnp.logical_and(a, jnp.all(jnp.isfinite(x))),
+        tree, jnp.bool_(True))
+
+
+def tree_finite_host(tree: PyTree) -> bool:
+    """Host-side companion to :func:`tree_finite` — identical verdict,
+    pure numpy over the leaves. The serving plane's publish pre-gate uses
+    this one: checking a candidate must never dispatch a device op (the
+    first jax op of a process boots the XLA backend — seconds on a loaded
+    host — which would stall the publish path and starve the canary)."""
+    import numpy as _np
+
+    return all(bool(_np.all(_np.isfinite(_np.asarray(l))))
+               for l in jax.tree_util.tree_leaves(tree))
+
+
 def norm_clip_update(update: PyTree, norm_bound: float) -> PyTree:
     """Scale one client's update so ‖update‖₂ ≤ norm_bound (reference
     ``norm_diff_clipping:46`` computes the same on (local - global)); batch

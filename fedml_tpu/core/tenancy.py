@@ -423,6 +423,11 @@ class CheckinQueue:
     unbounded backlog. ``poll`` is the drain side (the admission/round
     plane). The depth gauge is updated on both edges; its high-water mark
     is tracked so a drill can assert the bound held.
+
+    The serving plane (``fedml_tpu.serving``) rides this same edge:
+    inference requests and training check-in frames can share one queue,
+    drained deficit-round-robin across tenants — see
+    ``cross_silo/loadgen.py``'s mixed-traffic mode.
     """
 
     def __init__(self, maxsize: int = 1024):

@@ -557,7 +557,9 @@ def on_round_record(rec: Dict[str, Any], rank: int = 0) -> None:
 def record_instant(name: str, round_idx: Optional[int] = None, rank: int = 0,
                    attrs: Optional[Dict[str, Any]] = None) -> None:
     """One point-in-time event (quarantine / rollback / admission / shed /
-    crash / anomaly) on a rank's track. No-op when the plane is off."""
+    crash / anomaly, plus the serving plane's ``promote`` /
+    ``rollback_served`` swaps) on a rank's track. No-op when the plane is
+    off."""
     if not _plane.active or not telemetry.enabled():
         return
     rec: Dict[str, Any] = {

@@ -564,3 +564,201 @@ def run_chaos_drill(args=None, n_clients: Optional[int] = None,
         codec_bytes_wire=delta("fedml_codec_bytes_out", "plane", enc),
         tenant=tenant,
     )
+
+
+# --- poisoned-rollout drill (serving plane) ----------------------------------
+
+ROLLOUT_DEFAULTS = dict(
+    dataset="mnist",
+    model="lr",
+    debug_small_data=True,
+    client_num_in_total=6,
+    client_num_per_round=4,
+    comm_round=6,
+    learning_rate=0.1,
+    epochs=1,
+    batch_size=8,
+    # every round commits AND evaluates synchronously, so publish order is
+    # deterministic and each version number pairs with its exact round
+    frequency_of_the_test=1,
+    random_seed=0,
+    prefetch=False,
+    # serving plane: canary on, inline verdicts (no worker thread — the
+    # drill wants the promote/rollback decision before publish returns)
+    serve_enabled=True,
+    canary_batches=4,
+    canary_batch_size=64,
+    canary_regression_threshold=0.02,
+    canary_seed=0,
+    # the poison: the publish artifact of this version is corrupted the way
+    # a compromised rollout pipeline would corrupt it — training itself is
+    # untouched, so fault-free and faulted runs train identically
+    rollout_poison_version=5,
+    rollout_poison_kind="sign_flip",
+    rollout_poison_scale=10.0,
+)
+
+
+@dataclasses.dataclass
+class RolloutDrillResult:
+    """Outcome of one poisoned-rollout drill: did the canary block the
+    poisoned promotion, did serving roll back to last-good, did served
+    accuracy hold, and is the poisoned version pinned unre-promotable?"""
+
+    poison_version: int
+    poison_kind: str
+    publishes: int
+    promoted: int                 # hot-swaps in the faulted run
+    rollbacks: int                # store rollbacks (>= 1: the fault fired)
+    rollbacks_counter: float      # fedml_rollbacks_served_total delta
+    poison_status: str            # publish() return for the poisoned version
+    poison_verdict: str           # version-log verdict for that version
+    repub_status: str             # re-publishing the CLEAN params afterwards
+    served_acc_gap: float         # max over versions: ref served acc - faulted
+    fault_free_acc: float         # final served accuracy, fault-free run
+    faulted_acc: float            # final served accuracy, faulted run
+    trajectory: List[dict]        # per publish: version/status/served acc
+    elapsed_s: float
+    max_acc_delta: float = 0.02
+
+    @property
+    def ok(self) -> bool:
+        return (self.rollbacks >= 1
+                and self.rollbacks_counter >= 1
+                and self.poison_status == "rolled_back"
+                and self.poison_verdict == "rolled_back"
+                and self.repub_status == "pinned"
+                and self.served_acc_gap <= self.max_acc_delta)
+
+    def summary(self) -> str:
+        return (
+            f"rollout drill [{self.poison_kind} @ v{self.poison_version}]: "
+            f"{'PASS' if self.ok else 'FAIL'} — {self.publishes} publishes, "
+            f"{self.promoted} promoted, {self.rollbacks} rolled back in "
+            f"{self.elapsed_s:.1f}s | poison {self.poison_status}/"
+            f"{self.poison_verdict}, re-publish {self.repub_status} | "
+            f"served acc gap {self.served_acc_gap:+.4f} "
+            f"(gate <= {self.max_acc_delta:.2f}; final faulted "
+            f"{self.faulted_acc:.4f} vs fault-free {self.fault_free_acc:.4f})"
+        )
+
+    def json_record(self) -> dict:
+        return {
+            "scenario": "rollout",
+            "poison_version": self.poison_version,
+            "poison_kind": self.poison_kind,
+            "publishes": self.publishes,
+            "promoted": self.promoted,
+            "rollbacks": self.rollbacks,
+            "rollbacks_counter": int(self.rollbacks_counter),
+            "poison_status": self.poison_status,
+            "poison_verdict": self.poison_verdict,
+            "repub_status": self.repub_status,
+            "served_acc_gap": round(self.served_acc_gap, 6),
+            "fault_free_acc": round(self.fault_free_acc, 6),
+            "faulted_acc": round(self.faulted_acc, 6),
+            "trajectory": self.trajectory,
+            "elapsed_s": round(self.elapsed_s, 3),
+            "ok": self.ok,
+        }
+
+
+def run_rollout_drill(max_acc_delta: float = 0.02,
+                      **overrides) -> RolloutDrillResult:
+    """Poisoned-rollout drill: train a real simulator twice over the same
+    seed, publishing every committed version through the canary-gated
+    serving plane. The faulted run corrupts ONE version's published
+    artifact (``rollout_poison_kind``, a byzantine kind from
+    comm/resilience.py — training itself is untouched, modeling a
+    compromised rollout pipeline, not a poisoned cohort). The canary must
+    refuse the promotion, serving must keep answering from last-good within
+    the accuracy gate, and the poisoned version must stay pinned: a later
+    re-publish — even of CLEAN params under that version number — is
+    refused, because a version number that shipped poison can never be
+    trusted to mean one thing again."""
+    import numpy as np
+
+    import fedml_tpu
+    from ..comm.resilience import corrupt_update_tree
+    from ..core import telemetry
+    from ..serving import (CanaryEvaluator, InferenceServer, ServeConfig,
+                           held_out_batches)
+    from ..simulation import build_simulator
+
+    cfg = dict(ROLLOUT_DEFAULTS)
+    cfg.update(overrides)
+    poison_v = int(cfg["rollout_poison_version"])
+    kind = str(cfg["rollout_poison_kind"])
+    t0 = time.perf_counter()
+
+    def _run(poison: bool):
+        args = fedml_tpu.init(config=cfg)
+        sim, apply_fn = build_simulator(args)
+        scfg = ServeConfig.from_args(args)
+
+        def predict(params, x):
+            return np.asarray(apply_fn(params, np.asarray(x), train=False))
+
+        test = sim.fed.test_data_global
+        batches = held_out_batches(test.x, test.y, scfg.canary)
+        evaluator = CanaryEvaluator(predict, batches, scfg.canary)
+        server = InferenceServer(predict, scfg, eval_batches=batches)
+        traj: List[dict] = []
+        clean: Dict[int, object] = {}
+
+        def publish(version, params):
+            clean[int(version)] = params
+            if poison and int(version) == poison_v:
+                params = corrupt_update_tree(
+                    params, kind, scale=float(cfg["rollout_poison_scale"]),
+                    seed=int(cfg["random_seed"]))
+            status = server.publish(version, params)
+            act = server.store.active()
+            served_acc = evaluator.score(act[1])[0] if act else 0.0
+            traj.append({"version": int(version), "status": status,
+                         "served_acc": round(served_acc, 6)})
+            return status
+
+        sim.attach_publisher(publish)
+        sim.run(apply_fn, log_fn=None)
+        return server, traj, clean
+
+    # fault-free reference: same seed, same publishes, no poison
+    _, ref_traj, _ = _run(poison=False)
+
+    registry = telemetry.get_registry()
+    before = registry.snapshot()["counters"] if telemetry.enabled() else {}
+    server, traj, clean = _run(poison=True)
+    after = registry.snapshot()["counters"] if telemetry.enabled() else {}
+
+    def delta(name):
+        a = _label_totals(after, name)
+        b = _label_totals(before, name)
+        return sum(a.values()) - sum(b.values())
+
+    poison_recs = [r for r in traj if r["version"] == poison_v]
+    poison_status = poison_recs[0]["status"] if poison_recs else "missing"
+    verdicts = server.store.versions()
+    # the pin: re-publishing the poisoned version number with the CLEAN
+    # params must still be refused
+    repub_status = server.publish(poison_v, clean[poison_v])
+    gap = max((ref["served_acc"] - fau["served_acc"]
+               for ref, fau in zip(ref_traj, traj)), default=float("nan"))
+    store = server.store.stats()
+    return RolloutDrillResult(
+        poison_version=poison_v,
+        poison_kind=kind,
+        publishes=len(traj),
+        promoted=store["swaps"],
+        rollbacks=store["rollbacks"],
+        rollbacks_counter=delta("fedml_rollbacks_served_total"),
+        poison_status=poison_status,
+        poison_verdict=str(verdicts.get(poison_v, "missing")),
+        repub_status=repub_status,
+        served_acc_gap=float(gap),
+        fault_free_acc=ref_traj[-1]["served_acc"] if ref_traj else 0.0,
+        faulted_acc=traj[-1]["served_acc"] if traj else 0.0,
+        trajectory=traj,
+        elapsed_s=time.perf_counter() - t0,
+        max_acc_delta=float(max_acc_delta),
+    )

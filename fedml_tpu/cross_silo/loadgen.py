@@ -33,6 +33,8 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+import numpy as np
+
 from ..comm.message import Message
 from ..comm.resilience import FaultPlan, FaultRule
 from ..core import telemetry
@@ -229,6 +231,295 @@ def run_loadgen(duration_s: float = 1.0, target_rate: float = 0.0,
         queue_maxsize=stats["maxsize"],
         per_tenant_shed=delta("fedml_checkins_shed_total"),
         per_tenant_accepted=delta("fedml_checkins_accepted_total"),
+    )
+
+
+# --- mixed train/serve traffic ----------------------------------------------
+
+MIXED_DEFAULTS = dict(
+    mixed_duration_s=1.0,
+    mixed_target_rate=0.0,  # aggregate INFERENCE offer rate; 0 = flat out
+    mixed_infer_producers=2,
+    mixed_checkin_producers=1,
+    mixed_queue_maxsize=8192,
+    mixed_feature_dim=16,
+    mixed_classes=10,
+    mixed_commit_interval_s=0.05,
+    mixed_min_swaps=5,
+    mixed_seed=0,
+)
+
+
+@dataclasses.dataclass
+class MixedLoadReport:
+    """The mixed-traffic frontier: inference and training check-ins through
+    ONE bounded admission queue, versions hot-swapping underneath."""
+
+    elapsed_s: float
+    submitted: int       # inference requests offered
+    admitted: int        # inference requests accepted at the edge
+    served: int          # inference requests answered (post-drain)
+    canary_served: int   # of served, routed to an undecided candidate
+    train_offered: int   # check-in frames offered (post-churn)
+    train_processed: int  # check-in frames deserialized by the handler
+    publishes: int
+    swaps: int           # promoted versions = hot pointer swaps
+    rollbacks: int
+    min_swaps: int
+    max_queue_depth: int
+    queue_maxsize: int
+    served_by_version: Dict[str, int]
+
+    @property
+    def shed(self) -> int:
+        """Refused at the admission edge — bounded-queue overload working
+        as designed, NOT a dropped request."""
+        return self.submitted - self.admitted
+
+    @property
+    def dropped(self) -> int:
+        """Admitted but never answered. The zero-drop hot-swap guarantee
+        is exactly ``dropped == 0``."""
+        return self.admitted - self.served
+
+    @property
+    def served_rate(self) -> float:
+        return self.served / self.elapsed_s if self.elapsed_s > 0 else 0.0
+
+    @property
+    def ok(self) -> bool:
+        return (self.dropped == 0
+                and self.served == self.admitted
+                and self.train_processed <= self.train_offered
+                and self.max_queue_depth <= self.queue_maxsize
+                and self.swaps >= self.min_swaps)
+
+    def summary(self) -> str:
+        return (
+            f"mixed-loadgen: {'PASS' if self.ok else 'FAIL'} — "
+            f"{self.served_rate:,.0f} req/s served over {self.elapsed_s:.2f}s "
+            f"({self.canary_served} canary) | dropped {self.dropped}, "
+            f"shed {self.shed} | {self.swaps} hot-swaps "
+            f"(>= {self.min_swaps} required), {self.rollbacks} rollbacks | "
+            f"train {self.train_processed}/{self.train_offered} frames | "
+            f"queue depth max {self.max_queue_depth}/{self.queue_maxsize}"
+        )
+
+    def json_record(self) -> dict:
+        return {
+            "elapsed_s": round(self.elapsed_s, 4),
+            "submitted": self.submitted,
+            "admitted": self.admitted,
+            "served": self.served,
+            "served_per_sec": round(self.served_rate, 1),
+            "canary_served": self.canary_served,
+            "dropped": self.dropped,
+            "shed": self.shed,
+            "train_offered": self.train_offered,
+            "train_processed": self.train_processed,
+            "publishes": self.publishes,
+            "swaps": self.swaps,
+            "rollbacks": self.rollbacks,
+            "min_swaps": self.min_swaps,
+            "max_queue_depth": self.max_queue_depth,
+            "queue_maxsize": self.queue_maxsize,
+            "queue_depth_bounded": self.max_queue_depth <= self.queue_maxsize,
+            "served_by_version": {
+                str(k): int(v)
+                for k, v in sorted(self.served_by_version.items())},
+            "ok": self.ok,
+        }
+
+
+def run_mixed_loadgen(duration_s: float = 1.0, target_rate: float = 0.0,
+                      infer_producers: int = 2, checkin_producers: int = 1,
+                      queue_maxsize: int = 8192, feature_dim: int = 16,
+                      classes: int = 10, commit_interval_s: float = 0.05,
+                      min_swaps: int = 5, seed: int = 0,
+                      payload_bytes: int = 64, population: int = 50_000,
+                      server=None, committer=None) -> MixedLoadReport:
+    """Mixed-traffic drill: inference requests AND training check-in frames
+    share one bounded :class:`CheckinQueue`, drained deficit-round-robin by
+    the serving worker, while a committer publishes new model versions
+    underneath — the proof that hot-swaps drop nothing under load.
+
+    Default harness is self-contained: a seeded numpy linear model serves,
+    and a committer thread publishes perturbed weights every
+    ``commit_interval_s`` (worker-mode canary gates each one). Callers may
+    inject their own ``server`` (e.g. wired to a live simulator via
+    ``serving.build_inference_server``) and/or ``committer(server, stop)``.
+    """
+    from ..core.tenancy import DeficitRoundRobinScheduler
+    from ..serving import (CanaryConfig, InferenceServer, ServeConfig,
+                           held_out_batches)
+
+    rng = np.random.default_rng(int(seed))
+    train_processed = [0]
+
+    def handler(item) -> None:
+        msg = Message.from_bytes(item)  # real codec on the drain side
+        assert msg.get_type() == MSG_TYPE_CHECKIN
+        train_processed[0] += 1
+
+    if server is None:
+        w0 = (rng.normal(size=(int(feature_dim), int(classes)))
+              .astype(np.float32) * 0.5)
+        x_pool = rng.normal(
+            size=(4096, int(feature_dim))).astype(np.float32)
+        y_pool = np.argmax(x_pool @ w0, axis=-1)
+
+        def predict(params, x):
+            return x @ params
+
+        cfg = ServeConfig(
+            enabled=True, queue_maxsize=int(queue_maxsize),
+            canary=CanaryConfig(seed=int(seed)))
+        drr = DeficitRoundRobinScheduler()
+        drr.register("train", round_cost=1.0)
+        decided = threading.Event()
+        server = InferenceServer(
+            predict, cfg,
+            eval_batches=held_out_batches(x_pool, y_pool, cfg.canary),
+            drr=drr, handler=handler,
+            on_verdict=lambda _v, _s: decided.set())
+        server.publish(1, w0)
+
+        if committer is None:
+            def committer(srv, stop_evt) -> None:
+                version = 2
+                while not stop_evt.is_set():
+                    # small seeded drift: stays within the canary threshold,
+                    # so every version promotes (a hot swap per commit)
+                    delta = (np.random.default_rng(version)
+                             .normal(size=w0.shape).astype(np.float32)
+                             * 1e-4)
+                    t_pub = time.perf_counter()
+                    decided.clear()
+                    status = srv.publish(version, w0 + delta)
+                    if status == "candidate":
+                        # trainer-paced rollout: block on the verdict (the
+                        # canary window advances one held-out batch per
+                        # pump), so a loaded host slows the commit cadence
+                        # instead of superseding every candidate before
+                        # its window closes
+                        while (not stop_evt.is_set()
+                               and not decided.wait(0.25)):
+                            pass
+                    version += 1
+                    waited = time.perf_counter() - t_pub
+                    stop_evt.wait(
+                        max(float(commit_interval_s) - waited, 1e-3))
+    else:
+        server._handler = handler
+        x_pool = rng.normal(
+            size=(4096, int(feature_dim))).astype(np.float32)
+
+    stop = threading.Event()
+    per_rate = (float(target_rate) / max(1, int(infer_producers))
+                if target_rate and target_rate > 0 else 0.0)
+
+    def produce_infer(worker: int) -> None:
+        t0 = time.perf_counter()
+        i = 0
+        n_pool = len(x_pool)
+        while not stop.is_set():
+            server.submit(x_pool[(worker + i) % n_pool],
+                          request_id=(worker, i))
+            i += 1
+            if per_rate > 0 and i % 64 == 0:
+                ahead = i / per_rate - (time.perf_counter() - t0)
+                if ahead > 0.001:
+                    time.sleep(min(ahead, 0.05))
+
+    payload = bytes(int(payload_bytes))
+    train_offered = [0] * max(1, int(checkin_producers))
+
+    def produce_checkin(worker: int) -> None:
+        i = 0
+        pop = max(1, int(population))
+        while not stop.is_set():
+            device_id = worker * 10_000_000 + (i % pop)
+            msg = _checkin_frame(device_id, "train", payload)
+            server.queue.offer(msg.to_bytes(), tenant="train")
+            train_offered[worker] += 1
+            i += 1
+            # check-ins are the background tenant: pace them well below the
+            # inference stream so DRR fairness, not starvation, is on trial
+            if i % 256 == 0:
+                time.sleep(0.001)
+
+    threads = [threading.Thread(target=produce_infer, args=(w,),
+                                daemon=True, name=f"mixed-infer{w}")
+               for w in range(max(1, int(infer_producers)))]
+    threads += [threading.Thread(target=produce_checkin, args=(w,),
+                                 daemon=True, name=f"mixed-checkin{w}")
+                for w in range(max(1, int(checkin_producers)))]
+    commit_thread = None
+    if committer is not None:
+        commit_thread = threading.Thread(
+            target=committer, args=(server, stop), daemon=True,
+            name="mixed-committer")
+
+    t0 = time.perf_counter()
+    server.start()
+    for t in threads:
+        t.start()
+    if commit_thread is not None:
+        commit_thread.start()
+    time.sleep(max(0.01, float(duration_s)))
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    if commit_thread is not None:
+        commit_thread.join(timeout=10.0)
+    # stop drains the queue and lands any in-flight canary verdict, so the
+    # zero-drop accounting below is exact, not racy
+    server.stop(drain=True)
+    elapsed = time.perf_counter() - t0
+
+    st = server.stats()
+    store = st["store"]
+    log = server.store.export_state()["log"]
+    return MixedLoadReport(
+        elapsed_s=elapsed,
+        submitted=st["submitted"],
+        admitted=st["admitted"],
+        served=st["served"],
+        canary_served=st["canary_served"],
+        train_offered=sum(train_offered),
+        train_processed=train_processed[0],
+        publishes=sum(1 for _, ev in log if ev == "publish"),
+        swaps=store["swaps"],  # promote() pointer swaps; v1 doesn't count
+        rollbacks=store["rollbacks"],
+        min_swaps=int(min_swaps),
+        max_queue_depth=st["queue"]["max_depth"],
+        queue_maxsize=st["queue"]["maxsize"],
+        served_by_version=st["served_by_version"],
+    )
+
+
+def run_mixed_loadgen_from_args(args) -> MixedLoadReport:
+    """Map the flat ``mixed_*`` config keys onto :func:`run_mixed_loadgen`."""
+    d = MIXED_DEFAULTS
+    return run_mixed_loadgen(
+        duration_s=float(getattr(args, "mixed_duration_s",
+                                 d["mixed_duration_s"])),
+        target_rate=float(getattr(args, "mixed_target_rate",
+                                  d["mixed_target_rate"])),
+        infer_producers=int(getattr(args, "mixed_infer_producers",
+                                    d["mixed_infer_producers"])),
+        checkin_producers=int(getattr(args, "mixed_checkin_producers",
+                                      d["mixed_checkin_producers"])),
+        queue_maxsize=int(getattr(args, "mixed_queue_maxsize",
+                                  d["mixed_queue_maxsize"])),
+        feature_dim=int(getattr(args, "mixed_feature_dim",
+                                d["mixed_feature_dim"])),
+        classes=int(getattr(args, "mixed_classes", d["mixed_classes"])),
+        commit_interval_s=float(getattr(args, "mixed_commit_interval_s",
+                                        d["mixed_commit_interval_s"])),
+        min_swaps=int(getattr(args, "mixed_min_swaps",
+                              d["mixed_min_swaps"])),
+        seed=int(getattr(args, "mixed_seed", d["mixed_seed"])),
     )
 
 

@@ -26,7 +26,8 @@ from ..comm import codec as comm_codec
 from ..comm.resilience import SendFailure
 from ..comm.utils import log_round_end, log_round_start
 from ..core import telemetry, trace_plane
-from ..utils.checkpoint import RoundStateStore, trim_version_log
+from ..utils.checkpoint import (DEFAULT_KEEP_VERSIONS, RoundStateStore,
+                                trim_version_log)
 from .message_define import MyMessage
 
 
@@ -100,7 +101,8 @@ class FedMLServerManager(ServerManager):
         self._version_log: List[list] = []
         self._pending_senders: List[int] = []
         self.keep_versions = int(
-            getattr(args, "round_store_keep_versions", 32) or 0)
+            getattr(args, "round_store_keep_versions",
+                    DEFAULT_KEEP_VERSIONS) or 0)
         if self.async_mode:
             if float(getattr(args, "watchdog_factor", 0.0) or 0.0) > 0:
                 raise ValueError(

@@ -1,0 +1,66 @@
+"""Canary-gated serving plane: versioned hot-swap inference.
+
+Training commits a model version after every round / async commit; this
+package turns those commits into a live request path with three guarantees:
+
+1. **Zero-drop hot-swap** — a promote is an RCU pointer swap in the
+   :class:`~fedml_tpu.serving.store.VersionedModelStore`; in-flight batches
+   finish on the version they started with, the next batch serves the new
+   one. Requests drop only at the bounded admission edge, under overload.
+2. **Canary-gated promotion** — a new version serves a configurable traffic
+   fraction while a seeded evaluator scores it against fixed held-out
+   batches (:mod:`~fedml_tpu.serving.canary`); a regression beyond the
+   threshold or any non-finite output rolls the rollout back to last-good.
+3. **Rollback pins** — the verdict is recorded in the version log; a
+   rolled-back version is refused on re-publish forever, across trims and
+   restarts (``export_state``/``import_state``).
+
+Everything is off by default: no ``serve_*`` knob set means no server is
+constructed and the training path is byte-identical to builds without this
+package.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from .canary import CanaryConfig, CanaryEvaluator, held_out_batches
+from .server import InferenceServer, ServeConfig
+from .store import VersionedModelStore
+
+__all__ = [
+    "CanaryConfig",
+    "CanaryEvaluator",
+    "InferenceServer",
+    "ServeConfig",
+    "VersionedModelStore",
+    "build_inference_server",
+    "held_out_batches",
+]
+
+
+def build_inference_server(args, sim, apply_fn,
+                           queue=None, drr=None, handler=None,
+                           on_result=None) -> Optional[InferenceServer]:
+    """Wire a server to a built simulator: the canary's held-out batches
+    come from the global test split (seeded by ``canary_seed``, not the run
+    seed) and ``predict_fn`` is the model's apply under the committed
+    variables. Returns None when serving is disabled — the caller attaches
+    nothing and the run is unchanged."""
+    cfg = ServeConfig.from_args(args)
+    if not cfg.enabled:
+        return None
+
+    def predict(params: Any, x: np.ndarray):
+        return apply_fn(params, np.asarray(x), train=False)
+
+    test = sim.fed.test_data_global
+    batches = (held_out_batches(test.x, test.y, cfg.canary)
+               if len(test.x) else [])
+    server = InferenceServer(
+        predict, cfg, eval_batches=batches, queue=queue, drr=drr,
+        handler=handler, on_result=on_result)
+    sim.attach_publisher(server.publish)
+    return server

@@ -1,0 +1,117 @@
+"""Seeded canary evaluation for version promotion.
+
+A candidate version earns its hot-swap by scoring against a fixed set of
+held-out batches drawn once with the canary seed — the SAME batches every
+round and every process, so a canary verdict is reproducible and two
+replicas never disagree about whether a rollout regressed. Two gates:
+
+- **finiteness** — any non-finite output (or non-finite params; see
+  :func:`fedml_tpu.core.robust.tree_finite`, the watchdog's shared gate)
+  fails immediately: a NaN model would serve NaN scores to every request;
+- **regression** — candidate accuracy more than ``regression_threshold``
+  below the serving baseline fails (baseline = the currently-promoted
+  version scored on the same batches).
+
+The evaluator is deliberately tiny and host-side: a few small batches per
+verdict, cheap enough to ride the publish path or the serve worker's drain
+loop without denting throughput.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Sequence, Tuple
+
+import numpy as np
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class CanaryConfig:
+    # fraction of live traffic routed to an undecided candidate while the
+    # evaluator scores it (0 = shadow-only canary, no live exposure)
+    fraction: float = 0.1
+    # held-out batches per verdict; more batches = lower-variance verdict
+    batches: int = 4
+    batch_size: int = 64
+    # max accuracy drop vs the serving baseline before rollback fires
+    regression_threshold: float = 0.02
+    seed: int = 0
+
+
+def held_out_batches(x, y, cfg: CanaryConfig
+                     ) -> List[Tuple[np.ndarray, np.ndarray]]:
+    """Draw the canary's held-out batches from a global test split,
+    deterministically in the canary seed (NOT the run seed — the canary
+    must score identically across runs that train differently)."""
+    x = np.asarray(x)
+    y = np.asarray(y)
+    n = int(x.shape[0])
+    if n == 0:
+        return []
+    rng = np.random.default_rng(int(cfg.seed))
+    out = []
+    for _ in range(max(int(cfg.batches), 1)):
+        idx = rng.choice(n, size=min(int(cfg.batch_size), n), replace=False)
+        out.append((x[idx], y[idx]))
+    return out
+
+
+class CanaryEvaluator:
+    """Scores params against the fixed held-out batches.
+
+    ``predict_fn(params, x) -> outputs`` — class scores ``(B, C)`` or a
+    scalar-per-sample vector ``(B,)`` (thresholded at 0.5, the bce
+    convention used by the eval plane).
+    """
+
+    def __init__(self, predict_fn: Callable[[PyTree, np.ndarray], Any],
+                 batches: Sequence[Tuple[np.ndarray, np.ndarray]],
+                 cfg: CanaryConfig = CanaryConfig()):
+        self.cfg = cfg
+        self._predict = predict_fn
+        self._batches = list(batches)
+        if not self._batches:
+            raise ValueError("canary evaluator needs >= 1 held-out batch")
+
+    def __len__(self) -> int:
+        return len(self._batches)
+
+    def score_batch(self, params: PyTree, i: int
+                    ) -> Tuple[float, bool, int]:
+        """One batch: ``(accuracy, finite, n_samples)``. ``i`` wraps, so an
+        incremental scorer can just feed its running batch counter."""
+        x, y = self._batches[i % len(self._batches)]
+        out = np.asarray(self._predict(params, x))
+        finite = bool(np.all(np.isfinite(out)))
+        if not finite:
+            return 0.0, False, int(x.shape[0])
+        if out.ndim > 1:
+            pred = np.argmax(out, axis=-1)
+        else:
+            pred = (out > 0.5).astype(np.int64)
+        acc = float(np.mean(pred.reshape(-1) == np.asarray(y).reshape(-1)))
+        return acc, True, int(x.shape[0])
+
+    def score(self, params: PyTree) -> Tuple[float, bool]:
+        """All batches: sample-weighted accuracy + finiteness. Short-circuits
+        on the first non-finite batch (the verdict is already decided)."""
+        acc_sum = 0.0
+        n_sum = 0
+        for i in range(len(self._batches)):
+            acc, finite, n = self.score_batch(params, i)
+            if not finite:
+                return 0.0, False
+            acc_sum += acc * n
+            n_sum += n
+        return acc_sum / max(n_sum, 1), True
+
+    def verdict(self, baseline_acc: float, cand_acc: float,
+                cand_finite: bool) -> bool:
+        """True = promote. The epsilon absorbs float summation noise so a
+        bit-identical re-publish of the baseline always passes."""
+        if not cand_finite:
+            return False
+        return (float(baseline_acc) - float(cand_acc)
+                <= float(self.cfg.regression_threshold) + 1e-12)

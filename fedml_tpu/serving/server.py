@@ -1,0 +1,424 @@
+"""In-process batched inference server with canary-gated hot-swap.
+
+The serving half of the train/serve plane: training commits a model version
+(``FedSimulator.attach_publisher`` → :meth:`InferenceServer.publish`),
+the canary scores it against seeded held-out batches, and only a passing
+version is promoted into the request path — a regressing or non-finite
+rollout is rolled back to last-good automatically and pinned so it can
+never be re-promoted (the verdict rides the version log; see
+serving/store.py).
+
+Admission reuses the multi-tenant edge the cross-silo server and the async
+engine already share: requests enter through a bounded
+:class:`~fedml_tpu.core.tenancy.CheckinQueue` (overload sheds with a
+counter instead of an unbounded backlog) and, when a
+:class:`~fedml_tpu.core.tenancy.DeficitRoundRobinScheduler` is attached,
+drain in deficit-round-robin order across tenants — mixed train/serve
+traffic shares one queue without starvation.
+
+Hot-swap contract: a batch reads the store's active ``(version, params)``
+tuple ONCE and serves the whole batch from that reference; a promote
+landing mid-batch swaps the tuple for the NEXT batch. No request is ever
+dropped by a swap — drops happen only at the admission edge, and only
+under overload.
+
+Threading: ``pump`` drains on the caller's thread (deterministic drills);
+``start`` runs it on a worker thread (throughput benches). Every mutable
+server attribute is touched only under ``self._lock``; metric writes and
+store calls happen outside it (graftcheck lock-order/thread-hazard scope
+covers this package).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core import telemetry
+from ..core.robust import tree_finite_host
+from ..core.tenancy import CheckinQueue, DeficitRoundRobinScheduler
+from ..utils.checkpoint import DEFAULT_KEEP_VERSIONS
+from .canary import CanaryConfig, CanaryEvaluator
+from .store import VersionedModelStore
+
+PyTree = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Serving knobs (``serve_*``/``canary_*`` in the flat args namespace).
+    ``enabled`` is the master gate: False (the default) means no server is
+    built anywhere — the training path stays byte-identical."""
+
+    enabled: bool = False
+    batch_max: int = 64
+    queue_maxsize: int = 4096
+    tenant: str = "serve"
+    keep_versions: int = DEFAULT_KEEP_VERSIONS
+    canary: CanaryConfig = dataclasses.field(default_factory=CanaryConfig)
+
+    @staticmethod
+    def from_args(args) -> "ServeConfig":
+        return ServeConfig(
+            enabled=bool(getattr(args, "serve_enabled", False)),
+            batch_max=int(getattr(args, "serve_batch_max", 64)),
+            queue_maxsize=int(getattr(args, "serve_queue_maxsize", 4096)),
+            tenant=str(getattr(args, "serve_tenant", "serve")),
+            # shared retention default with the round-store / federation log
+            keep_versions=int(
+                getattr(args, "round_store_keep_versions",
+                        DEFAULT_KEEP_VERSIONS) or 0),
+            canary=CanaryConfig(
+                fraction=float(getattr(args, "canary_fraction", 0.1)),
+                batches=int(getattr(args, "canary_batches", 4)),
+                batch_size=int(getattr(args, "canary_batch_size", 64)),
+                regression_threshold=float(
+                    getattr(args, "canary_regression_threshold", 0.02)),
+                seed=int(getattr(args, "canary_seed", 0)),
+            ),
+        )
+
+
+class InferenceServer:
+    """Batched request server over a :class:`VersionedModelStore`.
+
+    ``predict_fn(params, x) -> outputs`` must accept a stacked feature
+    batch. ``eval_batches`` (held-out ``(x, y)`` pairs) arm the canary;
+    without them every publish promotes immediately (trust-on-publish).
+    ``handler`` consumes non-inference queue items (mixed-traffic mode:
+    training check-in frames share the admission queue). ``on_result``
+    (optional) receives ``(request_id, served_version, output_row)`` per
+    request — for correctness tests, not the throughput path.
+    ``on_verdict`` (optional) receives ``(version, status)`` when a
+    version reaches a terminal state (``promoted`` / ``rolled_back`` /
+    ``superseded``) — fired outside every lock, so a trainer can block
+    on a real Event for the canary verdict instead of GIL-starved
+    polling.
+    """
+
+    def __init__(self, predict_fn: Callable[[PyTree, np.ndarray], Any],
+                 cfg: Optional[ServeConfig] = None,
+                 eval_batches=(),
+                 queue: Optional[CheckinQueue] = None,
+                 drr: Optional[DeficitRoundRobinScheduler] = None,
+                 handler: Optional[Callable[[Any], Any]] = None,
+                 on_result: Optional[Callable[[Any, int, Any], Any]] = None,
+                 on_verdict: Optional[Callable[[int, str], Any]] = None):
+        self.cfg = cfg or ServeConfig(enabled=True)
+        self._predict = predict_fn
+        self.store = VersionedModelStore(self.cfg.keep_versions)
+        self._canary = (
+            CanaryEvaluator(predict_fn, eval_batches, self.cfg.canary)
+            if eval_batches else None)
+        self.queue = queue or CheckinQueue(maxsize=self.cfg.queue_maxsize)
+        self._drr = drr
+        if drr is not None:
+            try:
+                drr.register(self.cfg.tenant, round_cost=1.0)
+            except ValueError:
+                pass  # shared scheduler: tenant registered by the caller
+        self._handler = handler
+        self._on_result = on_result
+        self._on_verdict = on_verdict
+        self._lock = threading.Lock()
+        self._run = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+        # mutable serving state — every access below goes through self._lock
+        self._submitted = 0
+        self._admitted = 0
+        self._served = 0
+        self._handled = 0
+        self._canary_served = 0
+        self._seq = 0
+        self._by_version: Dict[int, int] = {}
+        self._pend: List[tuple] = []  # admitted before any version exists
+        self._baseline: Optional[Tuple[int, float]] = None
+        self._cand: Optional[dict] = None  # in-flight canary bookkeeping
+
+    # --- publish side (training thread) ------------------------------------
+
+    def publish(self, version: int, params: PyTree) -> str:
+        """The commit→publish hook. Returns the final status: ``promoted``,
+        ``candidate`` (worker mode: verdict lands asynchronously after the
+        canary window), ``rolled_back``, ``pinned`` or ``duplicate``."""
+        status = self.store.publish(version, params)
+        if status != "candidate":
+            return status
+        if self._canary is None:
+            self.store.promote(version)
+            return "promoted"
+        # shared servability gate with the divergence watchdog (host-side
+        # variant — the publish path must not boot the XLA backend): a
+        # version with non-finite params never reaches the request path
+        if not tree_finite_host(params):
+            self.store.rollback(version, reason="non_finite_params")
+            self._notify(version, "rolled_back")
+            return "rolled_back"
+        base = self._baseline_acc()
+        worker_live = self._worker is not None and self._run.is_set()
+        if worker_live:
+            with self._lock:
+                prev, self._cand = self._cand, {
+                    "version": int(version), "acc_sum": 0.0, "n_sum": 0,
+                    "steps": 0, "finite": True, "base": base}
+            if prev is not None:
+                # a newer publish closes the previous canary window
+                self.store.retire(prev["version"])
+                self._notify(prev["version"], "superseded")
+            return "candidate"
+        # no worker: score the whole window inline — the deterministic
+        # drill path (verdict before publish returns)
+        acc, finite = self._canary.score(params)
+        if self._canary.verdict(base, acc, finite):
+            self.store.promote(version)
+            self._notify(version, "promoted")
+            return "promoted"
+        self.store.rollback(
+            version,
+            reason="canary_regression" if finite else "non_finite_outputs")
+        self._notify(version, "rolled_back")
+        return "rolled_back"
+
+    def _notify(self, version: int, status: str) -> None:
+        if self._on_verdict is not None:
+            self._on_verdict(int(version), status)
+
+    def _baseline_acc(self) -> float:
+        """Serving baseline = the active version's score on the canary
+        batches, cached per version (one re-score per promote)."""
+        act = self.store.active()
+        if act is None:
+            return 0.0
+        version, params = act
+        with self._lock:
+            b = self._baseline
+        if b is not None and b[0] == version:
+            return b[1]
+        acc, _ = self._canary.score(params)
+        with self._lock:
+            self._baseline = (version, acc)
+        return acc
+
+    # --- request side -------------------------------------------------------
+
+    def submit(self, features, request_id=None,
+               tenant: Optional[str] = None) -> bool:
+        """Offer one request at the admission edge. False = shed (queue
+        full) — the only way the serving plane ever drops a request."""
+        t = str(tenant or self.cfg.tenant)
+        ok = self.queue.offer(("infer", request_id, features, t), tenant=t)
+        with self._lock:
+            self._submitted += 1
+            if ok:
+                self._admitted += 1
+        return ok
+
+    def pump(self, max_items: Optional[int] = None) -> int:
+        """Drain up to ``max_items`` queue entries on the caller's thread.
+        Returns the number drained (0 = queue empty). Non-inference items
+        go to ``handler``; inference items are DRR-ordered across tenants
+        (when a scheduler is attached) and served in batches of
+        ``batch_max``, each batch on ONE store read."""
+        if self.store.active() is None:
+            # nothing published yet: leave traffic parked in the BOUNDED
+            # queue (the edge keeps shedding) instead of pulling it into an
+            # unbounded host list — admitted requests still serve once the
+            # first version lands
+            return 0
+        limit = (int(max_items) if max_items is not None
+                 else 4 * self.cfg.batch_max)
+        infer: List[tuple] = []
+        other: List[Any] = []
+        n = 0
+        while n < limit:
+            item = self.queue.poll()
+            if item is None:
+                break
+            n += 1
+            if isinstance(item, tuple) and item and item[0] == "infer":
+                infer.append(item)
+            else:
+                other.append(item)
+        if other and self._handler is not None:
+            for it in other:
+                self._handler(it)
+            with self._lock:
+                self._handled += len(other)
+        with self._lock:
+            if self._pend:
+                infer = self._pend + infer
+                self._pend = []
+        if infer and self._drr is not None:
+            infer = self._drr_order(infer)
+        for start in range(0, len(infer), self.cfg.batch_max):
+            self._process_batch(infer[start:start + self.cfg.batch_max])
+        self._canary_step()
+        return n
+
+    def _drr_order(self, items: List[tuple]) -> List[tuple]:
+        by_t: Dict[str, List[tuple]] = {}
+        for it in items:
+            by_t.setdefault(str(it[3]), []).append(it)
+        if len(by_t) == 1:
+            return items
+        ordered: List[tuple] = []
+        ready = set(by_t)
+        while ready:
+            t = self._drr.next_tenant(ready=ready)
+            if t is None:
+                break
+            lst = by_t[t]
+            ordered.append(lst.pop(0))
+            self._drr.charge(t, 1.0)
+            if not lst:
+                ready.discard(t)
+        for lst in by_t.values():  # tenants the scheduler doesn't know
+            ordered.extend(lst)
+        return ordered
+
+    def _process_batch(self, items: List[tuple]) -> None:
+        act = self.store.active()
+        if act is None:
+            # admitted before the first publish: park, retry next pump —
+            # an admitted request is never dropped
+            with self._lock:
+                self._pend.extend(items)
+            return
+        version, params = act  # ONE read; the batch serves this version
+        cand = None
+        frac = self.cfg.canary.fraction
+        with self._lock:
+            cand_v = (self._cand["version"]
+                      if self._cand is not None else None)
+            seq0 = self._seq
+            self._seq += len(items)
+        if cand_v is not None and frac > 0:
+            cand = self.store.get(cand_v)
+        stride = max(1, int(round(1.0 / frac))) if frac > 0 else 0
+        idx_c = ([i for i in range(len(items))
+                  if (seq0 + i) % stride == 0]
+                 if cand is not None else [])
+        idx_m = [i for i in range(len(items)) if i not in set(idx_c)]
+        outs: List[Any] = [None] * len(items)
+        vers: List[int] = [version] * len(items)
+        for idx, p, v in ((idx_m, params, version),
+                          (idx_c, cand, cand_v)):
+            if not idx:
+                continue
+            x = np.stack([np.asarray(items[i][2]) for i in idx])
+            out = np.asarray(self._predict(p, x))
+            for j, i in enumerate(idx):
+                outs[i] = out[j]
+                vers[i] = v
+        if self._on_result is not None:
+            for i, it in enumerate(items):
+                self._on_result(it[1], vers[i], outs[i])
+        with self._lock:
+            self._served += len(items)
+            self._canary_served += len(idx_c)
+            self._by_version[version] = (
+                self._by_version.get(version, 0) + len(idx_m))
+            if idx_c:
+                self._by_version[cand_v] = (
+                    self._by_version.get(cand_v, 0) + len(idx_c))
+        reg = telemetry.get_registry()
+        if reg.enabled:
+            reg.counter("fedml_inference_requests_total").inc(len(items))
+
+    def _canary_step(self) -> None:
+        """One held-out batch of canary scoring per drain iteration; the
+        verdict fires once the window is full (or on the first non-finite
+        batch). Runs on whichever thread pumps."""
+        if self._canary is None:
+            return
+        with self._lock:
+            c = self._cand
+        if c is None:
+            return
+        params = self.store.get(c["version"])
+        if params is None:  # rolled back / retired underneath us
+            with self._lock:
+                if self._cand is c:
+                    self._cand = None
+            return
+        acc, finite, nb = self._canary.score_batch(params, c["steps"])
+        done = False
+        with self._lock:
+            if self._cand is not c:
+                return
+            c["acc_sum"] += acc * nb
+            c["n_sum"] += nb
+            c["steps"] += 1
+            c["finite"] = c["finite"] and finite
+            done = ((not c["finite"])
+                    or c["steps"] >= self._canary.cfg.batches)
+            if done:
+                self._cand = None
+        if not done:
+            return
+        cand_acc = c["acc_sum"] / max(c["n_sum"], 1)
+        if self._canary.verdict(c["base"], cand_acc, c["finite"]):
+            self.store.promote(c["version"])
+            self._notify(c["version"], "promoted")
+        else:
+            self.store.rollback(
+                c["version"],
+                reason=("canary_regression" if c["finite"]
+                        else "non_finite_outputs"))
+            self._notify(c["version"], "rolled_back")
+
+    # --- worker -------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._worker is not None:
+            return
+        self._run.set()
+        self._worker = threading.Thread(
+            target=self._serve_loop, name="fedml-serve", daemon=True)
+        self._worker.start()
+
+    def stop(self, drain: bool = True) -> None:
+        self._run.clear()
+        w = self._worker
+        if w is not None:
+            w.join(timeout=30.0)
+        self._worker = None
+        if not drain:
+            return
+        while self.pump() > 0:
+            pass
+        # land the verdict of a candidate still mid-window so no version
+        # exits the run undecided
+        for _ in range(self.cfg.canary.batches + 1):
+            with self._lock:
+                pending = self._cand is not None
+            if not pending:
+                break
+            self._canary_step()
+
+    def _serve_loop(self) -> None:
+        while self._run.is_set():
+            if self.pump() == 0:
+                time.sleep(0.0005)
+
+    # --- accounting ---------------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Any] = {
+                "submitted": self._submitted,
+                "admitted": self._admitted,
+                "served": self._served,
+                "handled": self._handled,
+                "canary_served": self._canary_served,
+                "pending": len(self._pend),
+                "served_by_version": dict(self._by_version),
+            }
+        out["dropped"] = out["submitted"] - out["admitted"]
+        out["queue"] = self.queue.stats()
+        out["store"] = self.store.stats()
+        return out

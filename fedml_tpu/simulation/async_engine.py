@@ -497,6 +497,8 @@ class AsyncFedSimulator(FedSimulator):
             "virtual_time_s": self._vt,
             "goodput_ups": self._committed / max(self._vt, 1e-12),
         })
+        # commit→publish rides the inherited record finalize (see _commit):
+        # gen+1 == the incremented self._version of this lockstep commit
         self._pending = self._defer_rec(
             gen, t0, metrics_vec, self._pending, apply_fn, ckpt, log_fn,
             timing)
@@ -659,6 +661,10 @@ class AsyncFedSimulator(FedSimulator):
             "virtual_time_s": self._vt,
             "goodput_ups": goodput,
         })
+        # finalizing this record fires the inherited commit→publish hook
+        # (fed_sim._post_round_body) with version round_idx+1 — exactly the
+        # post-increment self._version this commit just produced, so the
+        # serving plane sees one publish per commit with the right number
         self._pending = self._defer_rec(
             version, t0, metrics_vec, self._pending, apply_fn, ckpt, log_fn,
             rec_timing)

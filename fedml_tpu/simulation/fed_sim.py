@@ -259,6 +259,13 @@ class SimConfig:
     # packed/bucketed schedules, async mode, host-resident data or dict
     # state backends) raise ScanIncompatibleError at construction.
     rounds_per_dispatch: int = 1
+    # honest "device" phase stamping for benchmarks: block on the committed
+    # params (not just the tiny metric vector) before taking the completion
+    # timestamp. Under async dispatch the metric readback can return while
+    # the round's larger executables are still retiring, which shifted tail
+    # device time into host_other in earlier bench runs (BENCH_r07). Costs
+    # one extra sync per round, so off by default; bench.py opts in.
+    sync_device_phase: bool = False
 
 
 @dataclasses.dataclass
@@ -399,6 +406,11 @@ class FedSimulator:
         # attributed rather than lumped into host_other. None (default) =
         # single-tenant, zero behavior change.
         self._round_gate: Optional[Callable[[int], None]] = None
+        # commit→publish hook (serving plane): called with
+        # ``(version, params_copy)`` after each round's params commit —
+        # attach via attach_publisher. None (default) = no serving, zero
+        # behavior change (the disabled path never copies params).
+        self._publisher: Optional[Callable[[int, Any], Any]] = None
 
         sizes = [len(v) for v in fed_data.train_data_local_dict.values()]
         if cfg.num_local_batches is None:
@@ -1542,10 +1554,12 @@ class FedSimulator:
                 self._arena.restore(arena_snap)
 
         if self._finite_fn is None:
-            self._finite_fn = jax.jit(
-                lambda p: jax.tree_util.tree_reduce(
-                    lambda a, x: jnp.logical_and(a, jnp.all(jnp.isfinite(x))),
-                    p, jnp.bool_(True)))
+            from ..core.robust import tree_finite
+
+            # same last-good gate the serving canary applies to committed
+            # versions (core/robust.tree_finite) — one shared definition of
+            # "this model is servable"
+            self._finite_fn = jax.jit(tree_finite)
         last_good = snap()
         window: List[float] = []
         for round_idx in rounds:
@@ -1633,6 +1647,23 @@ class FedSimulator:
                 del window[:-max(1, cfg.watchdog_window)]
             self._finalize_rec(rec, apply_fn, ckpt, log_fn)
 
+    def attach_publisher(self, publish_fn) -> None:
+        """Arm the commit→publish hook: ``publish_fn(version, params)`` runs
+        after every round's params commit with a COPIED pytree (the round
+        step donates ``self.params`` into the next dispatch, so the
+        published reference must own its buffers — the watchdog snapshot
+        discipline). ``version`` is the committed model version (rounds
+        folded so far). ``None`` detaches; detached (the default) the round
+        loop is byte-identical to a build without serving."""
+        self._publisher = publish_fn
+
+    def _publish_params(self, version: int) -> None:
+        if self._publisher is None:
+            return
+        t_pub = time.perf_counter()
+        self._publisher(int(version), jax.tree.map(jnp.copy, self.params))
+        self._phase_acc.append(("publish", time.perf_counter() - t_pub))
+
     def _span(self, name: str, value: Optional[str] = None):
         if self._profiler is not None:
             return self._profiler.span(name, event_value=value)
@@ -1693,6 +1724,11 @@ class FedSimulator:
         pipelined readback this is the honest per-round throughput number —
         the raw host dispatch time is kept as ``dispatch_time``."""
         t_dev = time.perf_counter()
+        if self.cfg.sync_device_phase:
+            # the metric vector is a few scalars — its readback can land
+            # before the round's params-producing executables retire, so
+            # bench runs block on the committed params too before stamping
+            jax.block_until_ready(self.params)  # graftcheck: disable=host-sync
         mvec = np.asarray(rec.pop("_mvec"))
         now = time.perf_counter()
         # the blocking readback IS the wait on device compute still in flight
@@ -1794,6 +1830,14 @@ class FedSimulator:
             self._phase_acc.append(
                 ("eval", time.perf_counter() - t_eval - t_inner))
         self.history.append(rec)
+        # commit→publish: version = rounds folded (resume-stable, monotone —
+        # a pending record always finalizes before the next one is created).
+        # With deferred readback this record may finalize after later rounds
+        # dispatched, so self.params may already be a NEWER commit than this
+        # version number; serving callers that need exact round↔version
+        # pairing run with frequency_of_the_test=1 (every record finalizes
+        # synchronously before the next dispatch).
+        self._publish_params(int(round_idx) + 1)
         if ckpt is not None and self._should_checkpoint(round_idx):
             from ..utils.checkpoint import save_simulator_state
 

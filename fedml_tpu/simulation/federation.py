@@ -57,7 +57,8 @@ from ..comm.resilience import LeaseTable, SendFailure
 from ..core import telemetry, trace_plane
 from ..cross_silo.hierarchical import HeartbeatSender, TierMsg
 from ..data.federated import FederatedData
-from ..utils.checkpoint import LeafShardStore, RoundStateStore, trim_version_log
+from ..utils.checkpoint import (DEFAULT_KEEP_VERSIONS, LeafShardStore,
+                                RoundStateStore, trim_version_log)
 from ..utils.seed import set_seeds
 from .fed_sim import SimConfig
 from .hierarchical import build_leaf_round, contiguous_group_split, fold_partials
@@ -84,7 +85,8 @@ class TierConfig:
     round_timeout_s: float = 30.0    # hard cap on one round's leaf_wait
     shard_dir: Optional[str] = None  # LeafShardStore root (shared disk)
     staleness_alpha: float = 0.5     # (1+s)^-alpha weight on stale partials
-    keep_versions: int = 32          # version-log retention (<=0 = unbounded)
+    keep_versions: int = DEFAULT_KEEP_VERSIONS  # version-log retention
+    #                                  (<=0 = unbounded)
     ckpt_path: Optional[str] = None  # root RoundStateStore path
 
     @classmethod
@@ -98,8 +100,8 @@ class TierConfig:
             round_timeout_s=float(getattr(args, "hier_round_timeout_s", 30.0)),
             shard_dir=getattr(args, "hier_shard_dir", None),
             staleness_alpha=float(getattr(args, "hier_staleness_alpha", 0.5)),
-            keep_versions=int(getattr(args, "round_store_keep_versions", 32)
-                              or 0),
+            keep_versions=int(getattr(args, "round_store_keep_versions",
+                                      DEFAULT_KEEP_VERSIONS) or 0),
             ckpt_path=getattr(args, "round_ckpt_path", None),
         )
 

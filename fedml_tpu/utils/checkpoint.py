@@ -83,6 +83,14 @@ def _atomic_write_blob(path: str, blob: bytes) -> None:
         os.close(fd)
 
 
+# Shared model-version-log retention default: the cross-silo server
+# manager, the tiered federation root, and the serving plane all bound
+# their version logs with this one constant — a drifted per-site literal
+# would let a resume and the serving reader disagree about which versions
+# are still retrievable at the trim boundary.
+DEFAULT_KEEP_VERSIONS = 32
+
+
 def trim_version_log(log, keep: int):
     """Retain the last ``keep`` model-version-log entries (``<= 0`` =
     unbounded). The log is append-only per commit, so without a bound a

@@ -24,8 +24,12 @@
 #   5. scan_smoke — the same loopback federation on the classic
 #      per-round engine vs rounds_per_dispatch=4; final parameters and
 #      history must be bitwise identical (the fused-lax.scan invariant).
+#   6. serve_smoke — the debug federation with the inference server
+#      attached: every round publishes + canary-promotes, live requests
+#      all serve with zero drops, a NaN publish rolls back and pins,
+#      and training params stay bitwise-equal to the serving-off run.
 #
-# Checks 1-3 are pure-AST / host-compile; checks 4-5 run JAX on CPU
+# Checks 1-3 are pure-AST / host-compile; checks 4-6 run JAX on CPU
 # (debug-small dataset, a few seconds each). No network or model
 # downloads are involved.
 set -u
@@ -56,6 +60,9 @@ JAX_PLATFORMS=cpu "$PY" scripts/tier_smoke.py || rc=1
 
 echo "== multi-round scan bit-identity smoke =="
 JAX_PLATFORMS=cpu "$PY" scripts/scan_smoke.py || rc=1
+
+echo "== serving-plane rollout smoke =="
+JAX_PLATFORMS=cpu "$PY" scripts/serve_smoke.py || rc=1
 
 if [ "$rc" -ne 0 ]; then
     echo "static checks FAILED (see above)" >&2

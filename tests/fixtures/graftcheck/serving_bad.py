@@ -1,0 +1,52 @@
+"""Fixture: serving-plane hazards (fed to the checkers under a
+``fedml_tpu/serving/`` relpath — see tests/test_static_analysis.py).
+A promote that publishes while holding both store locks, an AB/BA
+nesting between the swap and stats locks, and a serve-loop thread
+mutating the active pointer and served-counts with no common lock."""
+
+import threading
+import time
+
+
+class BadStore:
+    def __init__(self):
+        self._swap_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+        self._bus = None
+
+    def promote(self, version):
+        with self._swap_lock:
+            with self._stats_lock:
+                self._bus.publish(version)   # blocking publish under locks
+
+    def stats(self):
+        # opposite nesting order from promote() — the AB/BA deadlock
+        with self._stats_lock:
+            with self._swap_lock:
+                time.sleep(0.01)
+
+
+class BadServer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.active = None
+        self._served = {}
+
+    def start(self):
+        t = threading.Thread(target=self._serve_loop, daemon=True)
+        t.start()
+
+    def _serve_loop(self):
+        while True:
+            self.active = self._next_version()   # unlocked write in thread
+            self._served[self.active] = True
+
+    def current(self):
+        return self.active                       # unlocked read from main
+
+    def served_by_version(self):
+        with self._lock:                         # reader locks, writer doesn't
+            return dict(self._served)
+
+    def _next_version(self):
+        return 1

@@ -1,0 +1,58 @@
+"""Fixture: the serving-plane discipline the checkers enforce — one
+short lock around the RCU swap and counters, telemetry/publish side
+effects strictly after release, an Event-guarded run flag, and the
+serve thread taking the same lock as every reader."""
+
+import threading
+
+
+class CleanStore:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._active = None
+        self._swaps = 0
+
+    def promote(self, version, params):
+        with self._lock:
+            self._active = (version, params)     # RCU pointer swap
+            self._swaps += 1
+        self._emit(version)                      # side effects post-release
+
+    def active(self):
+        with self._lock:
+            return self._active
+
+    def stats(self):
+        with self._lock:
+            return {"swaps": self._swaps}
+
+    def _emit(self, version):
+        pass
+
+
+class CleanServer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._run = threading.Event()
+        self._served = {}
+
+    def start(self):
+        self._run.set()
+        t = threading.Thread(target=self._serve_loop, daemon=True)
+        t.start()
+
+    def _serve_loop(self):
+        while self._run.is_set():
+            version = self._pump()
+            with self._lock:
+                self._served[version] = self._served.get(version, 0) + 1
+
+    def stats(self):
+        with self._lock:
+            return dict(self._served)
+
+    def stop(self):
+        self._run.clear()
+
+    def _pump(self):
+        return 1

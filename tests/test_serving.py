@@ -1,0 +1,413 @@
+"""Canary-gated serving plane: versioned store lifecycle (publish /
+promote / rollback / pin), trim-boundary retention under reader leases,
+seeded canary verdicts, inline and worker-mode gating, the zero-drop
+hot-swap guarantee under mixed train/serve load, the poisoned-rollout
+drill, and the disabled-path byte-identity pin."""
+
+import time
+
+import numpy as np
+import pytest
+
+import fedml_tpu
+from fedml_tpu.core import telemetry
+from fedml_tpu.serving import (
+    CanaryConfig,
+    CanaryEvaluator,
+    InferenceServer,
+    ServeConfig,
+    VersionedModelStore,
+    build_inference_server,
+    held_out_batches,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.configure(enabled=True, reset=True)
+    yield
+    telemetry.configure(enabled=True, reset=True)
+
+
+def _counters():
+    return telemetry.get_registry().snapshot()["counters"]
+
+
+def _params(v: float, dim: int = 8, classes: int = 4):
+    rng = np.random.default_rng(7)
+    w = rng.normal(size=(dim, classes)).astype(np.float32)
+    return {"w": w * np.float32(v)}
+
+
+# ----------------------------------------------------------------- store
+
+
+def test_store_publish_promote_rollback_lifecycle():
+    store = VersionedModelStore(keep_versions=8)
+    # very first version has nothing to canary against: promoted on landing
+    assert store.publish(1, _params(1.0)) == "promoted"
+    assert store.active()[0] == 1
+    # later versions land as candidates; only promote() swaps the pointer
+    assert store.publish(2, _params(1.1)) == "candidate"
+    assert store.active()[0] == 1
+    assert store.candidate()[0] == 2
+    assert store.promote(2)
+    assert store.active()[0] == 2
+    # a rollback of the live version falls back to the newest promoted one
+    assert store.publish(3, _params(-1.0)) == "candidate"
+    assert store.promote(3)
+    assert store.rollback(3, reason="canary_regression") == 2
+    assert store.active()[0] == 2
+    assert store.stats()["last_good"] == 2
+    # the pin: a rolled-back version number is refused forever
+    assert store.publish(3, _params(1.0)) == "pinned"
+    # a decided (promoted) version cannot be re-published either
+    assert store.publish(2, _params(9.0)) == "duplicate"
+    assert store.versions()[3] == "rolled_back"
+
+
+def test_store_trim_boundary_reader_lease_resume():
+    # resume at the trim boundary while a reader holds the oldest retained
+    # version: the lease keeps the params alive past the window, the
+    # restarted log refuses duplicate commits, and nothing is dropped
+    store = VersionedModelStore(keep_versions=3)
+    store.publish(1, _params(1.0))
+    lease = store.acquire(1)  # reader pins v1 before it leaves the window
+    assert lease[0] == 1
+    for v in range(2, 6):
+        assert store.publish(v, _params(float(v))) == "candidate"
+        assert store.promote(v)
+    # window is {3,4,5}; v2 was freed, v1 survives only through the lease
+    assert store.get(2) is None
+    assert store.get(1) is not None
+    np.testing.assert_array_equal(store.get(1)["w"], lease[1]["w"])
+    assert store.active()[0] == 5
+
+    # restart from the durable state (log + verdicts, no params)
+    reborn = VersionedModelStore(keep_versions=3)
+    reborn.import_state(store.export_state())
+    # every decided version is refused on re-publish: no duplicate commit
+    for v in range(1, 6):
+        assert reborn.publish(v, _params(float(v))) == "duplicate"
+    # the next training commit lands normally — no drop in the sequence
+    assert reborn.publish(6, _params(6.0)) == "promoted"
+
+    # releasing the lease lets the original store finally free v1
+    store.release(1)
+    assert store.get(1) is None
+    assert store.active()[0] == 5  # the live version never trims
+
+
+def test_store_rollback_pin_survives_trim_and_restart():
+    store = VersionedModelStore(keep_versions=2)
+    store.publish(1, _params(1.0))
+    store.publish(2, _params(2.0))
+    store.rollback(2, reason="canary_regression")
+    before = _counters()
+    # push the log far past the poisoned version's retention window
+    for v in range(3, 12):
+        store.publish(v, _params(float(v)))
+        store.promote(v)
+    assert store.get(2) is None  # params long gone
+    assert store.publish(2, _params(2.0)) == "pinned"
+    reborn = VersionedModelStore(keep_versions=2)
+    reborn.import_state(store.export_state())
+    assert reborn.publish(2, _params(2.0)) == "pinned"
+    delta = (_counters().get(
+        "fedml_publish_refused_total{reason=pinned}", 0.0)
+        - before.get("fedml_publish_refused_total{reason=pinned}", 0.0))
+    assert delta == 2
+
+
+# ---------------------------------------------------------------- canary
+
+
+def _linear_batches(w, n=256, batches=3, batch_size=32, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, w.shape[0])).astype(np.float32)
+    y = np.argmax(x @ w, axis=1)
+    cfg = CanaryConfig(batches=batches, batch_size=batch_size, seed=seed)
+    return held_out_batches(x, y, cfg), cfg
+
+
+def test_held_out_batches_are_seed_deterministic():
+    w = _params(1.0)["w"]
+    a, _ = _linear_batches(w, seed=3)
+    b, _ = _linear_batches(w, seed=3)
+    c, _ = _linear_batches(w, seed=4)
+    for (ax, ay), (bx, by) in zip(a, b):
+        np.testing.assert_array_equal(ax, bx)
+        np.testing.assert_array_equal(ay, by)
+    assert not np.array_equal(a[0][0], c[0][0])
+
+
+def test_canary_verdict_gates():
+    w = _params(1.0)["w"]
+    batches, cfg = _linear_batches(w)
+
+    def predict(params, x):
+        return x @ params["w"]
+
+    ev = CanaryEvaluator(predict, batches, cfg)
+    base, finite = ev.score({"w": w})
+    assert finite and base == 1.0  # labels are the model's own argmax
+    # within-threshold candidate promotes; a regressed one does not
+    assert ev.verdict(base, base, True)
+    assert ev.verdict(base, base - cfg.regression_threshold / 2, True)
+    assert not ev.verdict(base, base - 2 * cfg.regression_threshold, True)
+    # non-finite is an instant fail no matter the accuracy
+    assert not ev.verdict(base, 1.0, False)
+    acc, finite = ev.score({"w": np.full_like(w, np.nan)})
+    assert not finite
+
+
+# ---------------------------------------------------------------- server
+
+
+def _server(frac=0.0, batches=3, **kw):
+    w = _params(1.0)["w"]
+    eval_batches, _ = _linear_batches(w, batches=batches)
+
+    def predict(params, x):
+        return x @ params["w"]
+
+    cfg = ServeConfig(enabled=True, batch_max=32,
+                      canary=CanaryConfig(fraction=frac, batches=batches,
+                                          batch_size=32))
+    return InferenceServer(predict, cfg, eval_batches=eval_batches, **kw), w
+
+
+def test_inline_canary_blocks_regression_and_nonfinite():
+    server, w = _server()
+    assert server.publish(1, {"w": w}) == "promoted"
+    # harmless drift promotes (hot-swap)
+    assert server.publish(2, {"w": w * np.float32(1.0001)}) == "promoted"
+    assert server.store.active()[0] == 2
+    # sign-flipped weights invert the argmax: canary regression, rollback
+    assert server.publish(3, {"w": -w}) == "rolled_back"
+    assert server.store.active()[0] == 2
+    # NaN params never reach the request path (pre-gate, not the canary)
+    assert server.publish(4, {"w": np.full_like(w, np.nan)}) == "rolled_back"
+    # both poisoned versions are pinned against re-publish, even clean
+    assert server.publish(3, {"w": w}) == "pinned"
+    assert server.publish(4, {"w": w}) == "pinned"
+    snap = _counters()
+    assert snap.get("fedml_versions_promoted_total", 0) == 1  # v2 swap
+    assert snap.get("fedml_rollbacks_served_total", 0) == 2
+    assert snap.get("fedml_publish_refused_total{reason=pinned}", 0) == 2
+
+
+def test_served_requests_ride_hot_swaps_with_zero_drops():
+    results = []
+    server, w = _server(
+        on_result=lambda rid, ver, out: results.append((rid, ver)))
+    server.publish(1, {"w": w})
+    rng = np.random.default_rng(0)
+    feats = rng.normal(size=(96, w.shape[0])).astype(np.float32)
+    for i in range(48):
+        assert server.submit(feats[i], request_id=i)
+    server.pump()
+    server.publish(2, {"w": w * np.float32(1.0001)})  # hot-swap mid-stream
+    for i in range(48, 96):
+        assert server.submit(feats[i], request_id=i)
+    server.pump()
+    st = server.stats()
+    assert st["served"] == 96 and st["dropped"] == 0 and st["pending"] == 0
+    # every request is attributed to the version that answered it, and
+    # both sides of the swap actually served traffic
+    assert sorted(r[0] for r in results) == list(range(96))
+    by_ver = st["served_by_version"]
+    assert by_ver.get(1, 0) > 0 and by_ver.get(2, 0) > 0
+    assert sum(by_ver.values()) == 96
+    assert _counters().get("fedml_inference_requests_total", 0) == 96
+
+
+def test_worker_mode_canary_decides_candidate_asynchronously():
+    server, w = _server(frac=0.5)
+    server.publish(1, {"w": w})
+    server.start()
+    try:
+        assert server.publish(2, {"w": w * np.float32(1.0001)}) == "candidate"
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if server.store.versions().get(2) == "promoted":
+                break
+            time.sleep(0.005)
+        assert server.store.versions().get(2) == "promoted"
+        assert server.store.active()[0] == 2
+        # a regressing candidate is rolled back by the same async window
+        assert server.publish(3, {"w": -w}) == "candidate"
+        while time.monotonic() < deadline:
+            if server.store.versions().get(3) == "rolled_back":
+                break
+            time.sleep(0.005)
+        assert server.store.versions().get(3) == "rolled_back"
+        assert server.store.active()[0] == 2
+    finally:
+        server.stop()
+
+
+def test_candidate_superseded_by_newer_publish():
+    server, w = _server(frac=0.0)
+    server.publish(1, {"w": w})
+    server.start()
+    try:
+        server.publish(2, {"w": w * np.float32(1.0001)})
+        # a newer commit lands before v2's canary window closes often
+        # enough on a busy trainer; the loser is retired, not rolled back
+        server.publish(3, {"w": w * np.float32(1.0002)})
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if server.store.versions().get(3) in ("promoted", "rolled_back"):
+                break
+            time.sleep(0.005)
+        assert server.store.versions().get(3) == "promoted"
+    finally:
+        server.stop()
+    vs = server.store.versions()
+    assert vs.get(2) in ("superseded", "promoted")
+    assert server.store.stats()["rollbacks"] == 0
+
+
+# ------------------------------------------------- simulator integration
+
+_SIM_BASE = dict(
+    dataset="mnist", model="lr", partition_method="hetero",
+    partition_alpha=0.5, debug_small_data=True,
+    client_num_in_total=6, client_num_per_round=4, comm_round=3,
+    learning_rate=0.1, epochs=1, batch_size=8,
+    frequency_of_the_test=1, random_seed=0, prefetch=False,
+)
+
+_TIMING_KEYS = {"round_time", "dispatch_time", "pack_time", "pack_wait",
+                "overlap", "phases", "scan_rounds"}
+
+
+def _run_sim(extra):
+    from fedml_tpu.simulation import build_simulator
+
+    args = fedml_tpu.init(config=dict(_SIM_BASE, **extra))
+    sim, apply_fn = build_simulator(args)
+    server = build_inference_server(args, sim, apply_fn)
+    hist = sim.run(apply_fn, log_fn=None)
+    return sim, server, hist
+
+
+def _flat_params(sim):
+    import jax
+
+    return np.concatenate([np.asarray(l, np.float64).ravel()
+                           for l in jax.tree.leaves(sim.params)])
+
+
+def test_build_inference_server_disabled_returns_none():
+    from fedml_tpu.simulation import build_simulator
+
+    args = fedml_tpu.init(config=dict(_SIM_BASE))
+    sim, apply_fn = build_simulator(args)
+    assert build_inference_server(args, sim, apply_fn) is None
+    assert sim._publisher is None
+
+
+def test_training_run_publishes_and_promotes_every_round():
+    sim, server, hist = _run_sim(dict(
+        serve_enabled=True, canary_batches=2, canary_batch_size=32))
+    rounds = _SIM_BASE["comm_round"]
+    stats = server.store.stats()
+    assert stats["active_version"] == rounds
+    assert all(server.store.versions()[v] == "promoted"
+               for v in range(1, rounds + 1))
+    # the publish hand-off is attributed to its own phase; attribution is
+    # by completion interval (see docs/observability.md), so round r's
+    # publish lands in the record closing at round r+1's stamp — every
+    # record after the first carries one
+    assert all(r["phases"].get("publish", 0.0) > 0.0 for r in hist[1:])
+    assert "publish" not in hist[0]["phases"]
+    # and the server answers from the final model
+    x = np.asarray(sim.fed.test_data_global.x[:8], np.float32)
+    for i in range(8):
+        assert server.submit(x[i])
+    server.pump()
+    assert server.stats()["served"] == 8
+
+
+def test_serving_disabled_is_byte_identical():
+    # serve_*/canary_* knobs present but disabled must not perturb one bit
+    # of the training trajectory vs a config that never mentions serving
+    sim_ref, server_ref, hist_ref = _run_sim({})
+    sim_off, server_off, hist_off = _run_sim(dict(
+        serve_enabled=False, canary_batches=2, canary_fraction=0.5,
+        serve_batch_max=16))
+    assert server_ref is None and server_off is None
+    np.testing.assert_array_equal(_flat_params(sim_ref), _flat_params(sim_off))
+    strip = lambda h: [{k: v for k, v in r.items() if k not in _TIMING_KEYS}
+                      for r in h]
+    assert strip(hist_ref) == strip(hist_off)
+    assert "publish" not in {k for r in hist_ref for k in r["phases"]}
+
+
+# ----------------------------------------------------- mixed-traffic load
+
+
+@pytest.mark.loadgen
+def test_mixed_loadgen_zero_drops_across_five_hot_swaps():
+    from fedml_tpu.cross_silo.loadgen import run_mixed_loadgen
+
+    report = run_mixed_loadgen(duration_s=1.0, infer_producers=2,
+                               checkin_producers=1, commit_interval_s=0.05,
+                               min_swaps=5, seed=0)
+    assert report.ok, report.summary()
+    # the acceptance floor: >=10k req/s served while training commits
+    # versions underneath, zero dropped requests across >=5 hot-swaps
+    assert report.served_rate >= 10_000.0, report.summary()
+    assert report.dropped == 0
+    assert report.swaps >= 5
+    assert report.train_processed > 0       # check-ins share the queue
+    assert report.canary_served > 0         # candidates saw live traffic
+    assert len(report.served_by_version) >= 5
+    rec = report.json_record()
+    assert rec["ok"] and rec["queue_depth_bounded"]
+
+
+@pytest.mark.loadgen
+def test_mixed_loadgen_from_args_maps_knobs():
+    from fedml_tpu.cross_silo.loadgen import run_mixed_loadgen_from_args
+
+    args = fedml_tpu.init(config=dict(
+        mixed_duration_s=0.2, mixed_infer_producers=1,
+        mixed_checkin_producers=1, mixed_min_swaps=1,
+        mixed_queue_maxsize=1024, mixed_seed=3))
+    report = run_mixed_loadgen_from_args(args)
+    assert report.queue_maxsize == 1024
+    assert report.min_swaps == 1
+    assert report.dropped == 0
+
+
+# -------------------------------------------------- poisoned-rollout drill
+
+
+@pytest.mark.chaos
+def test_rollout_drill_blocks_poison_and_serves_within_gate():
+    from fedml_tpu.cross_silo.chaos import run_rollout_drill
+
+    result = run_rollout_drill()
+    assert result.ok, result.summary()
+    assert result.poison_status == "rolled_back"
+    assert result.repub_status == "pinned"          # never re-promoted
+    assert result.rollbacks_counter >= 1            # counter moved too
+    assert result.served_acc_gap <= result.max_acc_delta
+    by_v = {t["version"]: t for t in result.trajectory}
+    assert by_v[result.poison_version]["status"] == "rolled_back"
+    # serving kept answering from last-good while the poison was refused
+    assert by_v[result.poison_version]["served_acc"] is not None
+    rec = result.json_record()
+    assert rec["ok"] and rec["poison_kind"] == "sign_flip"
+
+
+@pytest.mark.chaos
+def test_rollout_drill_nonfinite_kind():
+    from fedml_tpu.cross_silo.chaos import run_rollout_drill
+
+    result = run_rollout_drill(rollout_poison_kind="nan")
+    assert result.ok, result.summary()
+    assert result.poison_status == "rolled_back"

@@ -430,6 +430,46 @@ def test_federation_fixture_out_of_scope_by_default():
     assert _run_on_fixture(LockOrderChecker, "federation_bad.py") == []
 
 
+# --------------------------------------- serving plane (thread + locks)
+
+_SERVING = "fedml_tpu/serving/_graftcheck_fixture.py"
+
+
+def test_serving_scope_fires_on_bad_fixture():
+    # the serving package is in both checkers' scope: a serve-loop
+    # thread swapping the active pointer / served-counts the main
+    # thread reads unguarded must fire thread-hazard, and a promote
+    # that publishes under the store locks (plus AB/BA nesting with
+    # the stats path) must fire lock-order
+    hazards = _run_on_fixture(
+        ThreadHazardChecker, "serving_bad.py", relpath=_SERVING)
+    keys = {f.key for f in hazards}
+    assert "hazard:BadServer.active" in keys
+    assert "hazard:BadServer._served" in keys
+    locks = _run_on_fixture(
+        LockOrderChecker, "serving_bad.py", relpath=_SERVING)
+    msgs = "\n".join(f.message for f in locks)
+    assert ".publish()" in msgs
+    assert "lock acquisition cycle" in msgs
+    assert "time.sleep" in msgs
+
+
+def test_serving_scope_silent_on_clean_fixture():
+    # one short lock around the RCU swap, telemetry after release, the
+    # serve thread taking the same lock as readers, Event run flag:
+    # both checkers stay quiet, so the real package's discipline is
+    # the enforced shape
+    assert _run_on_fixture(
+        ThreadHazardChecker, "serving_clean.py", relpath=_SERVING) == []
+    assert _run_on_fixture(
+        LockOrderChecker, "serving_clean.py", relpath=_SERVING) == []
+
+
+def test_serving_fixture_out_of_scope_by_default():
+    assert _run_on_fixture(ThreadHazardChecker, "serving_bad.py") == []
+    assert _run_on_fixture(LockOrderChecker, "serving_bad.py") == []
+
+
 # ----------------------------------------------------------- suppression
 
 def _no_print_over(tmp_path, source):
