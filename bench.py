@@ -1255,6 +1255,74 @@ def loadgen_bench(duration_s: float = 2.0, seed: int = 0) -> int:
     return 0 if (report.ok and rate_ok and shed_visible) else 1
 
 
+def device_day_bench(seed: int = 0, budget_mb: float = 1536.0) -> int:
+    """``--device-day``: the cross-device fleet gate. One full simulated day
+    over a 1M-client registry on CPU: seeded diurnal arrivals through the
+    bounded admission edge, cohorts folded through the tier-plane fan-in,
+    per-device optimizer state tiered device->host->disk by the client-state
+    arena.
+
+    Gates: >= 50k offered check-ins/s of wall time at the admission edge
+    itself; peak-RSS growth under ``budget_mb`` (the arena's spill tier, not
+    RAM, absorbs the fleet's state); the disk tier actually engaged; closed
+    shed/drop accounting with zero ledger duplicates; and the whole day
+    byte-identical across two runs (history and params digests)."""
+    import dataclasses
+    import resource
+    import tempfile
+
+    from fedml_tpu.core import telemetry
+    from fedml_tpu.cross_device.device_day import (DeviceDayConfig,
+                                                   run_device_day)
+
+    telemetry.configure(enabled=True)
+    spill_root = tempfile.mkdtemp(prefix="device_day_bench_")
+    cfg = DeviceDayConfig(
+        registry_size=1_000_000, day_s=86_400.0, tick_s=300.0,
+        num_classes=4, cohort=128, queue_maxsize=8192, peak_rate=6.0,
+        max_commits_per_tick=1, arena_capacity=2048, host_capacity=16384,
+        spill_dir=os.path.join(spill_root, "run1"), seed=seed)
+    os.makedirs(cfg.spill_dir, exist_ok=True)
+    rss_before_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    r1 = run_device_day(cfg)
+    rss_after_kb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss
+    rss_delta_mb = max(0.0, (rss_after_kb - rss_before_kb) / 1024.0)
+    spill_files = len(os.listdir(cfg.spill_dir))
+    cfg2 = dataclasses.replace(
+        cfg, spill_dir=os.path.join(spill_root, "run2"))
+    os.makedirs(cfg2.spill_dir, exist_ok=True)
+    r2 = run_device_day(cfg2)
+
+    pass_rate = r1.offered_per_s >= 50_000.0
+    pass_rss = rss_delta_mb <= float(budget_mb)
+    pass_spill = (spill_files > 0
+                  and r1.arena_resident <= cfg.arena_capacity)
+    pass_deterministic = (r1.history_digest == r2.history_digest
+                          and r1.params_digest == r2.params_digest)
+    line = {
+        "metric": "device_day",
+        "unit": ("one simulated 86400s day over a 1,000,000-device registry "
+                 f"(288 ticks, seeded diurnal arrivals, seed={seed}), "
+                 "bounded admission edge + DRR, cohort=128 tier-plane "
+                 "fan-in, arena spill device->host->disk, CPU"),
+        **r1.json_record(),
+        "rss_delta_mb": round(rss_delta_mb, 1),
+        "rss_budget_mb": float(budget_mb),
+        "spill_files": spill_files,
+        "pass_50k_per_sec_at_edge": bool(pass_rate),
+        "pass_rss_budget": bool(pass_rss),
+        "pass_spill_engaged": bool(pass_spill),
+        "pass_deterministic_day": bool(pass_deterministic),
+    }
+    print(json.dumps(line), flush=True)
+    print(r1.summary(), file=sys.stderr, flush=True)
+    print(f"rss delta {rss_delta_mb:.0f}MB (budget {budget_mb:.0f}MB), "
+          f"{spill_files} spill files, deterministic="
+          f"{pass_deterministic}", file=sys.stderr, flush=True)
+    return 0 if (r1.ok and r2.ok and pass_rate and pass_rss and pass_spill
+                 and pass_deterministic) else 1
+
+
 def serve_bench(rounds: int = 30, producers: int = 2,
                 target_rate: float = 40_000.0, seed: int = 0) -> int:
     """``--serve``: the train/serve overlap gate. A real simulator trains
@@ -1459,6 +1527,11 @@ if __name__ == "__main__":
         # check-in overload drill — host threads + codec only, no chip
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
         sys.exit(loadgen_bench())
+    if "--device-day" in sys.argv:
+        # cross-device fleet day — registry + admission edge + arena spill
+        # are all host-side; the fold math runs on the CPU backend
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        sys.exit(device_day_bench())
     if "--serve" in sys.argv:
         # train/serve overlap gate — CPU simulator + host serving threads
         os.environ.setdefault("JAX_PLATFORMS", "cpu")

@@ -34,8 +34,9 @@ from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from .core import SEVERITY_WARNING, Checker, Finding, Module, dotted_name
 
-SCOPE_PREFIXES = ("fedml_tpu/comm/", "fedml_tpu/cross_silo/",
-                  "fedml_tpu/parallel/", "fedml_tpu/serving/")
+SCOPE_PREFIXES = ("fedml_tpu/comm/", "fedml_tpu/cross_device/",
+                  "fedml_tpu/cross_silo/", "fedml_tpu/parallel/",
+                  "fedml_tpu/serving/")
 SCOPE_FILES = (
     "fedml_tpu/core/telemetry.py",
     "fedml_tpu/core/mlops.py",

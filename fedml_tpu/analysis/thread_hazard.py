@@ -47,7 +47,8 @@ from .core import Checker, Finding, Module, dotted_name
 from .jit_purity import _collect_functions, _is_ancestor, _walk_own_body
 from .lock_order import LOCK_FACTORIES
 
-SCOPE_PREFIXES = ("fedml_tpu/comm/", "fedml_tpu/serving/")
+SCOPE_PREFIXES = ("fedml_tpu/comm/", "fedml_tpu/cross_device/",
+                  "fedml_tpu/serving/")
 SCOPE_FILES = (
     "fedml_tpu/core/telemetry.py",
     "fedml_tpu/core/trace_plane.py",

@@ -310,6 +310,17 @@ def run(config_file, backend, flight_record):
               help="Hierarchical drill variant: cut root<->leaf for one "
                    "round window, verify the cut heals and the same "
                    "exactly-once + accuracy gates hold.")
+@click.option("--device-churn", "device_churn", is_flag=True,
+              help="Run the cross-device fleet drill instead: a simulated "
+                   "device day with 30% fleet churn (dropout + rejoin waves, "
+                   "permanent departures, one partition window), gated on "
+                   "accuracy within --max-acc-delta of the churn-free "
+                   "reference, closed shed/drop accounting, and a "
+                   "bit-identical replay.")
+@click.option("--spill-dir", default=None, type=click.Path(),
+              help="Device-churn drill: directory for the client-state "
+                   "arena's disk tier (departures reclaim their spill "
+                   "files there). Default: a temp dir.")
 @click.option("--rollout", is_flag=True,
               help="Run the poisoned-rollout drill instead: corrupt one "
                    "published model version (--byzantine sign_flip/nan/"
@@ -329,8 +340,8 @@ def chaos_drill(seed, rounds, clients, drop_rate, duplicate_rate,
                 fail_send_rate, crash_rank, crash_at_round, byzantine_kind,
                 byzantine_rate, byzantine_scale, defend, codec, timeout,
                 tenant, flight_record, flight_dir, as_json, straggler,
-                tier_scenario, rollout, skew, buffer_size, min_goodput_ratio,
-                max_acc_delta):
+                tier_scenario, device_churn, spill_dir, rollout, skew,
+                buffer_size, min_goodput_ratio, max_acc_delta):
     """Stand up a full cross-silo deployment (server + clients, real codec,
     real round FSM) under the given fault plan and verify every round still
     closes. Exits 1 if the run hangs or loses rounds — the same check
@@ -343,6 +354,20 @@ def chaos_drill(seed, rounds, clients, drop_rate, duplicate_rate,
         result = run_tier_drill(
             scenario=tier_scenario, max_acc_delta=max_acc_delta,
             random_seed=seed, comm_round=rounds)
+        click.echo(json.dumps(result.json_record()) if as_json
+                   else result.summary())
+        if not result.ok:
+            raise SystemExit(1)
+        return
+
+    if device_churn:
+        import tempfile
+
+        from ..cross_device.device_day import run_device_churn_drill
+
+        result = run_device_churn_drill(
+            max_acc_delta=max_acc_delta,
+            spill_dir=spill_dir or tempfile.mkdtemp(prefix="device_day_"))
         click.echo(json.dumps(result.json_record()) if as_json
                    else result.summary())
         if not result.ok:
@@ -630,6 +655,19 @@ def telemetry_summary(jsonl_path, tenant):
             click.echo("counters:")
             for key in sorted(counters):
                 click.echo(f"  {key} = {counters[key]:g}")
+        shed_rows = [(k.split("reason=", 1)[-1].rstrip("}"), v)
+                     for k, v in counters.items()
+                     if k.startswith("fedml_shed_total{")
+                     and "reason=" in k]
+        if shed_rows:
+            by_reason: dict = {}
+            for reason, v in shed_rows:
+                reason = reason.split(",", 1)[0]
+                by_reason[reason] = by_reason.get(reason, 0.0) + v
+            total = sum(by_reason.values()) or 1.0
+            click.echo("shed breakdown (by reason):")
+            for reason, v in sorted(by_reason.items(), key=lambda kv: -kv[1]):
+                click.echo(f"  {reason:<16}{v:>12g}{v / total:>9.1%}")
         hists = snapshot.get("histograms", {})
         phase_rows = []
         if hists:

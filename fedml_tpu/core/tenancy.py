@@ -411,18 +411,27 @@ class DeficitRoundRobinScheduler:
 
 # --- overload: bounded check-in queue ---------------------------------------
 
+# shed reasons: a full queue is backpressure working as designed; an
+# inadmissible check-in (a departed/unknown device announcing itself) is a
+# registry decision. Operators need the split — `fedml_shed_total{reason=}`
+# carries it, and `fedml-tpu telemetry summary` breaks it out.
+SHED_QUEUE_FULL = "queue_full"
+SHED_INADMISSIBLE = "inadmissible"
+
 
 class CheckinQueue:
     """Bounded device check-in queue with load shedding.
 
     ``offer`` is the ingress edge the load generator (and a real gateway)
-    hammers: it either enqueues and returns True, or — queue full — sheds
-    the check-in, counts it per tenant
-    (``fedml_checkins_shed_total{tenant=...}``), and returns False, so
-    overload produces bounded memory and a visible counter instead of an
-    unbounded backlog. ``poll`` is the drain side (the admission/round
-    plane). The depth gauge is updated on both edges; its high-water mark
-    is tracked so a drill can assert the bound held.
+    hammers: it either enqueues and returns True, or sheds the check-in,
+    counts it per tenant (``fedml_checkins_shed_total{tenant=...}``) and per
+    reason (``fedml_shed_total{reason=queue_full|inadmissible}``), and
+    returns False — so overload produces bounded memory and a visible
+    counter instead of an unbounded backlog. ``offer_many`` is the batched
+    edge for arrival waves (one lock acquisition per wave). ``poll`` is the
+    drain side (the admission/round plane). The depth gauge is updated on
+    both edges; its high-water mark is tracked so a drill can assert the
+    bound held.
 
     The serving plane (``fedml_tpu.serving``) rides this same edge:
     inference requests and training check-in frames can share one queue,
@@ -439,34 +448,93 @@ class CheckinQueue:
         self._offered = 0
         self._accepted = 0
         self._shed = 0
+        self._shed_full = 0
+        self._shed_inadmissible = 0
         self._max_depth = 0
 
-    def offer(self, item: Any, tenant: Optional[str] = None) -> bool:
+    def _record_sheds(self, tenant: Optional[str], depth: int,
+                      accepted: int, shed_full: int,
+                      shed_inadmissible: int) -> None:
+        """Metric writes for one offer batch — called OUTSIDE the queue
+        lock (the registry has its own lock and lock-order discipline
+        forbids nesting the two)."""
         reg = telemetry.get_registry()
+        if not reg.enabled:
+            return
+        labels = {} if tenant is None else {"tenant": str(tenant)}
+        if accepted:
+            reg.counter("fedml_checkins_accepted_total",
+                        **labels).inc(accepted)
+        for reason, n in ((SHED_QUEUE_FULL, shed_full),
+                          (SHED_INADMISSIBLE, shed_inadmissible)):
+            if not n:
+                continue
+            reg.counter("fedml_checkins_shed_total", **labels).inc(n)
+            reg.counter("fedml_shed_total", reason=reason, **labels).inc(n)
+            if trace_plane.active():
+                trace_plane.record_instant(
+                    "shed", attrs={"tenant": tenant, "reason": reason,
+                                   "count": n, "depth": depth})
+        reg.gauge("fedml_checkin_queue_depth").set(depth)
+
+    def offer(self, item: Any, tenant: Optional[str] = None,
+              admissible: bool = True) -> bool:
+        """Offer one check-in. ``admissible=False`` sheds it up front with
+        reason ``inadmissible`` (the caller's registry refused the device);
+        a full queue sheds with reason ``queue_full``."""
         with self._lock:
             self._offered += 1
-            if len(self._items) >= self.maxsize:
+            if not admissible:
                 self._shed += 1
-                shed, depth = self._shed, len(self._items)
+                self._shed_inadmissible += 1
+                shed_full, shed_inad, depth = 0, 1, len(self._items)
+            elif len(self._items) >= self.maxsize:
+                self._shed += 1
+                self._shed_full += 1
+                shed_full, shed_inad, depth = 1, 0, len(self._items)
             else:
                 self._items.append(item)
                 self._accepted += 1
-                shed, depth = None, len(self._items)
+                shed_full, shed_inad, depth = 0, 0, len(self._items)
                 if depth > self._max_depth:
                     self._max_depth = depth
-        # metric writes happen outside the queue lock: the registry has its
-        # own lock and lock-order discipline forbids nesting the two
-        if reg.enabled:
-            labels = {} if tenant is None else {"tenant": str(tenant)}
-            if shed is not None:
-                reg.counter("fedml_checkins_shed_total", **labels).inc()
-                if trace_plane.active():
-                    trace_plane.record_instant(
-                        "shed", attrs={"tenant": tenant, "shed_total": shed})
-            else:
-                reg.counter("fedml_checkins_accepted_total", **labels).inc()
-            reg.gauge("fedml_checkin_queue_depth").set(depth)
-        return shed is None
+        accepted = 1 - shed_full - shed_inad
+        self._record_sheds(tenant, depth, accepted, shed_full, shed_inad)
+        return accepted == 1
+
+    def offer_many(self, items: Sequence[Any], tenant: Optional[str] = None,
+                   admissible: Optional[Sequence[bool]] = None
+                   ) -> Dict[str, int]:
+        """Batched admission edge: offer one arrival wave under a single
+        lock acquisition (the per-offer lock/metric round-trip dominates at
+        cross-device rates). ``admissible`` (aligned to ``items``) marks
+        check-ins the caller's registry already refused — they shed with
+        reason ``inadmissible`` without consuming queue room. Returns the
+        wave's accounting: accepted / shed_queue_full / shed_inadmissible.
+        """
+        accepted = shed_full = shed_inad = 0
+        with self._lock:
+            self._offered += len(items)
+            room = self.maxsize - len(self._items)
+            for i, item in enumerate(items):
+                if admissible is not None and not admissible[i]:
+                    shed_inad += 1
+                elif room > 0:
+                    self._items.append(item)
+                    room -= 1
+                    accepted += 1
+                else:
+                    shed_full += 1
+            self._accepted += accepted
+            self._shed += shed_full + shed_inad
+            self._shed_full += shed_full
+            self._shed_inadmissible += shed_inad
+            depth = len(self._items)
+            if depth > self._max_depth:
+                self._max_depth = depth
+        self._record_sheds(tenant, depth, accepted, shed_full, shed_inad)
+        return {"accepted": accepted, "shed_queue_full": shed_full,
+                "shed_inadmissible": shed_inad}
 
     def poll(self) -> Optional[Any]:
         reg = telemetry.get_registry()
@@ -487,6 +555,8 @@ class CheckinQueue:
                 "offered": self._offered,
                 "accepted": self._accepted,
                 "shed": self._shed,
+                "shed_queue_full": self._shed_full,
+                "shed_inadmissible": self._shed_inadmissible,
                 "depth": len(self._items),
                 "max_depth": self._max_depth,
                 "maxsize": self.maxsize,

@@ -5,6 +5,16 @@ training is external in the reference too (Android/iOS SDK); this package is
 the server plane: blob codec, FedAvg aggregator, LightSecAgg variant.
 """
 
+from .device_day import (
+    DEVICE_DAY_DEFAULTS,
+    DeviceChurnDrillResult,
+    DeviceDayConfig,
+    DeviceDayResult,
+    run_device_churn_drill,
+    run_device_day,
+    run_device_day_from_args,
+)
+from .registry import DeviceRegistry
 from .server import (
     FedMLCrossDeviceAggregator,
     ServerMNN,
@@ -17,4 +27,7 @@ __all__ = [
     "FedMLCrossDeviceAggregator", "ServerMNN",
     "encode_model_blob", "decode_model_blob",
     "LSAAggregator",
+    "DeviceRegistry", "DeviceDayConfig", "DeviceDayResult",
+    "DeviceChurnDrillResult", "DEVICE_DAY_DEFAULTS",
+    "run_device_day", "run_device_day_from_args", "run_device_churn_drill",
 ]

@@ -1,0 +1,181 @@
+"""Fleet-scale device registry: seeded availability + lifecycle for 1M clients.
+
+Cross-device FL (PAPER.md's Beehive line) starts from a registry of
+*devices*, not a list of silo ranks: millions of phones, each with its own
+availability window (charging, idle, on wifi — the Google FL eligibility
+criteria), each moving through a lifecycle per check-in::
+
+    ELIGIBLE -> CHECKED_IN -> TRAINING -> (uploaded -> ELIGIBLE | DROPPED)
+
+plus two churn transitions: DROPPED devices *rejoin* (back to ELIGIBLE,
+possibly needing a model resync), and some depart permanently (DEPARTED —
+the point where their spilled client state must be reclaimed, see
+:meth:`fedml_tpu.simulation.client_store.ClientStateArena.discard`).
+
+Everything here is vectorized numpy over the full fleet — a 1M-device
+registry is ~15 MB of flat arrays, so "millions of users" fits tier-1 CPU
+runs (FedJAX, PAPERS.md, makes the same bet). All randomness is drawn from
+``np.random.default_rng([seed, ...])`` streams keyed by purpose, so a
+simulated day replays bit-identically from the seed. Availability-aware
+cohorting (only currently-awake devices are candidates) follows Parrot's
+treatment of device heterogeneity as a scheduling input (PAPERS.md).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import numpy as np
+
+# lifecycle states (int8 array values)
+ELIGIBLE = 0
+CHECKED_IN = 1
+TRAINING = 2
+DROPPED = 3
+DEPARTED = 4
+
+STATE_NAMES = ("eligible", "checked_in", "training", "dropped", "departed")
+
+
+class DeviceRegistry:
+    """Flat-array registry of ``size`` devices with seeded availability.
+
+    - ``state``: lifecycle per device (``ELIGIBLE`` .. ``DEPARTED``).
+    - availability: each device is awake for one seeded window per day
+      (``awake_start`` offset, ``awake_len`` duration); :meth:`available`
+      is a vectorized mask over the whole fleet.
+    - ``device_class``: ``device_id % num_classes`` — the tenant key the
+      admission edge's deficit-round-robin fairness runs over (a stand-in
+      for device cohorts like hardware tier or geo).
+    - ``last_version``: the model version a device last synced, consulted
+      on rejoin to decide full vs incremental resync against the trimmed
+      version log (the elastic-membership contract, PR 14).
+    - ``held``: churn-wave hold — a held DROPPED device does not auto-
+      recover; only an explicit rejoin wave releases it.
+    """
+
+    def __init__(self, size: int, *, num_classes: int = 4, seed: int = 0,
+                 day_s: float = 86_400.0):
+        if size <= 0:
+            raise ValueError(f"registry size must be positive, got {size}")
+        if num_classes <= 0:
+            raise ValueError(f"num_classes must be positive, got {num_classes}")
+        self.size = int(size)
+        self.num_classes = int(num_classes)
+        self.seed = int(seed)
+        self.day_s = float(day_s)
+        rng = np.random.default_rng([self.seed, 0x_DE5C])
+        self.state = np.zeros(self.size, dtype=np.int8)
+        self.awake_start = rng.uniform(
+            0.0, self.day_s, size=self.size).astype(np.float32)
+        self.awake_len = (rng.uniform(0.3, 0.9, size=self.size)
+                          * self.day_s).astype(np.float32)
+        self.last_version = np.zeros(self.size, dtype=np.int32)
+        self.held = np.zeros(self.size, dtype=bool)
+        self.counters: Dict[str, int] = {
+            "checkins": 0, "uploads": 0, "dropouts": 0, "rejoins": 0,
+            "departures": 0, "resync_full": 0, "resync_incremental": 0,
+        }
+
+    # ------------------------------------------------------- availability
+
+    def available(self, t_s: float) -> np.ndarray:
+        """Boolean mask: device is inside its awake window at time ``t_s``
+        (wrapping across midnight) — independent of lifecycle state."""
+        phase = (float(t_s) - self.awake_start) % self.day_s
+        return phase < self.awake_len
+
+    def eligible_available(self, t_s: float) -> np.ndarray:
+        """Device ids that may check in at ``t_s``: awake AND eligible."""
+        return np.flatnonzero(self.available(t_s)
+                              & (self.state == ELIGIBLE))
+
+    def device_class(self, ids) -> np.ndarray:
+        return np.asarray(ids, dtype=np.int64) % self.num_classes
+
+    def admissible(self, ids) -> np.ndarray:
+        """Per-device admission verdict for an arrival wave: a device that
+        dropped, departed, or already checked in since it decided to
+        announce itself is refused (shed reason ``inadmissible``)."""
+        return self.state[np.asarray(ids, dtype=np.int64)] == ELIGIBLE
+
+    # ---------------------------------------------------------- lifecycle
+
+    def mark_checked_in(self, ids) -> None:
+        ids = np.asarray(ids, dtype=np.int64)
+        self.state[ids] = CHECKED_IN
+        self.counters["checkins"] += int(ids.size)
+
+    def mark_training(self, ids) -> None:
+        self.state[np.asarray(ids, dtype=np.int64)] = TRAINING
+
+    def mark_uploaded(self, ids, version: int) -> None:
+        ids = np.asarray(ids, dtype=np.int64)
+        self.state[ids] = ELIGIBLE
+        self.last_version[ids] = int(version)
+        self.counters["uploads"] += int(ids.size)
+
+    def release(self, ids) -> None:
+        """Checked-in devices the round plane did not select this tick go
+        back to ELIGIBLE (told to come back later) — not a dropout."""
+        self.state[np.asarray(ids, dtype=np.int64)] = ELIGIBLE
+
+    def mark_dropped(self, ids, *, held: bool = False) -> int:
+        """Drop devices (mid-round failure or churn wave). Already-departed
+        devices are unaffected. Returns how many actually transitioned."""
+        ids = np.asarray(ids, dtype=np.int64)
+        ids = ids[self.state[ids] != DEPARTED]
+        self.state[ids] = DROPPED
+        if held:
+            self.held[ids] = True
+        self.counters["dropouts"] += int(ids.size)
+        return int(ids.size)
+
+    def rejoin(self, ids, *, log_floor_version: int) -> Dict[str, int]:
+        """Bring DROPPED devices back to ELIGIBLE. Each rejoiner resyncs:
+        devices whose ``last_version`` predates the retained version log
+        (``< log_floor_version``) need a *full* model resync, the rest an
+        incremental one — mirroring the tier plane's elastic re-adoption
+        against ``trim_version_log`` retention."""
+        ids = np.asarray(ids, dtype=np.int64)
+        ids = ids[self.state[ids] == DROPPED]
+        full = int(np.sum(self.last_version[ids] < int(log_floor_version)))
+        self.state[ids] = ELIGIBLE
+        self.held[ids] = False
+        self.counters["rejoins"] += int(ids.size)
+        self.counters["resync_full"] += full
+        self.counters["resync_incremental"] += int(ids.size) - full
+        return {"rejoined": int(ids.size), "resync_full": full,
+                "resync_incremental": int(ids.size) - full}
+
+    def depart(self, ids) -> np.ndarray:
+        """Permanent departures. Returns the ids that actually departed
+        (for arena spill reclamation)."""
+        ids = np.asarray(ids, dtype=np.int64)
+        ids = ids[self.state[ids] != DEPARTED]
+        self.state[ids] = DEPARTED
+        self.held[ids] = False
+        self.counters["departures"] += int(ids.size)
+        return ids
+
+    def recover(self, rate: float, rng) -> int:
+        """Natural per-tick recovery: each non-held DROPPED device comes
+        back to ELIGIBLE with probability ``rate`` (seeded by the caller's
+        per-tick generator). Churn-held devices wait for their wave."""
+        cand = np.flatnonzero((self.state == DROPPED) & ~self.held)
+        if cand.size == 0 or rate <= 0:
+            return 0
+        back = cand[rng.random(cand.size) < float(rate)]
+        self.state[back] = ELIGIBLE
+        return int(back.size)
+
+    # ----------------------------------------------------------- readouts
+
+    def state_counts(self) -> Dict[str, int]:
+        counts = np.bincount(self.state, minlength=len(STATE_NAMES))
+        return {name: int(counts[i]) for i, name in enumerate(STATE_NAMES)}
+
+    def summary(self) -> Dict[str, int]:
+        out = dict(self.counters)
+        out.update(self.state_counts())
+        return out

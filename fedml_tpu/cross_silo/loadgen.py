@@ -60,6 +60,68 @@ LOADGEN_DEFAULTS = dict(
 )
 
 
+# --- diurnal arrival curve ---------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class DiurnalCurve:
+    """Seeded diurnal arrival-rate curve: check-ins/s as a function of
+    simulated time-of-day.
+
+    Real cross-device fleets check in on a day/night cycle — devices charge
+    and idle on wifi in the local evening (the FL eligibility window), so
+    offered load swings several-fold between the overnight peak and the
+    midday trough. The curve is a raised cosine between
+    ``peak_rate * trough_fraction`` and ``peak_rate``, peaking at
+    ``peak_hour``, plus a few small seeded harmonics so two seeds give two
+    distinct (but individually reproducible) days. Everything is a pure
+    function of ``(seed, t)``: the cross-device day driver replays
+    bit-identically from it, and drills can dial overload by raising
+    ``peak_rate`` past the admission edge's drain rate.
+    """
+
+    peak_rate: float
+    trough_fraction: float = 0.2
+    day_s: float = 86_400.0
+    peak_hour: float = 20.0
+    jitter: float = 0.05
+    seed: int = 0
+
+    def _harmonics(self):
+        # three seeded overtones (amplitude, frequency multiple, phase) —
+        # drawn once per curve, so rate(t) stays pure in (seed, t)
+        rng = np.random.default_rng([int(self.seed), 0x_D1A2])
+        amps = rng.uniform(0.2, 1.0, size=3) * float(self.jitter)
+        freqs = rng.integers(2, 7, size=3)
+        phases = rng.uniform(0.0, 2 * np.pi, size=3)
+        return amps, freqs, phases
+
+    def rate(self, t_s) -> np.ndarray:
+        """Arrival rate (check-ins/s) at simulated time ``t_s``; accepts a
+        scalar or an array and is vectorized over it."""
+        t = np.asarray(t_s, dtype=np.float64)
+        phase = 2 * np.pi * (t / self.day_s - self.peak_hour / 24.0)
+        base = 0.5 * (1.0 + np.cos(phase))          # 1 at peak, 0 at trough
+        shape = self.trough_fraction + (1.0 - self.trough_fraction) * base
+        amps, freqs, phases = self._harmonics()
+        wobble = sum(a * np.sin(2 * np.pi * f * t / self.day_s + p)
+                     for a, f, p in zip(amps, freqs, phases))
+        return np.maximum(0.0, float(self.peak_rate) * (shape + wobble))
+
+    def expected_arrivals(self, t0_s: float, t1_s: float) -> float:
+        """Expected check-ins in ``[t0_s, t1_s)`` (trapezoid over the
+        endpoints — exact enough for tick-scale windows)."""
+        r0, r1 = self.rate([t0_s, t1_s])
+        return 0.5 * float(r0 + r1) * max(0.0, float(t1_s) - float(t0_s))
+
+    def arrivals(self, t0_s: float, t1_s: float, rng) -> int:
+        """Seeded Poisson draw of the arrival count for one tick window.
+        The caller owns the generator (e.g. ``default_rng([seed, tick])``)
+        so replays are bit-identical."""
+        lam = self.expected_arrivals(t0_s, t1_s)
+        return int(rng.poisson(lam)) if lam > 0 else 0
+
+
 @dataclasses.dataclass
 class LoadGenReport:
     elapsed_s: float

@@ -79,6 +79,47 @@ def sync_virtual_seconds(plan: Optional[ClientDelayPlan], base_s: float,
     return total
 
 
+class VirtualEventHeap:
+    """Min-heap of ``(virtual_time, payload)`` arrival events.
+
+    The async engine's event loop and the cross-device day driver
+    (:mod:`fedml_tpu.cross_device.device_day`) share this structure: both
+    advance a virtual clock to the earliest outstanding arrival and consume
+    every event tied at that instant as one admission batch. Payloads tied
+    at the same virtual time pop in push order (a monotonic sequence breaks
+    ties), so the drain order is deterministic even for non-comparable
+    payloads.
+    """
+
+    __slots__ = ("_heap", "_seq")
+
+    def __init__(self):
+        self._heap: List = []
+        self._seq = 0
+
+    def push(self, vt: float, payload: Any) -> None:
+        heapq.heappush(self._heap, (vt, self._seq, payload))
+        self._seq += 1
+
+    def peek_vt(self) -> float:
+        return self._heap[0][0]
+
+    def pop_batch(self) -> "tuple[float, List[Any]]":
+        """Pop every event tied at the earliest virtual time. Returns
+        ``(vt, payloads)``; raises IndexError when empty."""
+        vt0 = self._heap[0][0]
+        batch: List[Any] = []
+        while self._heap and self._heap[0][0] == vt0:
+            batch.append(heapq.heappop(self._heap)[2])
+        return vt0, batch
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def __bool__(self) -> bool:
+        return bool(self._heap)
+
+
 class _GenEntry:
     """One generation's device-resident training outputs awaiting folds:
     the stacked update, per-client fold weights, the base model version the
@@ -158,7 +199,7 @@ class AsyncFedSimulator(FedSimulator):
         self._committed = 0          # committed updates so far
         self._vt = 0.0               # virtual clock (free-running makespan)
         self._clock: Dict[int, float] = {}  # per-client completion clocks
-        self._events: List = []      # heap of (arrival_vt, pos) per gen
+        self._events = VirtualEventHeap()  # (arrival_vt, pos) per gen
         self._buffer: List = []      # fold refs: (gen, pos, staleness)
         self._gens: Dict[int, _GenEntry] = {}
         self._shed_updates = 0
@@ -554,7 +595,7 @@ class AsyncFedSimulator(FedSimulator):
         for pos, c in enumerate(int(x) for x in ids):
             arrival = self._clock.get(c, 0.0) + self._delay(c, gen)
             self._clock[c] = arrival
-            heapq.heappush(self._events, (arrival, pos))
+            self._events.push(arrival, pos)
 
     def _drain_events(self, gen: int, apply_fn, ckpt, log_fn) -> None:
         """Consume every arrival of this generation in virtual-time order.
@@ -565,13 +606,10 @@ class AsyncFedSimulator(FedSimulator):
         entry = self._gens[gen]
         ids = entry.ids
         while self._events:
-            vt0, _ = self._events[0]
-            batch = []
-            while self._events and self._events[0][0] == vt0:
-                batch.append(heapq.heappop(self._events))
+            vt0, batch = self._events.pop_batch()
             self._vt = max(self._vt, vt0)
             by_tenant: Dict[str, List[int]] = {}
-            for _, pos in batch:
+            for pos in batch:
                 tenant = str(int(ids[pos]))
                 if not self._checkin.offer((gen, pos), tenant=tenant):
                     # shed at the admission edge = a lost (never-committed)

@@ -295,6 +295,33 @@ class ClientStateArena:
     def spilled_count(self) -> int:
         return len(self._spilled) + len(self._on_disk)
 
+    def discard(self, client_ids: Sequence[int]) -> int:
+        """Permanently forget clients across all three tiers.
+
+        A device that departs the fleet for good (cross-device churn) must
+        not keep a slot, a host row, or — the part that actually leaks over
+        a simulated day — a ``client_{cid}.msgpack`` spill file on disk.
+        ``_fetch_spilled`` deliberately leaves stale files in place when a
+        client is merely *read back* (inert: ``_on_disk`` membership is the
+        source of truth), so departure is the point where files are
+        reclaimed; stale inert files for the departing client are removed
+        too. Returns the number of spill files deleted.
+        """
+        reclaimed = 0
+        for cid in sorted({int(c) for c in client_ids}):
+            slot = self._slot_of.pop(cid, None)
+            if slot is not None:
+                self._slot_client[slot] = -1
+            self._spilled.pop(cid, None)
+            self._on_disk.discard(cid)
+            if self._spill_dir is not None:
+                try:
+                    os.remove(self._disk_path(cid))
+                    reclaimed += 1
+                except FileNotFoundError:
+                    pass
+        return reclaimed
+
     # ------------------------------------------- watchdog snapshot/restore
 
     def snapshot(self):

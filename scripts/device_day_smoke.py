@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Fast cross-device fleet smoke for the static-check gate.
+
+Runs a 10k-device registry through a 2-minute simulated day with the full
+churn drill (30% fleet dropout + rejoin waves, a permanent-departure
+subset, one partition window) and fails unless:
+
+- the churn-free reference, the churned day, and the churned replay all
+  close their accounting (every arrival blackholed/accepted/shed by
+  reason, every cohort slot committed or dropped, zero ledger duplicates);
+- churned accuracy lands within the drill tolerance of the reference;
+- the churned day replays BYTE-identically (history digest) — the
+  determinism contract every device_day drill rests on;
+- permanent departures reclaim their arena spill files from the disk tier.
+
+This is the cheapest end-to-end probe of the cross-device plane: an
+admission-edge, lifecycle, or seeding regression shows up here as a digest
+diff or an accounting gap long before the full tier-1 suite runs.
+
+    JAX_PLATFORMS=cpu python scripts/device_day_smoke.py
+"""
+
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from fedml_tpu.cross_device.device_day import (  # noqa: E402
+    DeviceDayConfig, run_device_churn_drill)
+
+
+def main() -> int:
+    cfg = DeviceDayConfig(
+        registry_size=10_000, day_s=120.0, tick_s=5.0, num_classes=4,
+        cohort=32, queue_maxsize=256, peak_rate=80.0, dropout_rate=0.05,
+        max_commits_per_tick=2, arena_capacity=128, host_capacity=256,
+        spill_dir=tempfile.mkdtemp(prefix="device_day_smoke_"),
+        eval_every_ticks=4, seed=0,
+        churn_fraction=0.3, churn_rejoin_ticks=2,
+        churn_permanent_fraction=0.2, churn_partition_classes=1,
+        churn_partition_ticks=3)
+    res = run_device_churn_drill(cfg)
+    print(res.summary(), file=sys.stderr)
+
+    failures = []
+    if not res.reference.ok:
+        failures.append("reference accounting did not close")
+    if not res.churned.ok:
+        failures.append("churned accounting did not close")
+    if res.acc_delta > res.max_acc_delta:
+        failures.append(f"acc delta {res.acc_delta:.4f} > "
+                        f"{res.max_acc_delta}")
+    if not res.replay_identical:
+        failures.append("churned day did not replay bit-identically")
+    if res.churned.departures == 0:
+        failures.append("no permanent departures exercised")
+    if res.churned.rejoins == 0:
+        failures.append("no rejoin wave exercised")
+    if res.churned.partition_blackholed == 0:
+        failures.append("partition window blackholed nothing")
+    if res.churned.reclaimed_spill_files == 0:
+        failures.append("departures reclaimed no spill files (arena "
+                        "disk-tier lifecycle regression)")
+    if failures:
+        for f in failures:
+            print(f"device-day smoke: FAILED — {f}", file=sys.stderr)
+        return 1
+    print("device-day smoke: OK", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

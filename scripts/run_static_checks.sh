@@ -28,8 +28,13 @@
 #      attached: every round publishes + canary-promotes, live requests
 #      all serve with zero drops, a NaN publish rolls back and pins,
 #      and training params stay bitwise-equal to the serving-off run.
+#   7. device_day_smoke — a 10k-device registry through a 2-minute
+#      simulated day with the full churn drill (dropout + rejoin waves,
+#      permanent departures reclaiming arena spill files, one partition
+#      window); gates closed shed/drop accounting, accuracy vs the
+#      churn-free reference, and a bit-identical replay.
 #
-# Checks 1-3 are pure-AST / host-compile; checks 4-6 run JAX on CPU
+# Checks 1-3 are pure-AST / host-compile; checks 4-7 run JAX on CPU
 # (debug-small dataset, a few seconds each). No network or model
 # downloads are involved.
 set -u
@@ -63,6 +68,9 @@ JAX_PLATFORMS=cpu "$PY" scripts/scan_smoke.py || rc=1
 
 echo "== serving-plane rollout smoke =="
 JAX_PLATFORMS=cpu "$PY" scripts/serve_smoke.py || rc=1
+
+echo "== cross-device fleet churn smoke =="
+JAX_PLATFORMS=cpu "$PY" scripts/device_day_smoke.py || rc=1
 
 if [ "$rc" -ne 0 ]; then
     echo "static checks FAILED (see above)" >&2

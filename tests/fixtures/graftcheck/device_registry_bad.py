@@ -1,0 +1,37 @@
+"""Fixture: a cross-device check-in gateway with the two concurrency
+mistakes the cross_device scope exists to catch (fed to the checkers under
+a ``fedml_tpu/cross_device/`` relpath — see tests/test_static_analysis.py):
+blocking work under the admission lock (and AB/BA nesting against the
+registry lock), plus a heartbeat thread racing the main thread on shared
+fleet state with no common lock."""
+
+import threading
+import time
+
+
+class Gateway:
+    def __init__(self):
+        self._admit_lock = threading.Lock()
+        self._fleet_lock = threading.Lock()
+        self.last_checkin = None
+
+    def admit(self, sock, frame):
+        with self._admit_lock:
+            with self._fleet_lock:
+                sock.sendall(frame)    # blocking send under both locks
+
+    def evict(self):
+        # opposite nesting order from admit() — AB/BA deadlock
+        with self._fleet_lock:
+            with self._admit_lock:
+                time.sleep(0.5)
+
+    def start_heartbeats(self):
+        threading.Thread(target=self._beat, daemon=True).start()
+
+    def _beat(self):
+        while True:
+            self.last_checkin = time.monotonic()  # unlocked thread write
+
+    def stale(self):
+        return self.last_checkin       # unlocked main-thread read

@@ -1,0 +1,38 @@
+"""Fixture: the same check-in gateway written with the house discipline —
+one nesting order, no blocking call under any lock, and the heartbeat
+thread sharing a lock with its readers."""
+
+import threading
+import time
+
+
+class Gateway:
+    def __init__(self):
+        self._admit_lock = threading.Lock()
+        self._fleet_lock = threading.Lock()
+        self.last_checkin = None
+
+    def admit(self, sock, frame):
+        with self._admit_lock:
+            with self._fleet_lock:
+                self._pending = frame
+        sock.sendall(frame)            # send happens outside the locks
+
+    def evict(self):
+        # same order as admit()
+        with self._admit_lock:
+            with self._fleet_lock:
+                self._pending = None
+        time.sleep(0.5)
+
+    def start_heartbeats(self):
+        threading.Thread(target=self._beat, daemon=True).start()
+
+    def _beat(self):
+        while True:
+            with self._fleet_lock:
+                self.last_checkin = time.monotonic()
+
+    def stale(self):
+        with self._fleet_lock:         # same lock as the writer
+            return self.last_checkin

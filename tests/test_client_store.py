@@ -144,6 +144,38 @@ def test_arena_reload_actually_round_trips(tmp_path):
         arena.gather(list(range(6)))
 
 
+def test_arena_discard_reclaims_every_tier_including_stale_files(tmp_path):
+    """Permanent departure (cross-device churn) must not leak: the slot,
+    the host row, the live spill file, AND the stale-but-inert file left
+    behind when a disk-tier client was merely read back all go away."""
+    proto = {"a": jnp.zeros((3,))}
+    arena = ClientStateArena(proto, 2, spill_dir=str(tmp_path),
+                             host_capacity=2)
+    for cid in range(6):
+        arena.gather([cid])
+        arena.scatter([cid], {"a": jnp.full((1, 3), float(cid))})
+    # reading client 0 back promotes it to a device slot but deliberately
+    # leaves its file on disk (inert — _on_disk is the source of truth)
+    arena.gather([0])
+    files = lambda: sorted(p.name for p in tmp_path.glob("client_*.msgpack"))
+    assert "client_0.msgpack" in files()
+    before = arena.spilled_count
+    # clients 0 (resident again, stale file), 1 and 2 (disk tier) depart;
+    # duplicate and never-seen ids are harmless
+    reclaimed = arena.discard([0, 1, 2, 2, 99])
+    assert reclaimed == 3          # 0's stale file + 1's and 2's live files
+    assert files() == []           # every file for the departed is gone
+    assert arena.spilled_count < before
+    # departed clients are fully forgotten: they read back as fresh proto
+    for cid in (0, 1, 2):
+        np.testing.assert_array_equal(
+            np.asarray(arena.state_of(cid)["a"]), np.zeros(3))
+    # survivors are untouched across all tiers
+    for cid in (3, 4, 5):
+        np.testing.assert_array_equal(
+            np.asarray(arena.state_of(cid)["a"]), np.full(3, cid))
+
+
 def test_arena_checkpoint_resume_bit_exact(tmp_path):
     """Interrupted-at-2 resume == uninterrupted run: the checkpoint carries
     the whole arena (slots, map, clock, spilled rows)."""

@@ -1,0 +1,256 @@
+"""Cross-device day driver (tentpole): the 1M-class device registry, the
+seeded diurnal arrival curve, the virtual-time admission edge, and the full
+churn drill — every claim here is either an accounting-closure invariant
+(arrivals = offered + blackholed, offered = accepted + shed-by-reason, ...)
+or a bit-identical-replay claim from ``(seed, curve)``.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from fedml_tpu.core import telemetry
+from fedml_tpu.core.tenancy import CheckinQueue
+from fedml_tpu.cross_device import (
+    DEVICE_DAY_DEFAULTS,
+    DeviceDayConfig,
+    DeviceRegistry,
+    run_device_churn_drill,
+    run_device_day,
+)
+from fedml_tpu.cross_device.device_day import config_from_args
+from fedml_tpu.cross_silo.loadgen import DiurnalCurve
+from fedml_tpu.simulation.async_engine import VirtualEventHeap
+
+
+@pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    telemetry.configure(enabled=True, reset=True)
+    yield
+    telemetry.configure(enabled=True, reset=True)
+
+
+def _tiny(**overrides):
+    base = dict(
+        registry_size=2_000, day_s=600.0, tick_s=30.0, num_classes=4,
+        cohort=16, queue_maxsize=128, peak_rate=8.0, arena_capacity=64,
+        host_capacity=128, eval_every_ticks=4, dropout_rate=0.05,
+        max_commits_per_tick=2, seed=7,
+    )
+    base.update(overrides)
+    return DeviceDayConfig(**base)
+
+
+# --- registry lifecycle -------------------------------------------------------
+
+
+def test_registry_lifecycle_and_counters():
+    reg = DeviceRegistry(100, num_classes=4, seed=3)
+    assert reg.state_counts()["eligible"] == 100
+    ids = np.arange(10)
+    reg.mark_checked_in(ids)
+    assert reg.state_counts()["checked_in"] == 10
+    assert not reg.admissible(ids).any()          # already in: refused
+    reg.mark_training(ids[:6])
+    reg.mark_uploaded(ids[:4], version=5)
+    assert (reg.last_version[:4] == 5).all()
+    assert reg.state_counts()["eligible"] == 94
+    # mid-round failures: the two still-training devices drop
+    assert reg.mark_dropped(ids[4:6]) == 2
+    # devices the round plane passed over go back to eligible, not dropped
+    reg.release(ids[6:10])
+    assert reg.state_counts() == {
+        "eligible": 98, "checked_in": 0, "training": 0,
+        "dropped": 2, "departed": 0}
+    assert reg.counters["checkins"] == 10
+    assert reg.counters["uploads"] == 4
+    assert reg.counters["dropouts"] == 2
+
+
+def test_registry_rejoin_resync_split_on_log_floor():
+    reg = DeviceRegistry(20, seed=0)
+    reg.mark_checked_in(np.arange(8))
+    reg.mark_uploaded(np.arange(4), version=2)    # behind the floor
+    reg.mark_uploaded(np.arange(4, 8), version=9)  # ahead of it
+    reg.mark_dropped(np.arange(8), held=True)
+    assert reg.recover(rate=1.0, rng=np.random.default_rng(0)) == 0  # held
+    out = reg.rejoin(np.arange(8), log_floor_version=5)
+    assert out == {"rejoined": 8, "resync_full": 4, "resync_incremental": 4}
+    assert (reg.state[:8] == 0).all() and not reg.held[:8].any()
+
+
+def test_registry_departure_is_permanent():
+    reg = DeviceRegistry(10, seed=0)
+    gone = reg.depart([3, 4])
+    assert sorted(gone.tolist()) == [3, 4]
+    # departed devices never re-enter any lifecycle path
+    assert reg.depart([3]).size == 0
+    assert reg.mark_dropped([3]) == 0
+    assert not reg.admissible([3, 4]).any()
+    assert reg.eligible_available(0.0).size <= 8
+    assert reg.counters["departures"] == 2
+
+
+def test_registry_availability_is_seeded_and_windowed():
+    a = DeviceRegistry(5_000, seed=11)
+    b = DeviceRegistry(5_000, seed=11)
+    np.testing.assert_array_equal(a.awake_start, b.awake_start)
+    # awake windows are 0.3-0.9 of the day, so the fleet-wide availability
+    # fraction at any instant sits inside that envelope
+    frac = a.available(12_345.0).mean()
+    assert 0.3 < frac < 0.9
+    assert DeviceRegistry(5_000, seed=12).available(12_345.0).mean() != frac
+
+
+# --- diurnal curve ------------------------------------------------------------
+
+
+def test_diurnal_curve_pure_and_seeded():
+    c = DiurnalCurve(peak_rate=10.0, seed=4)
+    t = np.linspace(0.0, 86_400.0, 97)
+    np.testing.assert_array_equal(c.rate(t),
+                                  DiurnalCurve(peak_rate=10.0, seed=4).rate(t))
+    assert (c.rate(t) >= 0.0).all()
+    # peak-to-trough swing is real: the curve spans several-fold
+    assert c.rate(t).max() > 2.5 * c.rate(t).min()
+    # a different seed reshapes the harmonics but not the envelope
+    d = DiurnalCurve(peak_rate=10.0, seed=5)
+    assert not np.array_equal(c.rate(t), d.rate(t))
+    # Poisson arrivals are owned by the caller's generator: same stream in,
+    # same counts out
+    n1 = [c.arrivals(i * 600.0, (i + 1) * 600.0,
+                     np.random.default_rng([4, i])) for i in range(16)]
+    n2 = [c.arrivals(i * 600.0, (i + 1) * 600.0,
+                     np.random.default_rng([4, i])) for i in range(16)]
+    assert n1 == n2 and sum(n1) > 0
+
+
+def test_virtual_event_heap_pops_ties_in_push_order():
+    h = VirtualEventHeap()
+    for i, vt in enumerate([3.0, 1.0, 3.0, 1.0, 2.0]):
+        h.push(vt, i)
+    assert len(h) == 5
+    assert h.peek_vt() == 1.0
+    assert h.pop_batch() == (1.0, [1, 3])
+    assert h.pop_batch() == (2.0, [4])
+    assert h.pop_batch() == (3.0, [0, 2])
+    assert not h
+
+
+# --- the day itself -----------------------------------------------------------
+
+
+def test_device_day_accounting_closes_and_replays_bit_identical():
+    r1 = run_device_day(_tiny())
+    assert r1.ok, r1.summary()
+    assert r1.arrivals == r1.offered  # no partition in the plain day
+    assert r1.offered == (r1.accepted + r1.shed_queue_full
+                          + r1.shed_inadmissible)
+    assert r1.commits > 0 and r1.committed_updates > 0
+    assert r1.final_version == r1.commits - r1.zero_survivor_commits
+    assert r1.duplicates == 0
+    assert 0.0 <= r1.final_acc <= 1.0
+    # bit-identical replay from (seed, curve): digests AND raw history
+    r2 = run_device_day(_tiny())
+    assert r2.history_digest == r1.history_digest
+    assert r2.params_digest == r1.params_digest
+    assert r2.history == r1.history
+    # a different seed is a different day
+    assert run_device_day(_tiny(seed=8)).history_digest != r1.history_digest
+
+
+def test_device_day_spill_tier_engages_and_stays_bounded(tmp_path):
+    cfg = _tiny(arena_capacity=24, host_capacity=48,
+                spill_dir=str(tmp_path / "spill"))
+    r = run_device_day(cfg)
+    assert r.ok, r.summary()
+    assert r.arena_resident <= cfg.arena_capacity
+    assert r.arena_spilled > 0, "day never exercised the spill tier"
+    assert len(list((tmp_path / "spill").glob("client_*.msgpack"))) > 0
+
+
+def test_device_day_duplicate_announces_shed_as_inadmissible():
+    # long announce latency relative to the tick makes re-announces while
+    # the first copy is still airborne common — the edge must admit only
+    # the first copy per wave and refuse the rest
+    r = run_device_day(_tiny(arrival_spread_ticks=4.0, peak_rate=16.0))
+    assert r.ok, r.summary()
+    assert r.shed_inadmissible > 0
+    assert r.duplicates == 0
+
+
+def test_device_day_sheds_instead_of_unbounded_queue():
+    r = run_device_day(_tiny(queue_maxsize=16, peak_rate=24.0))
+    assert r.ok, r.summary()
+    assert r.shed_queue_full > 0
+    assert r.max_queue_depth <= 16
+    cs = telemetry.get_registry().snapshot()["counters"]
+    by_reason = {
+        "queue_full": sum(v for k, v in cs.items()
+                          if k.startswith("fedml_shed_total{reason=queue_full")),
+        "inadmissible": sum(
+            v for k, v in cs.items()
+            if k.startswith("fedml_shed_total{reason=inadmissible")),
+    }
+    assert by_reason["queue_full"] == r.shed_queue_full
+    assert by_reason["inadmissible"] == r.shed_inadmissible
+
+
+def test_device_day_defaults_flow_through_args():
+    class _Args:
+        pass
+
+    args = _Args()
+    for key, val in DEVICE_DAY_DEFAULTS.items():
+        setattr(args, key, val)
+    args.device_registry_size = 123
+    args.churn_fraction = 0.25
+    cfg = config_from_args(args)
+    assert cfg.registry_size == 123
+    assert cfg.churn_fraction == 0.25
+    assert cfg.spill_dir is None  # "" means no disk tier
+    assert cfg.n_ticks == int(round(cfg.day_s / cfg.tick_s))
+
+
+# --- the churn drill ----------------------------------------------------------
+
+
+def test_churn_drill_survives_thirty_percent_churn(tmp_path):
+    cfg = _tiny(registry_size=4_000, day_s=900.0, tick_s=30.0,
+                cohort=24, peak_rate=12.0,
+                churn_fraction=0.3, churn_rejoin_ticks=2,
+                churn_permanent_fraction=0.2,
+                churn_partition_classes=1, churn_partition_ticks=4,
+                spill_dir=str(tmp_path))
+    drill = run_device_churn_drill(cfg, max_acc_delta=0.05)
+    assert drill.ok, drill.summary()
+    c = drill.churned
+    # every churn mechanism actually fired
+    assert c.dropouts > 0 and c.rejoins > 0 and c.departures > 0
+    assert c.partition_blackholed > 0
+    assert c.reclaimed_spill_files > 0, \
+        "permanent departures must reclaim their spill files"
+    # the reference day is genuinely churn-free
+    assert drill.reference.departures == 0
+    assert drill.reference.partition_blackholed == 0
+    # degradation is graceful, not catastrophic
+    assert drill.acc_delta <= 0.05
+    # and the churned day replays bit-identically
+    assert drill.replay_identical
+
+
+def test_churn_rejoin_across_version_log_trim_forces_full_resync():
+    # keep only the last 2 versions; the churn wave drops at the midpoint
+    # and rejoins several commits later, so rejoiners' last-synced version
+    # has fallen off the retained log -> full resync, no duplicate commits
+    cfg = _tiny(registry_size=4_000, day_s=900.0, tick_s=30.0,
+                cohort=24, peak_rate=12.0, keep_versions=2,
+                churn_fraction=0.4, churn_rejoin_ticks=4)
+    r = run_device_day(cfg)
+    assert r.ok, r.summary()
+    assert r.rejoins > 0
+    assert r.resync_full > 0, \
+        "rejoin after the trim boundary must trigger full resyncs"
+    assert r.resync_full + r.resync_incremental == r.rejoins
+    assert r.duplicates == 0

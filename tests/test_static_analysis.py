@@ -470,6 +470,46 @@ def test_serving_fixture_out_of_scope_by_default():
     assert _run_on_fixture(LockOrderChecker, "serving_bad.py") == []
 
 
+# ------------------------------------ cross-device plane (thread + locks)
+
+_CROSS_DEVICE = "fedml_tpu/cross_device/_graftcheck_fixture.py"
+
+
+def test_cross_device_scope_fires_on_bad_fixture():
+    # the cross-device package is in both checkers' scope: a check-in
+    # gateway doing a blocking send under the admission+fleet locks
+    # (with the eviction path nesting them in the opposite order) must
+    # fire lock-order, and a heartbeat thread stamping last_checkin
+    # without the readers' lock must fire thread-hazard
+    locks = _run_on_fixture(
+        LockOrderChecker, "device_registry_bad.py", relpath=_CROSS_DEVICE)
+    msgs = "\n".join(f.message for f in locks)
+    assert ".sendall()" in msgs
+    assert "time.sleep" in msgs
+    assert "lock acquisition cycle" in msgs
+    hazards = _run_on_fixture(
+        ThreadHazardChecker, "device_registry_bad.py", relpath=_CROSS_DEVICE)
+    assert "hazard:Gateway.last_checkin" in {f.key for f in hazards}
+
+
+def test_cross_device_scope_silent_on_clean_fixture():
+    # one nesting order, send/sleep after release, heartbeat writes and
+    # staleness reads sharing the fleet lock: both checkers stay quiet,
+    # so the real package's discipline is the enforced shape
+    assert _run_on_fixture(
+        LockOrderChecker, "device_registry_clean.py",
+        relpath=_CROSS_DEVICE) == []
+    assert _run_on_fixture(
+        ThreadHazardChecker, "device_registry_clean.py",
+        relpath=_CROSS_DEVICE) == []
+
+
+def test_cross_device_fixture_out_of_scope_by_default():
+    assert _run_on_fixture(
+        ThreadHazardChecker, "device_registry_bad.py") == []
+    assert _run_on_fixture(LockOrderChecker, "device_registry_bad.py") == []
+
+
 # ----------------------------------------------------------- suppression
 
 def _no_print_over(tmp_path, source):

@@ -169,6 +169,48 @@ def test_checkin_queue_sheds_when_full_and_accounting_closes():
     assert q.stats()["accepted"] == 9
 
 
+def test_checkin_queue_sheds_by_reason():
+    q = CheckinQueue(maxsize=4)
+    # the caller's registry can refuse a device before the queue is asked
+    assert q.offer(b"dup", tenant="t0", admissible=False) is False
+    for _ in range(6):
+        q.offer(b"x", tenant="t0")
+    stats = q.stats()
+    assert stats["shed_inadmissible"] == 1
+    assert stats["shed_queue_full"] == 2
+    assert stats["shed"] == stats["shed_queue_full"] \
+        + stats["shed_inadmissible"]
+    # per-reason shed counters (what `telemetry summary` breaks down)
+    cs = _counters()
+    assert cs.get("fedml_shed_total{reason=inadmissible,tenant=t0}") == 1
+    assert cs.get("fedml_shed_total{reason=queue_full,tenant=t0}") == 2
+    # reason totals reconcile with the legacy per-tenant shed counter
+    assert sum(v for k, v in cs.items()
+               if k.startswith("fedml_shed_total{")) \
+        == cs["fedml_checkins_shed_total{tenant=t0}"]
+
+
+def test_checkin_queue_offer_many_accounting_matches_per_offer():
+    # one arrival wave through the batched edge ...
+    q_batch = CheckinQueue(maxsize=4)
+    adm = [True, False, True, True, False, True, True, True]
+    out = q_batch.offer_many(list(range(8)), tenant="t0", admissible=adm)
+    assert out == {"accepted": 4, "shed_queue_full": 2,
+                   "shed_inadmissible": 2}
+    # ... is indistinguishable from the same wave offered one at a time
+    q_solo = CheckinQueue(maxsize=4)
+    for i, a in enumerate(adm):
+        q_solo.offer(i, tenant="t0", admissible=a)
+    assert q_batch.stats() == q_solo.stats()
+    # inadmissible sheds never consumed queue room
+    assert [q_batch.poll() for _ in range(4)] == [0, 2, 3, 5]
+    # telemetry saw both edges identically (batch + solo = 2x each count)
+    cs = _counters()
+    assert cs["fedml_checkins_accepted_total{tenant=t0}"] == 8
+    assert cs["fedml_shed_total{reason=queue_full,tenant=t0}"] == 4
+    assert cs["fedml_shed_total{reason=inadmissible,tenant=t0}"] == 4
+
+
 # --- telemetry isolation -----------------------------------------------------
 
 
