@@ -76,11 +76,13 @@ class TurboAggregateSimulator:
                 "num_samples": jnp.asarray(batches.num_samples),
             }
             rng, step_rng = jax.random.split(rng)
+            # cohort size is fixed by config, not by this round's sample —
+            # splitting by the constant keeps the traced shape loop-invariant
+            C = cfg.client_num_per_round
             outs = self._cohort_step(
-                self.params, cohort, jax.random.split(step_rng, len(client_ids))
+                self.params, cohort, jax.random.split(step_rng, C)
             )
             # host-side: unstack per-client updates, secure-sum, uniform mean
-            C = len(client_ids)
             updates = [
                 jax.tree.map(lambda u, i=i: np.asarray(u[i]), outs.update)
                 for i in range(C)

@@ -1,7 +1,11 @@
 """graftcheck: fedml_tpu's first-party static-analysis suite.
 
-Ten AST checkers over one shared parse of the package, with per-line
-suppressions and a committed baseline (see docs/static_analysis.md):
+Thirteen AST checkers over one shared parse of the package and one shared
+interprocedural project graph (``project.py``: import-resolved cross-module
+call edges, constant resolution, dependency closures), with per-line
+suppressions, a committed baseline, and a content-hash incremental result
+cache (``cache.py``) that makes warm re-runs near-instant (see
+docs/static_analysis.md):
 
 - ``jit-purity`` — impure calls reachable from jit/pjit/shard_map/lax bodies
 - ``determinism`` — unseeded RNGs, time-derived seeds, set-order leaks
@@ -14,9 +18,16 @@ suppressions and a committed baseline (see docs/static_analysis.md):
 - ``host-sync`` — implicit device syncs on round-loop hot paths
 - ``collective-deadlock`` — collectives under process_index/rank/tenant guards
 - ``thread-hazard`` — cross-thread attribute access without a common lock
+- ``retrace-hazard`` — jit wrappers constructed per call/iteration,
+  loop-varying or unhashable static args, shape-derived values retracing
+- ``wire-protocol`` — sent message types without handlers, handler-read
+  keys no sender stamps, raw literals shadowing wire constants
+- ``resource-leak`` — unjoined non-daemon threads, unclosed
+  files/sockets/channels, spill arenas with no reclaim edge
 
 Entry points: ``python -m fedml_tpu.cli analyze`` and ``scripts/graftcheck.py``
-(``--changed-only`` for the dev loop, ``--format sarif`` for CI annotation).
+(``--changed-only`` for the dev loop, ``--format sarif`` for CI annotation,
+``--stats`` for per-checker timing and cache hit rate).
 """
 
 from .core import (  # noqa: F401

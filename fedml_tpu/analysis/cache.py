@@ -1,0 +1,280 @@
+"""Content-hash incremental result cache for the graftcheck suite.
+
+A full scan parses ~120 modules and runs thirteen checkers; the result
+for any given file only changes when something it can see changes. This
+module caches post-suppression findings keyed by content hash so the
+pre-commit loop pays for what changed and nothing else:
+
+- **suite token** — a hash over every ``fedml_tpu/analysis/*.py`` source;
+  any change to the checkers (or this cache) drops the whole cache, so a
+  checker edit can never serve stale results.
+- **per-file scope** (``cache_scope = "file"``) — the checker's findings
+  for a file depend only on that file's bytes. Reused when the hash
+  matches.
+- **file+deps scope** (``"file+deps"``) — findings depend on the file
+  plus its transitive package-internal import closure (retrace-hazard
+  resolves jitted callables across modules). Reused when nothing in the
+  closure changed.
+- **package scope** (``"package"``) — cross-file aggregation
+  (wire-protocol's send/handler join, lock-order's cycle graph,
+  config-drift). Reused only on a fully-unchanged package.
+- ``cache_extra_files`` — repo-root-relative non-package inputs a checker
+  reads (config-drift's docs, sharding-consistency's mesh vocabulary);
+  their hashes fold into that checker's validity.
+
+A fully-warm run (no file changed) does not even parse the package: it
+deserializes findings straight from the cache, which is what keeps the
+``fedml-tpu analyze`` warm path under the 10s budget. Cold and warm runs
+are byte-identical by construction — the cache stores the exact Finding
+fields, post-suppression, and the final sort is shared with
+:func:`fedml_tpu.analysis.core.run_checkers`.
+
+The cache lives at ``<repo>/.graftcheck_cache.json`` (gitignored); delete
+it or pass ``--no-cache`` to force a cold run.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+import time
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import (
+    Context,
+    Finding,
+    Module,
+    iter_source_files,
+    load_module,
+)
+
+CACHE_FORMAT = 1
+
+
+def default_cache_path(repo_root: str) -> str:
+    return os.path.join(repo_root, ".graftcheck_cache.json")
+
+
+def _sha(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def file_hash(path: str) -> Optional[str]:
+    try:
+        with open(path, "rb") as f:
+            return _sha(f.read())
+    except OSError:
+        return None
+
+
+def suite_token() -> str:
+    """Hash of every checker source in this package — edits to the suite
+    itself invalidate everything."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    h = hashlib.sha256()
+    for fn in sorted(os.listdir(here)):
+        if not fn.endswith(".py"):
+            continue
+        h.update(fn.encode())
+        try:
+            with open(os.path.join(here, fn), "rb") as f:
+                h.update(f.read())
+        except OSError:
+            pass
+    return h.hexdigest()
+
+
+def _finding_to_cache(f: Finding) -> dict:
+    return {"checker": f.checker, "path": f.path, "line": f.line,
+            "message": f.message, "key": f.key, "severity": f.severity}
+
+
+def _finding_from_cache(d: dict) -> Finding:
+    return Finding(checker=d["checker"], path=d["path"], line=int(d["line"]),
+                   message=d["message"], key=d["key"],
+                   severity=d.get("severity", "error"))
+
+
+def load_cache(path: str, suite: str, package_dir: str) -> dict:
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    if not isinstance(data, dict) or data.get("format") != CACHE_FORMAT:
+        return {}
+    if data.get("suite") != suite:
+        return {}
+    if data.get("package_dir") != os.path.abspath(package_dir):
+        return {}
+    return data
+
+
+def save_cache(path: str, data: dict) -> None:
+    """Atomic write — a crashed run can never leave a torn cache."""
+    try:
+        fd, tmp = tempfile.mkstemp(
+            dir=os.path.dirname(path) or ".", prefix=".graftcheck_cache.")
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(data, f, separators=(",", ":"))
+        os.replace(tmp, path)
+    except OSError:
+        pass  # cache is an optimization; never fail the run over it
+
+
+def run_checkers_cached(
+    checker_classes: Sequence[type],
+    package_dir: str,
+    repo_root: str,
+    cache_path: str,
+    stats: Optional[dict] = None,
+) -> List[Finding]:
+    """Cache-aware equivalent of :func:`core.run_checkers` over the full
+    package (no ``only`` subset — --changed-only keeps its own path).
+    Byte-identical findings to the uncached run, warm or cold."""
+    t_start = time.perf_counter()
+    suite = suite_token()
+    paths = iter_source_files(package_dir)
+    rel_of = {p: os.path.relpath(p, repo_root).replace(os.sep, "/")
+              for p in paths}
+    hashes: Dict[str, str] = {}
+    for p in paths:
+        h = file_hash(p)
+        if h is not None:
+            hashes[rel_of[p]] = h
+    path_of = {rel_of[p]: p for p in paths}
+
+    prior = load_cache(cache_path, suite, package_dir)
+    prior_files: Dict[str, dict] = prior.get("files", {}) or {}
+    prior_results: Dict[str, dict] = prior.get("results", {}) or {}
+    prior_pkg: Dict[str, list] = prior.get("package_results", {}) or {}
+    prior_extra: Dict[str, str] = prior.get("extra", {}) or {}
+
+    changed = {rel for rel, h in hashes.items()
+               if prior_files.get(rel, {}).get("hash") != h}
+    removed = set(prior_files) - set(hashes)
+
+    extra_paths: Set[str] = set()
+    for cls in checker_classes:
+        extra_paths.update(getattr(cls, "cache_extra_files", ()))
+    extra_now: Dict[str, str] = {}
+    for ep in sorted(extra_paths):
+        h = file_hash(os.path.join(repo_root, ep))
+        if h is not None:
+            extra_now[ep] = h
+
+    def extra_changed(cls) -> bool:
+        return any(prior_extra.get(ep) != extra_now.get(ep)
+                   for ep in getattr(cls, "cache_extra_files", ()))
+
+    # ---- lazy parsing: a fully-warm run never touches the ASTs
+    modules: Dict[str, Module] = {}
+    graph = [None]
+
+    def get_module(rel: str) -> Module:
+        if rel not in modules:
+            modules[rel] = load_module(path_of[rel], repo_root)
+        return modules[rel]
+
+    def get_all_modules() -> List[Module]:
+        return [get_module(rel) for rel in sorted(hashes)]
+
+    def get_graph():
+        if graph[0] is None:
+            from .project import build_graph
+            graph[0] = build_graph(get_all_modules())
+        return graph[0]
+
+    ctx = Context(repo_root=repo_root, package_dir=package_dir)
+
+    def suppressed(f: Finding) -> bool:
+        mod = modules.get(f.path)
+        if mod is None and f.path in path_of:
+            mod = get_module(f.path)
+        if mod is None:
+            return False
+        ids = mod.suppressions.get(f.line, ())
+        return bool(ids) and ("*" in ids or f.checker in ids)
+
+    findings: List[Finding] = []
+    new_results: Dict[str, Dict[str, list]] = {}
+    new_pkg: Dict[str, list] = {}
+
+    for cls in checker_classes:
+        t0 = time.perf_counter()
+        scope = getattr(cls, "cache_scope", "file")
+        cid = cls.id
+        scanned = cached_n = 0
+
+        if scope == "package":
+            if not changed and not removed and not extra_changed(cls) \
+                    and cid in prior_pkg:
+                got = [_finding_from_cache(d) for d in prior_pkg[cid]]
+                new_pkg[cid] = prior_pkg[cid]
+                cached_n = len(hashes)
+            else:
+                ctx.graph = get_graph()
+                checker = cls(ctx)
+                raw: List[Finding] = []
+                for mod in get_all_modules():
+                    if checker.interested(mod.relpath):
+                        raw.extend(checker.visit_module(mod))
+                        scanned += 1
+                raw.extend(checker.finalize())
+                got = [f for f in raw if not suppressed(f)]
+                new_pkg[cid] = [_finding_to_cache(f) for f in got]
+            findings.extend(got)
+        else:
+            prior_mine: Dict[str, list] = prior_results.get(cid, {}) or {}
+            mine: Dict[str, list] = {}
+            checker = None
+            probe = cls(ctx)
+            invalid_extra = extra_changed(cls)
+            for rel in sorted(hashes):
+                if not probe.interested(rel):
+                    continue
+                valid = (not invalid_extra and rel not in changed
+                         and rel in prior_mine)
+                if valid and scope == "file+deps" and (changed or removed):
+                    closure = get_graph().import_closure(rel)
+                    valid = not (closure & changed) and not removed
+                if valid:
+                    mine[rel] = prior_mine[rel]
+                    findings.extend(_finding_from_cache(d)
+                                    for d in prior_mine[rel])
+                    cached_n += 1
+                    continue
+                if checker is None:
+                    if scope == "file+deps":
+                        ctx.graph = get_graph()
+                    checker = cls(ctx)
+                got = [f for f in checker.visit_module(get_module(rel))
+                       if not suppressed(f)]
+                mine[rel] = [_finding_to_cache(f) for f in got]
+                findings.extend(got)
+                scanned += 1
+            new_results[cid] = mine
+        if stats is not None:
+            stats.setdefault("checkers", {})[cid] = {
+                "seconds": time.perf_counter() - t0,
+                "files_scanned": scanned,
+                "files_cached": cached_n,
+            }
+
+    save_cache(cache_path, {
+        "format": CACHE_FORMAT,
+        "suite": suite,
+        "package_dir": os.path.abspath(package_dir),
+        "files": {rel: {"hash": h} for rel, h in hashes.items()},
+        "results": new_results,
+        "package_results": new_pkg,
+        "extra": extra_now,
+    })
+    if stats is not None:
+        stats["total_seconds"] = time.perf_counter() - t_start
+        stats["files"] = len(hashes)
+        stats["files_changed"] = len(changed)
+        stats["files_removed"] = len(removed)
+    return sorted(findings, key=lambda f: (f.path, f.line, f.checker, f.key))

@@ -74,6 +74,8 @@ class ConfigDriftChecker(Checker):
     # cross-file by construction: a subset scan would report every key
     # whose read sites didn't change as doc-only drift
     whole_package_only = True
+    cache_scope = "package"
+    cache_extra_files = ("docs/config_reference.md", "docs/observability.md")
 
     def __init__(self, ctx):
         super().__init__(ctx)

@@ -147,10 +147,14 @@ def iter_source_files(root: str) -> List[str]:
 @dataclass
 class Context:
     """Paths a checker may need beyond the per-file AST (e.g. config-drift
-    cross-references docs/config_reference.md)."""
+    cross-references docs/config_reference.md). ``graph`` is the shared
+    :class:`~fedml_tpu.analysis.project.ProjectGraph` over every scanned
+    module, built once per run; checkers fall back to a single-module
+    graph when it is absent (fixture tests construct Context directly)."""
 
     repo_root: str
     package_dir: str
+    graph: Optional[object] = None
 
 
 class Checker:
@@ -164,6 +168,14 @@ class Checker:
     # package (cross-file aggregation that would false-positive on a
     # subset); --changed-only skips them
     whole_package_only: bool = False
+    # incremental-cache validity of this checker's findings for a file:
+    #   "file"      — depend only on that file's bytes
+    #   "file+deps" — also on the file's transitive package import closure
+    #   "package"   — cross-file aggregation; any package change invalidates
+    cache_scope: str = "file"
+    # repo-root-relative non-package files this checker reads; their hashes
+    # fold into cache validity (e.g. config-drift's docs)
+    cache_extra_files: Tuple[str, ...] = ()
 
     def __init__(self, ctx: Context):
         self.ctx = ctx
@@ -191,13 +203,18 @@ def run_checkers(
     package_dir: str,
     repo_root: str,
     only: Optional[Sequence[str]] = None,
+    stats: Optional[dict] = None,
 ) -> List[Finding]:
-    """Parse every file once, feed all checkers, drop suppressed findings.
+    """Parse every file once, build the shared project graph once, feed all
+    checkers, drop suppressed findings.
 
     ``only`` (absolute paths) restricts the scan to that subset of the
     package — the ``--changed-only`` dev loop. Returns findings sorted by
     (path, line, checker) — baseline filtering is the caller's concern
     (see :func:`apply_baseline`)."""
+    import time
+
+    t_start = time.perf_counter()
     ctx = Context(repo_root=repo_root, package_dir=package_dir)
     paths = iter_source_files(package_dir)
     if only is not None:
@@ -205,15 +222,33 @@ def run_checkers(
         paths = [p for p in paths if os.path.abspath(p) in allowed]
     modules = [load_module(p, repo_root) for p in paths]
     by_rel = {m.relpath: m for m in modules}
+    # one interprocedural graph for every checker (import-resolved
+    # cross-module edges; see project.py) instead of N per-checker rebuilds
+    from .project import build_graph
+    ctx.graph = build_graph(modules)
     findings: List[Finding] = []
     for cls in checker_classes:
+        t0 = time.perf_counter()
         checker = cls(ctx)
+        scanned = 0
         for mod in modules:
             if not checker.interested(mod.relpath):
                 continue
             findings.extend(checker.visit_module(mod))
+            scanned += 1
         findings.extend(checker.finalize())
+        if stats is not None:
+            stats.setdefault("checkers", {})[cls.id] = {
+                "seconds": time.perf_counter() - t0,
+                "files_scanned": scanned,
+                "files_cached": 0,
+            }
     findings = [f for f in findings if not _suppressed(f, by_rel)]
+    if stats is not None:
+        stats["total_seconds"] = time.perf_counter() - t_start
+        stats["files"] = len(modules)
+        stats["files_changed"] = len(modules)
+        stats["files_removed"] = 0
     return sorted(findings, key=lambda f: (f.path, f.line, f.checker, f.key))
 
 
@@ -274,8 +309,11 @@ def checker_registry() -> Dict[str, type]:
         jit_purity,
         lock_order,
         no_print,
+        resource_leak,
+        retrace_hazard,
         sharding_consistency,
         thread_hazard,
+        wire_protocol,
     )
 
     checkers = (
@@ -289,30 +327,80 @@ def checker_registry() -> Dict[str, type]:
         host_sync.HostSyncChecker,
         collective_deadlock.CollectiveDeadlockChecker,
         thread_hazard.ThreadHazardChecker,
+        retrace_hazard.RetraceHazardChecker,
+        wire_protocol.WireProtocolChecker,
+        resource_leak.ResourceLeakChecker,
     )
     return {c.id: c for c in checkers}
 
 
 def changed_files(repo_root: str, ref: str) -> List[str]:
     """Absolute paths of .py files changed vs ``ref`` (tracked diff plus
-    untracked files) — the ``--changed-only`` dev-loop filter."""
+    untracked files) — the ``--changed-only`` dev-loop filter.
+
+    Uses ``--name-status --find-renames`` so a renamed file is scanned at
+    its NEW path (plain ``--name-only`` reports the old, now-nonexistent
+    path, silently dropping the file from the scan) and deletions are
+    skipped rather than failing the existence filter."""
     import subprocess
 
     out: List[str] = []
-    for cmd in (["git", "diff", "--name-only", ref, "--"],
-                ["git", "ls-files", "--others", "--exclude-standard"]):
-        try:
-            proc = subprocess.run(
-                cmd, cwd=repo_root, capture_output=True, text=True, timeout=30)
-        except (OSError, subprocess.TimeoutExpired):
-            continue
-        if proc.returncode != 0:
-            continue
+    try:
+        proc = subprocess.run(
+            ["git", "diff", "--name-status", "--find-renames", ref, "--"],
+            cwd=repo_root, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        proc = None
+    if proc is not None and proc.returncode == 0:
+        for line in proc.stdout.splitlines():
+            parts = [p.strip() for p in line.split("\t") if p.strip()]
+            if len(parts) < 2:
+                continue
+            status = parts[0]
+            if status.startswith("D"):
+                continue
+            # R<score>/C<score> rows are "status\told\tnew": scan the new path
+            path = parts[-1]
+            if path.endswith(".py"):
+                out.append(os.path.join(repo_root, path))
+    try:
+        proc = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=repo_root, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        proc = None
+    if proc is not None and proc.returncode == 0:
         for line in proc.stdout.splitlines():
             line = line.strip()
             if line.endswith(".py"):
                 out.append(os.path.join(repo_root, line))
     return sorted(set(p for p in out if os.path.exists(p)))
+
+
+def expand_with_dependents(
+    changed: Sequence[str], package_dir: str, repo_root: str,
+) -> List[str]:
+    """Grow a changed-file set with every package module whose cross-module
+    dependency edges reach it (reverse import closure): editing a module's
+    signature invalidates its importers' findings too, so the dev loop must
+    rescan them. Paths in and out are absolute; non-package files pass
+    through untouched."""
+    paths = iter_source_files(package_dir)
+    by_abs = {os.path.abspath(p): p for p in paths}
+    in_pkg = [by_abs[os.path.abspath(p)] for p in changed
+              if os.path.abspath(p) in by_abs]
+    if not in_pkg:
+        return sorted(set(changed))
+    from .project import build_graph
+    modules = [load_module(p, repo_root) for p in paths]
+    graph = build_graph(modules)
+    rels = {os.path.relpath(p, repo_root).replace(os.sep, "/") for p in in_pkg}
+    expanded_rels = graph.dependents_closure(rels)
+    out = set(changed)
+    for m in modules:
+        if m.relpath in expanded_rels:
+            out.add(m.path)
+    return sorted(out)
 
 
 def to_sarif(findings: Sequence[Finding], registry: Dict[str, type]) -> dict:
@@ -352,6 +440,28 @@ def to_sarif(findings: Sequence[Finding], registry: Dict[str, type]) -> dict:
     }
 
 
+def _print_stats(stats: dict, stream) -> None:
+    """Per-checker timing + cache hit rate, on stderr so machine-readable
+    stdout (json/sarif) stays clean."""
+    checkers = stats.get("checkers", {})
+    total_scanned = sum(c["files_scanned"] for c in checkers.values())
+    total_cached = sum(c["files_cached"] for c in checkers.values())
+    denom = total_scanned + total_cached
+    rate = (100.0 * total_cached / denom) if denom else 0.0
+    stream.write("graftcheck stats:\n")
+    for cid in sorted(checkers):
+        c = checkers[cid]
+        stream.write(
+            f"  {cid:<22} {c['seconds']*1000:8.1f} ms  "
+            f"scanned={c['files_scanned']:<4} cached={c['files_cached']}\n")
+    stream.write(
+        f"  total {stats.get('total_seconds', 0.0):.2f}s over "
+        f"{stats.get('files', 0)} file(s) "
+        f"({stats.get('files_changed', 0)} changed, "
+        f"{stats.get('files_removed', 0)} removed); "
+        f"cache hit rate {rate:.1f}%\n")
+
+
 def main(argv: Optional[Sequence[str]] = None) -> int:
     registry = checker_registry()
     parser = argparse.ArgumentParser(
@@ -381,12 +491,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         help="output format (--json is shorthand for "
                              "--format json; sarif emits SARIF 2.1.0 for "
                              "CI PR annotation)")
+    parser.add_argument("--cache", default=None, metavar="PATH",
+                        help="incremental result-cache file (default: "
+                             "<repo>/.graftcheck_cache.json)")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="ignore and do not write the result cache")
+    parser.add_argument("--stats", action="store_true",
+                        help="print per-checker timing and cache hit rate "
+                             "to stderr")
     ns = parser.parse_args(argv)
 
     repo_root = default_repo_root()
     package_dir = ns.root or os.path.join(repo_root, "fedml_tpu")
     baseline_path = ns.baseline or default_baseline_path(repo_root)
     ids = ns.checker or sorted(registry)
+    stats: Optional[dict] = {} if ns.stats else None
     only = None
     if ns.changed_only is not None:
         only = changed_files(repo_root, ns.changed_only)
@@ -394,6 +513,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             sys.stdout.write(
                 f"graftcheck: no .py files changed vs {ns.changed_only}\n")
             return 0
+        # a changed module invalidates findings in its importers too
+        # (retrace-hazard resolves jitted callables across modules), so the
+        # dev loop scans the reverse dependency closure, not just the diff
+        only = expand_with_dependents(only, package_dir, repo_root)
         # cross-file checkers false-positive on a partial scan (e.g.
         # config-drift would report every key whose read sites didn't
         # change as doc-only); the full run in CI keeps covering them
@@ -404,8 +527,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             sys.stdout.write(
                 "graftcheck: skipping whole-package checker(s) in "
                 f"--changed-only mode: {', '.join(skipped)}\n")
-    findings = run_checkers(
-        [registry[i] for i in ids], package_dir, repo_root, only=only)
+    # the result cache covers the canonical shape — every checker over the
+    # whole package; subset runs (--checker/--changed-only/--root file)
+    # would evict the other checkers' entries, so they bypass it
+    use_cache = (not ns.no_cache and only is None
+                 and ns.checker is None and os.path.isdir(package_dir))
+    if use_cache:
+        from .cache import default_cache_path, run_checkers_cached
+        cache_path = ns.cache or default_cache_path(repo_root)
+        findings = run_checkers_cached(
+            [registry[i] for i in ids], package_dir, repo_root,
+            cache_path, stats=stats)
+    else:
+        findings = run_checkers(
+            [registry[i] for i in ids], package_dir, repo_root, only=only,
+            stats=stats)
+    if stats is not None:
+        _print_stats(stats, sys.stderr)
 
     if ns.write_baseline:
         write_baseline(findings, baseline_path)

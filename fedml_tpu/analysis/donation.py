@@ -40,7 +40,10 @@ import ast
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from .core import Checker, Finding, Module, dotted_name
-from .jit_purity import _collect_functions, _walk_own_body
+from .project import (
+    collect_functions as _collect_functions,
+    walk_own_body as _walk_own_body,
+)
 
 DONATING_WRAPPERS = {"jit", "pjit"}
 

@@ -8,8 +8,9 @@ lesson: keep the round step device-resident, read back only at phase
 boundaries). These calls are invisible to correctness tests; they only
 show up as a flat profile on real hardware.
 
-The checker walks the same-module call graph (jit_purity's BFS: plain-name
-and ``self.method()`` edges, nested defs traced with their parent) from
+The checker walks the same-module call graph (the shared project-core BFS:
+plain-name and ``self.method()`` edges, nested defs traced with their
+parent) from
 the engine entry points — the simulation round loops
 (``fed_sim.run``/``_run_selfheal``/dispatch/deferred-readback planes),
 the multi-tenant driver (``multi_run.run``/``_worker``), and the
@@ -47,7 +48,12 @@ import ast
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
 from .core import Checker, Finding, Module, dotted_name
-from .jit_purity import _collect_functions, _is_ancestor, _walk_own_body
+from .project import (
+    by_simple_name,
+    collect_functions as _collect_functions,
+    local_reach,
+    walk_own_body as _walk_own_body,
+)
 
 # entry points per file; cross_silo/ additionally treats _on_* handlers
 # and the listed names as hot
@@ -133,9 +139,7 @@ class HostSyncChecker(Checker):
         is_cross_silo = module.relpath.startswith(CROSS_SILO_PREFIX)
         is_pallas = module.relpath.startswith(PALLAS_PREFIX)
         funcs = _collect_functions(module.tree)
-        by_simple: Dict[str, List] = {}
-        for f in funcs:
-            by_simple.setdefault(f.simple, []).append(f)
+        by_simple = by_simple_name(funcs)
 
         roots = []
         for f in funcs:
@@ -173,43 +177,12 @@ class HostSyncChecker(Checker):
     # ------------------------------------------------------ reachability
 
     def _reach(self, funcs, by_simple, roots) -> Dict[object, str]:
-        """jit_purity's BFS with a cold-plane cut: calls into
-        eval/checkpoint/build_* helpers are not followed."""
-        reachable: Dict[object, str] = {f: f"entry point {f.qualname}"
-                                        for f in roots}
-        nested_of: Dict[object, List] = {}
-        for f in funcs:
-            for g in funcs:
-                if g is not f and _is_ancestor(f.node, g.node):
-                    nested_of.setdefault(f, []).append(g)
-        work = list(roots)
-        while work:
-            cur = work.pop()
-            why = reachable[cur]
-            for child in nested_of.get(cur, ()):
-                if child not in reachable and not _is_cold(child.simple):
-                    reachable[child] = f"defined inside {cur.qualname}"
-                    work.append(child)
-            for node in _walk_own_body(cur.node):
-                if not isinstance(node, ast.Call):
-                    continue
-                name = None
-                if isinstance(node.func, ast.Name):
-                    name = node.func.id
-                elif isinstance(node.func, ast.Attribute) and \
-                        isinstance(node.func.value, ast.Name) and \
-                        node.func.value.id == "self":
-                    name = node.func.attr
-                if name is None or _is_cold(name):
-                    continue
-                for cand in by_simple.get(name, ()):
-                    if cand.cls is not None and cur.cls is not None \
-                            and cand.cls != cur.cls:
-                        continue
-                    if cand not in reachable:
-                        reachable[cand] = f"called from {cur.qualname}"
-                        work.append(cand)
-        return reachable
+        """The shared project.local_reach BFS with a cold-plane cut: calls
+        into eval/checkpoint/build_* helpers are not followed."""
+        return local_reach(
+            funcs, by_simple,
+            {f: f"entry point {f.qualname}" for f in roots},
+            skip=_is_cold)
 
     # ------------------------------------------------------------- sinks
 

@@ -30,6 +30,14 @@ from .core import (
     Module,
     dotted_name,
 )
+from .project import (
+    FuncInfo as _FuncInfo,
+    by_simple_name,
+    collect_functions as _collect_functions,
+    is_ancestor as _is_ancestor,
+    local_reach,
+    walk_own_body as _walk_own_body,
+)
 
 # wrapper callables whose function argument (or decorated function) is traced.
 # pallas_call is included: a Pallas kernel body is traced exactly like a jit
@@ -47,34 +55,10 @@ IMPURE_TIME = {"time.time", "time.time_ns", "time.perf_counter",
                "time.perf_counter_ns", "time.monotonic", "time.sleep",
                "datetime.now", "datetime.utcnow", "datetime.today"}
 
-
-def _is_ancestor(outer: ast.AST, inner: ast.AST) -> bool:
-    return any(n is inner for n in ast.walk(outer)) and outer is not inner
-
-
-def _walk_own_body(func_node: ast.AST):
-    """Walk a function body without descending into nested def/class scopes
-    (those are separate _FuncInfo entries, scanned on their own when
-    reachable). Lambdas stay in: they have no _FuncInfo of their own."""
-    stack = list(ast.iter_child_nodes(func_node))
-    while stack:
-        node = stack.pop()
-        yield node
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
-            continue
-        stack.extend(ast.iter_child_nodes(node))
-
-
-class _FuncInfo:
-    __slots__ = ("node", "qualname", "simple", "cls", "is_root", "root_why")
-
-    def __init__(self, node: ast.AST, qualname: str, simple: str, cls: Optional[str]):
-        self.node = node
-        self.qualname = qualname
-        self.simple = simple
-        self.cls = cls
-        self.is_root = False
-        self.root_why = ""
+# _FuncInfo/_collect_functions/_walk_own_body/_is_ancestor moved to
+# .project (the shared interprocedural core); the underscored aliases
+# imported above keep this module's historical surface for the checkers
+# that grew up importing them from here.
 
 
 def _is_jit_wrapper(node: ast.AST) -> bool:
@@ -94,24 +78,6 @@ def _is_jit_wrapper(node: ast.AST) -> bool:
     return False
 
 
-def _collect_functions(tree: ast.AST) -> List[_FuncInfo]:
-    funcs: List[_FuncInfo] = []
-
-    def walk(node: ast.AST, stack: List[str], cls: Optional[str]):
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                qual = ".".join(stack + [child.name])
-                funcs.append(_FuncInfo(child, qual, child.name, cls))
-                walk(child, stack + [child.name], cls)
-            elif isinstance(child, ast.ClassDef):
-                walk(child, stack + [child.name], child.name)
-            else:
-                walk(child, stack, cls)
-
-    walk(tree, [], None)
-    return funcs
-
-
 class JitPurityChecker(Checker):
     id = "jit-purity"
     description = ("impure calls (time/random/print/host-sync/global mutation) "
@@ -121,12 +87,14 @@ class JitPurityChecker(Checker):
         funcs = _collect_functions(module.tree)
         if not funcs:
             return []
-        by_simple: Dict[str, List[_FuncInfo]] = {}
-        for f in funcs:
-            by_simple.setdefault(f.simple, []).append(f)
+        by_simple = by_simple_name(funcs)
 
         self._mark_roots(module.tree, funcs, by_simple)
-        reachable = self._reach(funcs, by_simple)
+        reachable = local_reach(
+            funcs, by_simple,
+            {f: f.root_why for f in funcs if f.is_root},
+            why_nested=lambda cur, why: f"defined inside {cur.qualname} ({why})",
+            why_call=lambda cur, why: f"called from {cur.qualname} ({why})")
         findings: List[Finding] = []
         for info, why in reachable.items():
             findings.extend(self._scan_body(module, info, why))
@@ -188,47 +156,6 @@ class JitPurityChecker(Checker):
                     if isinstance(arg, (ast.Name, ast.Attribute)):
                         mark_target(arg, f"traced body of {fname}(...)")
                         break
-
-    # ------------------------------------------------------- reachability
-
-    def _reach(self, funcs: List[_FuncInfo],
-               by_simple: Dict[str, List[_FuncInfo]]) -> Dict[_FuncInfo, str]:
-        reachable: Dict[_FuncInfo, str] = {}
-        work = [f for f in funcs if f.is_root]
-        for f in work:
-            reachable[f] = f.root_why
-        nested_of: Dict[_FuncInfo, List[_FuncInfo]] = {}
-        for f in funcs:
-            for g in funcs:
-                if g is not f and _is_ancestor(f.node, g.node):
-                    nested_of.setdefault(f, []).append(g)
-        while work:
-            cur = work.pop()
-            why = reachable[cur]
-            # inner helpers defined inside a traced body are traced with it
-            for child in nested_of.get(cur, ()):
-                if child not in reachable:
-                    reachable[child] = f"defined inside {cur.qualname} ({why})"
-                    work.append(child)
-            for node in _walk_own_body(cur.node):
-                if not isinstance(node, ast.Call):
-                    continue
-                name = None
-                if isinstance(node.func, ast.Name):
-                    name = node.func.id
-                elif isinstance(node.func, ast.Attribute) and \
-                        isinstance(node.func.value, ast.Name) and \
-                        node.func.value.id == "self":
-                    name = node.func.attr
-                if name is None:
-                    continue
-                for cand in by_simple.get(name, ()):
-                    if cand.cls is not None and cur.cls is not None and cand.cls != cur.cls:
-                        continue
-                    if cand not in reachable:
-                        reachable[cand] = f"called from {cur.qualname} ({why})"
-                        work.append(cand)
-        return reachable
 
     # ---------------------------------------------------------- impurity
 

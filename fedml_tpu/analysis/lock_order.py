@@ -81,6 +81,9 @@ class LockOrderChecker(Checker):
     id = "lock-order"
     description = ("lock acquisition cycles and blocking calls (sleep/send/"
                    "serialize/socket) made while holding a lock")
+    # the cycle graph accumulates edges across every module, so per-file
+    # cached results cannot be stitched back together
+    cache_scope = "package"
 
     def __init__(self, ctx):
         super().__init__(ctx)

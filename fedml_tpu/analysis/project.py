@@ -1,0 +1,443 @@
+"""Project-wide interprocedural core shared by the graftcheck checkers.
+
+Before this module existed, three checkers (jit-purity, host-sync,
+donation-safety) each re-implemented the same same-module reachability
+walk: collect every function def, index by simple name, BFS over
+plain-name and ``self.method()`` call edges with nested defs inherited
+from their parent. That BFS now lives here once
+(:func:`collect_functions` / :func:`local_reach`), parameterized by the
+two knobs the checkers actually differ on — which names the walk refuses
+to enter (host-sync's cold-plane cut) and how the "why reachable" trail
+is worded (jit-purity threads the root cause through every hop).
+
+On top of it sits :class:`ProjectGraph`, the whole-package view built
+once per run and handed to every checker through ``Context.graph``:
+
+- **import resolution** — absolute and relative imports mapped to the
+  repo-relative path of the target module, symbol imports chased through
+  re-exports (``from .message import Message`` in a package
+  ``__init__``), so a checker can ask "what does ``trace_plane.CLOCK_KEY``
+  mean inside server_manager.py" and get the literal back;
+- **constants table** — every module-level and class-attribute binding of
+  a string/int literal, with aliases (``MSG_ARG_KEY_X =
+  Message.MSG_ARG_KEY_X``) resolved by reference, powering the
+  wire-protocol checker's cross-backend send/handler join;
+- **dependency closure** — direct imports, transitive import closure, and
+  the reverse closure (who would be invalidated if this file changed),
+  shared by the incremental cache and the ``--changed-only`` expansion;
+- **function resolution** — symbol-import chasing down to the defining
+  module's :class:`FuncInfo`, so retrace-hazard can see that a callable
+  imported from another module is a jit with ``static_argnums``.
+
+Checkers run on single-file fixtures (no package context) build a
+one-module graph on the fly via :func:`build_graph`; every lookup then
+degrades to same-module resolution, which is exactly the pre-v3
+behaviour.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import Module, dotted_name
+
+# --------------------------------------------------------------- functions
+
+
+class FuncInfo:
+    """One function def: AST node, dotted qualname, simple name, enclosing
+    class (nearest, if any), enclosing function (``owner``), and the root
+    marks jit-purity stamps on it."""
+
+    __slots__ = ("node", "qualname", "simple", "cls", "owner",
+                 "is_root", "root_why")
+
+    def __init__(self, node: ast.AST, qualname: str, simple: str,
+                 cls: Optional[str], owner: "Optional[FuncInfo]" = None):
+        self.node = node
+        self.qualname = qualname
+        self.simple = simple
+        self.cls = cls
+        self.owner = owner
+        self.is_root = False
+        self.root_why = ""
+
+
+def collect_functions(tree: ast.AST) -> List[FuncInfo]:
+    """Every function def in the module, in source order, with class and
+    enclosing-function context (classes nested in functions keep the
+    function as owner — containment, not lexical scope kind)."""
+    funcs: List[FuncInfo] = []
+
+    def walk(node: ast.AST, stack: List[str], cls: Optional[str],
+             owner: Optional[FuncInfo]):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = ".".join(stack + [child.name])
+                info = FuncInfo(child, qual, child.name, cls, owner)
+                funcs.append(info)
+                walk(child, stack + [child.name], cls, info)
+            elif isinstance(child, ast.ClassDef):
+                walk(child, stack + [child.name], child.name, owner)
+            else:
+                walk(child, stack, cls, owner)
+
+    walk(tree, [], None, None)
+    return funcs
+
+
+def by_simple_name(funcs: Sequence[FuncInfo]) -> Dict[str, List[FuncInfo]]:
+    out: Dict[str, List[FuncInfo]] = {}
+    for f in funcs:
+        out.setdefault(f.simple, []).append(f)
+    return out
+
+
+def walk_own_body(func_node: ast.AST):
+    """Walk a function body without descending into nested def/class scopes
+    (those are separate FuncInfo entries, scanned on their own when
+    reachable). Lambdas stay in: they have no FuncInfo of their own."""
+    stack = list(ast.iter_child_nodes(func_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def is_ancestor(outer: ast.AST, inner: ast.AST) -> bool:
+    return any(n is inner for n in ast.walk(outer)) and outer is not inner
+
+
+def nested_map(funcs: Sequence[FuncInfo]) -> Dict[FuncInfo, List[FuncInfo]]:
+    """ancestor -> [every function nested anywhere inside it], transitive,
+    in source order — the containment relation the reachability BFS uses
+    to pull inner helpers in with their parent."""
+    out: Dict[FuncInfo, List[FuncInfo]] = {}
+    for g in funcs:
+        p = g.owner
+        while p is not None:
+            out.setdefault(p, []).append(g)
+            p = p.owner
+    return out
+
+
+def call_edge_name(func_expr: ast.AST) -> Optional[str]:
+    """The callee name a same-module call edge can resolve: a plain name
+    (``helper(...)``) or a ``self.method(...)`` attribute."""
+    if isinstance(func_expr, ast.Name):
+        return func_expr.id
+    if isinstance(func_expr, ast.Attribute) and \
+            isinstance(func_expr.value, ast.Name) and \
+            func_expr.value.id == "self":
+        return func_expr.attr
+    return None
+
+
+def local_reach(
+    funcs: Sequence[FuncInfo],
+    by_simple: Dict[str, List[FuncInfo]],
+    roots: Dict[FuncInfo, str],
+    *,
+    skip: Optional[Callable[[str], bool]] = None,
+    why_nested: Callable[[FuncInfo, str], str] = (
+        lambda cur, why: f"defined inside {cur.qualname}"),
+    why_call: Callable[[FuncInfo, str], str] = (
+        lambda cur, why: f"called from {cur.qualname}"),
+) -> Dict[FuncInfo, str]:
+    """The shared same-module reachability BFS.
+
+    ``roots`` maps each entry function to its "why" string; the result maps
+    every reachable function to a why. Edges: functions nested inside a
+    reachable one (inherited with their parent), plain-name calls, and
+    ``self.method()`` calls resolved by simple name with the conservative
+    class-compatibility rule (a method of class A never resolves a call made
+    from class B). ``skip`` prunes both nested defs and call edges by simple
+    name — host-sync's cold-plane cut.
+    """
+    reachable: Dict[FuncInfo, str] = dict(roots)
+    nested_of = nested_map(funcs)
+    work = list(roots)
+    while work:
+        cur = work.pop()
+        why = reachable[cur]
+        for child in nested_of.get(cur, ()):
+            if child in reachable or (skip is not None and skip(child.simple)):
+                continue
+            reachable[child] = why_nested(cur, why)
+            work.append(child)
+        for node in walk_own_body(cur.node):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_edge_name(node.func)
+            if name is None or (skip is not None and skip(name)):
+                continue
+            for cand in by_simple.get(name, ()):
+                if cand.cls is not None and cur.cls is not None \
+                        and cand.cls != cur.cls:
+                    continue
+                if cand not in reachable:
+                    reachable[cand] = why_call(cur, why)
+                    work.append(cand)
+    return reachable
+
+
+def unwrap_partial(call: ast.Call) -> List[ast.AST]:
+    """Positional args of a ``functools.partial(...)`` call (however the
+    name is spelled), else []. The first one is the wrapped callable."""
+    fname = dotted_name(call.func)
+    if fname is not None and fname.split(".")[-1] == "partial":
+        return list(call.args)
+    return []
+
+
+# ------------------------------------------------------------------- graph
+
+
+class ImportEntry:
+    """One name bound by an import statement, resolved to a module inside
+    the scanned package. ``kind`` is "module" (``import x.y as z`` /
+    ``from pkg import mod``) or "symbol" (``from mod import name``, where
+    ``orig`` is the name inside the target module)."""
+
+    __slots__ = ("kind", "target", "orig")
+
+    def __init__(self, kind: str, target: str, orig: str = ""):
+        self.kind = kind
+        self.target = target
+        self.orig = orig
+
+
+class ModuleGraphInfo:
+    """Per-module slice of the project graph."""
+
+    __slots__ = ("relpath", "tree", "funcs", "by_simple", "imports",
+                 "constants", "aliases", "deps")
+
+    def __init__(self, relpath: str, tree: ast.AST):
+        self.relpath = relpath
+        self.tree = tree
+        self.funcs: List[FuncInfo] = collect_functions(tree)
+        self.by_simple: Dict[str, List[FuncInfo]] = by_simple_name(self.funcs)
+        self.imports: Dict[str, ImportEntry] = {}
+        # dotted local name ("NAME" or "Cls.NAME") -> (literal value, lineno)
+        self.constants: Dict[str, Tuple[object, int]] = {}
+        # dotted local name -> dotted expression it aliases (value is a
+        # Name/Attribute chain, e.g. MSG_ARG_KEY_X = Message.MSG_ARG_KEY_X)
+        self.aliases: Dict[str, str] = {}
+        self.deps: Set[str] = set()
+
+
+class ProjectGraph:
+    """Whole-package view: import-resolved modules, constants, functions,
+    and the dependency closures the cache and --changed-only share."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModuleGraphInfo] = {}
+        self._closure: Dict[str, Set[str]] = {}
+        self._rdeps: Optional[Dict[str, Set[str]]] = None
+
+    # -------------------------------------------------------- construction
+
+    @classmethod
+    def build(cls, modules: Iterable[Module]) -> "ProjectGraph":
+        graph = cls()
+        for mod in modules:
+            graph.modules[mod.relpath] = ModuleGraphInfo(mod.relpath, mod.tree)
+        for info in graph.modules.values():
+            graph._index_module(info)
+        return graph
+
+    def _module_for_dotted(self, parts: Sequence[str]) -> Optional[str]:
+        if not parts:
+            return None
+        base = "/".join(parts)
+        for cand in (base + ".py", base + "/__init__.py"):
+            if cand in self.modules:
+                return cand
+        return None
+
+    def _index_module(self, info: ModuleGraphInfo) -> None:
+        pkg_parts = info.relpath.split("/")[:-1]
+        if info.relpath.endswith("/__init__.py"):
+            # the module IS the package: relative imports resolve against it
+            pkg_parts = info.relpath.split("/")[:-1]
+
+        for node in ast.walk(info.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    target = self._module_for_dotted(alias.name.split("."))
+                    if target is None:
+                        continue
+                    bound = alias.asname or alias.name
+                    info.imports[bound] = ImportEntry("module", target)
+                    info.deps.add(target)
+            elif isinstance(node, ast.ImportFrom):
+                if node.level == 0:
+                    base = (node.module or "").split(".") if node.module else []
+                else:
+                    cut = len(pkg_parts) - (node.level - 1)
+                    if cut < 0:
+                        continue
+                    base = pkg_parts[:cut] + \
+                        ((node.module or "").split(".") if node.module else [])
+                base_mod = self._module_for_dotted(base)
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    bound = alias.asname or alias.name
+                    sub = self._module_for_dotted(base + alias.name.split("."))
+                    if sub is not None:
+                        info.imports[bound] = ImportEntry("module", sub)
+                        info.deps.add(sub)
+                    elif base_mod is not None:
+                        info.imports[bound] = ImportEntry(
+                            "symbol", base_mod, alias.name)
+                        info.deps.add(base_mod)
+
+        def record(target: ast.AST, value: ast.AST, cls: Optional[str],
+                   lineno: int) -> None:
+            if not isinstance(target, ast.Name):
+                return
+            local = target.id if cls is None else f"{cls}.{target.id}"
+            if isinstance(value, ast.Constant) and \
+                    isinstance(value.value, (str, int, float, bool)):
+                info.constants[local] = (value.value, lineno)
+            else:
+                ref = dotted_name(value)
+                if ref is not None:
+                    info.aliases[local] = ref
+
+        def walk_consts(node: ast.AST, cls: Optional[str]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    walk_consts(child, child.name)
+                elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                elif isinstance(child, ast.Assign):
+                    for t in child.targets:
+                        record(t, child.value, cls, child.lineno)
+                elif isinstance(child, ast.AnnAssign) and child.value is not None:
+                    record(child.target, child.value, cls, child.lineno)
+
+        walk_consts(info.tree, None)
+
+    # ---------------------------------------------------------- resolution
+
+    def resolve_constant(self, relpath: str, dotted: str,
+                         _seen: Optional[Set[Tuple[str, str]]] = None
+                         ) -> Optional[object]:
+        """The literal value a dotted name refers to from inside ``relpath``
+        — local constant, class attribute, alias chain, or import chase —
+        or None when it cannot be resolved statically."""
+        got = self.resolve_constant_site(relpath, dotted, _seen)
+        return got[0] if got is not None else None
+
+    def resolve_constant_site(self, relpath: str, dotted: str,
+                              _seen: Optional[Set[Tuple[str, str]]] = None
+                              ) -> Optional[Tuple[object, str, str]]:
+        """(value, defining-relpath, defining-local-name) for a dotted
+        constant reference, chasing aliases and imports with a cycle guard."""
+        info = self.modules.get(relpath)
+        if info is None:
+            return None
+        if _seen is None:
+            _seen = set()
+        if (relpath, dotted) in _seen:
+            return None
+        _seen.add((relpath, dotted))
+
+        if dotted in info.constants:
+            return info.constants[dotted][0], relpath, dotted
+        if dotted in info.aliases:
+            return self.resolve_constant_site(relpath, info.aliases[dotted], _seen)
+        # strip a leading "self." — class attributes read through instances
+        if dotted.startswith("self."):
+            rest = dotted[len("self."):]
+            for local in info.constants:
+                if local.endswith("." + rest):
+                    return info.constants[local][0], relpath, local
+        parts = dotted.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            head = ".".join(parts[:i])
+            entry = info.imports.get(head)
+            if entry is None:
+                continue
+            rest = ".".join(parts[i:])
+            if entry.kind == "module":
+                return self.resolve_constant_site(entry.target, rest, _seen)
+            target = entry.orig + ("." + rest if rest else "")
+            return self.resolve_constant_site(entry.target, target, _seen)
+        if len(parts) == 1:
+            entry = info.imports.get(parts[0])
+            if entry is not None and entry.kind == "symbol":
+                return self.resolve_constant_site(entry.target, entry.orig, _seen)
+        return None
+
+    def resolve_function(self, relpath: str, name: str,
+                         _seen: Optional[Set[Tuple[str, str]]] = None
+                         ) -> Optional[Tuple[str, FuncInfo]]:
+        """(defining-relpath, FuncInfo) for a plain callable name referenced
+        from ``relpath`` — local def first, then symbol-import chase."""
+        info = self.modules.get(relpath)
+        if info is None:
+            return None
+        if _seen is None:
+            _seen = set()
+        if (relpath, name) in _seen:
+            return None
+        _seen.add((relpath, name))
+        for cand in info.by_simple.get(name, ()):
+            if cand.cls is None and cand.owner is None:
+                return relpath, cand
+        entry = info.imports.get(name)
+        if entry is not None and entry.kind == "symbol":
+            return self.resolve_function(entry.target, entry.orig, _seen)
+        return None
+
+    # ------------------------------------------------------------ closures
+
+    def direct_deps(self, relpath: str) -> Set[str]:
+        info = self.modules.get(relpath)
+        return set(info.deps) if info is not None else set()
+
+    def import_closure(self, relpath: str) -> Set[str]:
+        """Transitive package-internal import closure, self included."""
+        cached = self._closure.get(relpath)
+        if cached is not None:
+            return cached
+        seen: Set[str] = set()
+        work = [relpath]
+        while work:
+            cur = work.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            work.extend(self.direct_deps(cur) - seen)
+        self._closure[relpath] = seen
+        return seen
+
+    def dependents_closure(self, relpaths: Iterable[str]) -> Set[str]:
+        """Every module whose import closure reaches any of ``relpaths`` —
+        the set a change to those files can invalidate (inputs included)."""
+        if self._rdeps is None:
+            rdeps: Dict[str, Set[str]] = {}
+            for rel, info in self.modules.items():
+                for dep in info.deps:
+                    rdeps.setdefault(dep, set()).add(rel)
+            self._rdeps = rdeps
+        seen: Set[str] = set()
+        work = list(relpaths)
+        while work:
+            cur = work.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            work.extend(self._rdeps.get(cur, set()) - seen)
+        return seen
+
+
+def build_graph(modules: Iterable[Module]) -> ProjectGraph:
+    return ProjectGraph.build(modules)

@@ -1,0 +1,292 @@
+"""resource-leak checker: threads, file handles, sockets, channels, spill.
+
+The framework's long-lived processes (the agent runner, the serving
+plane, the device-day driver) accumulate whatever each round leaks:
+
+- **threads** — a non-daemon thread started and never joined keeps the
+  process alive after ``run()`` returns and pins whatever its closure
+  captured. The PR 17 churn drill found exactly this class by hand; the
+  checker flags ``Thread``/``Timer`` constructions that are started but
+  neither ``daemon=True`` nor ``join()``ed nor handed to someone else
+  (stored on ``self``, appended to a pool, returned) to manage.
+- **file handles / sockets / grpc channels** — an ``open()``/
+  ``socket.socket()``/``grpc.insecure_channel()`` that is not used as a
+  context manager, never ``.close()``d in the function, and does not
+  escape (returned, stored on ``self``, passed along) leaks its fd on
+  every exit path; inline uses (``data = open(p).read()``) are the
+  classic shape. CPython's refcounting hides it locally and CI never notices —
+  fd exhaustion shows up after hours of rounds.
+- **arena spill files** — a :class:`ClientStateArena` constructed with
+  ``spill_dir=...`` writes ``client_{cid}.msgpack`` files as clients
+  overflow host capacity; a module that builds such an arena but never
+  calls ``.discard(...)`` has no reclaim edge, so permanently departed
+  clients' spill files accumulate for the life of the fleet (the exact
+  leak PR 17's ``discard`` fix closed).
+
+The escape analysis is deliberately conservative: anything that leaves
+the constructing function is assumed to be somebody else's lifecycle.
+What remains — a purely local resource with no join/close/with on any
+path — has no owner at all, which is never intentional. Known-deliberate
+sites (a lock file held for the process lifetime, a daemon-equivalent
+acceptor thread) carry inline ``# graftcheck: disable=resource-leak``
+suppressions with their rationale.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import Checker, Finding, Module, dotted_name
+from .project import FuncInfo, collect_functions, walk_own_body
+
+# constructor name (last dotted component) -> resource kind
+_THREAD_CTORS = {"Thread": "thread", "Timer": "timer"}
+_HANDLE_CTORS = {
+    "open": "file",
+    "socket": "socket",
+    "insecure_channel": "grpc-channel",
+    "secure_channel": "grpc-channel",
+}
+
+
+def _ctor_kind(call: ast.Call) -> Optional[str]:
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    parts = name.split(".")
+    last = parts[-1]
+    if last in _THREAD_CTORS:
+        # threading.Thread / Thread / Timer — but not SomeClass.Thread(...)
+        if len(parts) == 1 or parts[0] in ("threading",):
+            return _THREAD_CTORS[last]
+        return None
+    if last == "open" and len(parts) == 1:
+        return "file"
+    if last == "socket" and parts[0] == "socket":
+        return "socket"
+    if last in ("insecure_channel", "secure_channel") and parts[0] == "grpc":
+        return "grpc-channel"
+    return None
+
+
+def _has_kw_true(call: ast.Call, name: str) -> bool:
+    for kw in call.keywords:
+        if kw.arg == name and isinstance(kw.value, ast.Constant) \
+                and kw.value.value is True:
+            return True
+    return False
+
+
+class ResourceLeakChecker(Checker):
+    id = "resource-leak"
+    description = ("non-daemon threads started without join, files/sockets/"
+                   "grpc channels opened without with/close, and spill-dir "
+                   "arenas with no discard() reclaim edge")
+    cache_scope = "file"
+
+    def visit_module(self, module: Module) -> Iterable[Finding]:
+        funcs = collect_functions(module.tree)
+        findings: List[Finding] = []
+        for f in funcs:
+            findings.extend(self._scan_function(module, f))
+        findings.extend(self._scan_spill(module))
+        return findings
+
+    # -------------------------------------------------------- per function
+
+    def _scan_function(self, module: Module, f: FuncInfo) -> List[Finding]:
+        body = list(walk_own_body(f.node))
+        findings: List[Finding] = []
+
+        # resources opened as `with ...:` context managers are safe
+        with_exprs: Set[int] = set()
+        for n in body:
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    for sub in ast.walk(item.context_expr):
+                        with_exprs.add(id(sub))
+
+        ctors: List[Tuple[ast.Call, str]] = []
+        for n in body:
+            if isinstance(n, ast.Call) and id(n) not in with_exprs:
+                kind = _ctor_kind(n)
+                if kind is not None:
+                    ctors.append((n, kind))
+        if not ctors:
+            return findings
+
+        ctor_ids = {id(c) for c, _ in ctors}
+        bound: Dict[int, str] = {}       # id(ctor) -> local name
+        escaped: Set[int] = set()        # id(ctor) -> left the function
+
+        def value_roots(expr: ast.AST) -> List[ast.AST]:
+            """Expressions the assigned value can BE (through conditional
+            expressions and tuple packing) — a ctor nested deeper (method
+            receiver, call argument) is used, not stored."""
+            if isinstance(expr, ast.IfExp):
+                return value_roots(expr.body) + value_roots(expr.orelse)
+            if isinstance(expr, (ast.Tuple, ast.List)):
+                out: List[ast.AST] = []
+                for e in expr.elts:
+                    out.extend(value_roots(e))
+                return out
+            return [expr]
+
+        for n in body:
+            if isinstance(n, ast.Assign):
+                roots = [r for r in value_roots(n.value)
+                         if id(r) in ctor_ids]
+                if roots:
+                    plain = [t for t in n.targets if isinstance(t, ast.Name)]
+                    if plain and id(n.value) in ctor_ids:
+                        bound[id(n.value)] = plain[0].id
+                    else:
+                        # self.X / container slot / conditional store —
+                        # someone else's lifecycle now
+                        escaped.update(id(r) for r in roots)
+            elif isinstance(n, (ast.Return, ast.Yield)) and n.value is not None:
+                for sub in ast.walk(n.value):
+                    if id(sub) in ctor_ids:
+                        escaped.add(id(sub))
+            elif isinstance(n, ast.Call):
+                # ctor passed as an argument (incl. pool.append(Thread(...)))
+                for a in list(n.args) + [kw.value for kw in n.keywords]:
+                    for sub in ast.walk(a):
+                        if id(sub) in ctor_ids:
+                            escaped.add(id(sub))
+
+        # per-name facts over the whole function body
+        def name_facts(name: str) -> Dict[str, bool]:
+            facts = {"join": False, "close": False, "daemon": False,
+                     "escapes": False, "started": False}
+            for n in body:
+                if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                        and isinstance(n.func.value, ast.Name) \
+                        and n.func.value.id == name:
+                    if n.func.attr == "join":
+                        facts["join"] = True
+                    elif n.func.attr == "close":
+                        facts["close"] = True
+                    elif n.func.attr == "start":
+                        facts["started"] = True
+                    elif n.func.attr == "setDaemon":
+                        facts["daemon"] = True
+                if isinstance(n, ast.Assign):
+                    for t in n.targets:
+                        if isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id == name and t.attr == "daemon":
+                            facts["daemon"] = True
+                    # name re-exported: self.x = t / container[i] = t
+                    if any(isinstance(sub, ast.Name) and sub.id == name
+                           for sub in ast.walk(n.value)) and \
+                            not all(isinstance(t, ast.Name) for t in n.targets):
+                        facts["escapes"] = True
+                if isinstance(n, ast.Call):
+                    callee = n.func
+                    is_method_of_name = (
+                        isinstance(callee, ast.Attribute)
+                        and isinstance(callee.value, ast.Name)
+                        and callee.value.id == name)
+                    if not is_method_of_name:
+                        for a in list(n.args) + [kw.value for kw in n.keywords]:
+                            if any(isinstance(sub, ast.Name) and sub.id == name
+                                   for sub in ast.walk(a)):
+                                facts["escapes"] = True
+                if isinstance(n, (ast.Return, ast.Yield)) and n.value is not None:
+                    if any(isinstance(sub, ast.Name) and sub.id == name
+                           for sub in ast.walk(n.value)):
+                        facts["escapes"] = True
+            return facts
+
+        for ctor, kind in ctors:
+            if id(ctor) in escaped:
+                continue
+            name = bound.get(id(ctor))
+            if kind in ("thread", "timer"):
+                if _has_kw_true(ctor, "daemon"):
+                    continue
+                if name is None:
+                    # inline Thread(...).start() — no handle to join
+                    parent_started = any(
+                        isinstance(n, ast.Call)
+                        and isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "start" and n.func.value is ctor
+                        for n in body)
+                    if parent_started:
+                        findings.append(Finding(
+                            checker=self.id, path=module.relpath,
+                            line=ctor.lineno,
+                            message=(f"{kind} started inline in {f.qualname} "
+                                     "with no handle — it can neither be "
+                                     "joined nor daemonized; bind it and "
+                                     "join, or pass daemon=True"),
+                            key=f"{f.qualname}:thread-no-join:<inline>"))
+                    continue
+                facts = name_facts(name)
+                if facts["join"] or facts["daemon"] or facts["escapes"]:
+                    continue
+                if not facts["started"]:
+                    continue  # constructed but not started here: not a leak
+                findings.append(Finding(
+                    checker=self.id, path=module.relpath, line=ctor.lineno,
+                    message=(f"non-daemon {kind} '{name}' started in "
+                             f"{f.qualname} but never joined, daemonized, or "
+                             "handed off — it outlives the function and "
+                             "pins its closure; join it on every exit path "
+                             "or mark it daemon"),
+                    key=f"{f.qualname}:thread-no-join:{name}"))
+            else:
+                if name is None:
+                    findings.append(Finding(
+                        checker=self.id, path=module.relpath, line=ctor.lineno,
+                        message=(f"{kind} opened inline in {f.qualname} and "
+                                 "never closed — use a with-block so every "
+                                 "exit path releases it"),
+                        key=f"{f.qualname}:unclosed:{kind}:<inline>"))
+                    continue
+                facts = name_facts(name)
+                if facts["close"] or facts["escapes"]:
+                    continue
+                findings.append(Finding(
+                    checker=self.id, path=module.relpath, line=ctor.lineno,
+                    message=(f"{kind} '{name}' opened in {f.qualname} "
+                             "without with/close on any path — the "
+                             "descriptor leaks on every call; wrap it in a "
+                             "with-block or close it in a finally"),
+                    key=f"{f.qualname}:unclosed:{kind}:{name}"))
+        return findings
+
+    # ------------------------------------------------------------- spill
+
+    def _scan_spill(self, module: Module) -> List[Finding]:
+        """ClientStateArena(spill_dir=...) with no .discard( reclaim edge
+        anywhere in the module."""
+        has_discard = any(
+            isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+            and n.func.attr == "discard"
+            for n in ast.walk(module.tree))
+        if has_discard:
+            return []
+        findings: List[Finding] = []
+        for n in ast.walk(module.tree):
+            if not isinstance(n, ast.Call):
+                continue
+            name = (dotted_name(n.func) or "").split(".")[-1]
+            if name != "ClientStateArena":
+                continue
+            spill = next((kw for kw in n.keywords if kw.arg == "spill_dir"),
+                         None)
+            if spill is None or (isinstance(spill.value, ast.Constant)
+                                 and spill.value.value is None):
+                continue
+            findings.append(Finding(
+                checker=self.id, path=module.relpath, line=n.lineno,
+                message=("ClientStateArena constructed with spill_dir but "
+                         "this module never calls .discard(...) — "
+                         "permanently departed clients' spill files are "
+                         "never reclaimed and accumulate for the life of "
+                         "the fleet"),
+                key="spill-no-reclaim"))
+        return findings

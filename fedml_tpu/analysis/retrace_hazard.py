@@ -1,0 +1,461 @@
+"""retrace-hazard checker: jit-cache thrash that never fails a test.
+
+``jax.jit`` keys its compilation cache on the wrapper object plus the
+abstract signature of the call. Both are easy to churn silently:
+
+- **constructing the wrapper per iteration / per call** — every
+  ``jax.jit(f)`` expression is a *new* wrapper with an empty cache, so a
+  construction inside a loop (or inside a function called once per
+  round) retraces and recompiles on every single use. CPU tests pass;
+  on a TPU pod every round pays seconds of XLA compile.
+- **loop-varying static arguments** — a callable jitted with
+  ``static_argnums``/``static_argnames`` specializes per distinct static
+  value; feeding it the loop index (or an unhashable list/dict, which
+  raises outright) compiles one program per iteration.
+- **shape-derived Python values in call arguments** — ``len(batch)`` or
+  ``x.shape[0]`` flowing into a jitted call from inside a loop
+  re-specializes whenever the cohort/batch geometry varies; the classic
+  fix is padding to fixed buckets (which the engine's dispatch planes
+  already do — this checker keeps new call sites honest).
+- **scan-block bodies** — the PR 15 fused multi-round dispatch traces R
+  rounds into ONE ``lax.scan`` program; a jit wrapper constructed inside
+  the scanned body (or anything it calls) recompiles the entire fused
+  block, not one round. These sites are rooted through the same
+  ``lax.scan``/``fori_loop``/``while_loop`` callback detection host-sync
+  uses and flagged at error severity.
+
+Wrapper bindings are resolved through the shared project core: direct
+assignments (``self._step = jax.jit(...)``), builder returns (the
+``_build_round_step`` hop), ``@partial(jax.jit, static_argnums=...)``
+decorated defs, and symbol imports from other modules (the cross-module
+hop the per-module v2 checkers could not see).
+
+Builder/constructor scopes (``build_*``/``_build*``/``make_*``/
+``__init__``/``setup``) are exempt from the per-call rule — constructing
+a jit once at setup is the idiomatic pattern; storing the wrapper on
+``self``/a module global, or returning it, also counts as build-once.
+
+Suppress with ``# graftcheck: disable=retrace-hazard`` plus a rationale
+(e.g. the loop provably runs once per distinct static value).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import SEVERITY_WARNING, Checker, Finding, Module, dotted_name
+from .host_sync import _hof_body_names
+from .project import (
+    FuncInfo,
+    build_graph,
+    by_simple_name,
+    collect_functions,
+    local_reach,
+    walk_own_body,
+)
+
+# wrappers whose construction starts a fresh compilation cache
+CTOR_WRAPPERS = {"jit", "pjit", "pmap"}
+
+# enclosing-scope names where constructing a wrapper is build-once by design
+_BUILDER_PREFIXES = ("build_", "_build", "make_", "_make")
+_BUILDER_NAMES = {"__init__", "__post_init__", "setup"}
+
+
+class _StaticSpec:
+    """Where a jitted callable's static arguments live."""
+
+    __slots__ = ("argnums", "argnames")
+
+    def __init__(self, argnums: Tuple[int, ...] = (),
+                 argnames: Tuple[str, ...] = ()):
+        self.argnums = argnums
+        self.argnames = argnames
+
+
+def _ctor_call(node: ast.AST) -> Optional[ast.Call]:
+    """The jit/pjit/pmap constructor Call if ``node`` is one — directly or
+    through ``functools.partial(jax.jit, ...)`` — else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    fname = dotted_name(node.func) or ""
+    last = fname.split(".")[-1]
+    if last in CTOR_WRAPPERS:
+        return node
+    if last == "partial":
+        for a in node.args:
+            aname = dotted_name(a) or ""
+            if aname.split(".")[-1] in CTOR_WRAPPERS:
+                return node
+    return None
+
+
+def _static_spec(call: ast.Call) -> _StaticSpec:
+    argnums: Tuple[int, ...] = ()
+    argnames: Tuple[str, ...] = ()
+    for kw in call.keywords:
+        if kw.arg == "static_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                argnums = (v.value,)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                argnums = tuple(e.value for e in v.elts
+                                if isinstance(e, ast.Constant)
+                                and isinstance(e.value, int))
+        elif kw.arg == "static_argnames":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                argnames = (v.value,)
+            elif isinstance(v, (ast.Tuple, ast.List)):
+                argnames = tuple(e.value for e in v.elts
+                                 if isinstance(e, ast.Constant)
+                                 and isinstance(e.value, str))
+    return _StaticSpec(argnums, argnames)
+
+
+def _wrapped_name(ctor: ast.Call) -> str:
+    """Best-effort name of the function the constructor wraps, for keys."""
+    for a in ctor.args:
+        name = dotted_name(a)
+        if name is not None and name.split(".")[-1] not in CTOR_WRAPPERS \
+                and name.split(".")[-1] != "partial":
+            return name.split(".")[-1]
+        inner = _ctor_call(a) if isinstance(a, ast.Call) else None
+        if inner is not None and inner is not ctor:
+            got = _wrapped_name(inner)
+            if got != "jit":
+                return got
+    return "jit"
+
+
+def _name_set(target: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for sub in ast.walk(target):
+        if isinstance(sub, ast.Name):
+            out.add(sub.id)
+    return out
+
+
+def _contains_name(expr: ast.AST, names: Set[str]) -> bool:
+    return any(isinstance(sub, ast.Name) and sub.id in names
+               for sub in ast.walk(expr))
+
+
+def _shape_derived(expr: ast.AST) -> Optional[str]:
+    """'len(...)' / '.shape' if the expression derives a Python value from
+    an array's geometry, else None."""
+    for sub in ast.walk(expr):
+        if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) \
+                and sub.func.id == "len":
+            return "len(...)"
+        if isinstance(sub, ast.Attribute) and sub.attr == "shape":
+            return ".shape"
+    return None
+
+
+class RetraceHazardChecker(Checker):
+    id = "retrace-hazard"
+    description = ("jit/pjit wrappers constructed per loop iteration or per "
+                   "call, loop-varying/unhashable static_argnums, and "
+                   "shape-derived values re-specializing jitted calls — "
+                   "each one a silent recompile (a whole fused scan block "
+                   "inside PR 15 scan bodies)")
+    cache_scope = "file+deps"
+
+    def visit_module(self, module: Module) -> Iterable[Finding]:
+        graph = self.ctx.graph
+        if graph is None or module.relpath not in graph.modules:
+            graph = build_graph([module])
+        funcs = collect_functions(module.tree)
+        by_simple = by_simple_name(funcs)
+
+        hof_bodies = _hof_body_names(module.tree)
+        scan_roots = {f: f"lax-control-flow callback {f.qualname}"
+                      for f in funcs if f.simple in hof_bodies}
+        in_scan: Set[FuncInfo] = set(
+            local_reach(funcs, by_simple, scan_roots)) if scan_roots else set()
+
+        self._module = module
+        self._graph = graph
+        self._jitted = self._jitted_bindings(module, graph, funcs)
+        self._findings: List[Finding] = []
+        self._flagged_ctors: Set[ast.Call] = set()
+
+        # loop-context walk over every scope: each function, plus module level
+        for f in funcs:
+            self._walk_scope(f.node, f.qualname, f in in_scan)
+        self._walk_scope(module.tree, "<module>", False)
+
+        # per-call construction pass (function scopes only)
+        for f in funcs:
+            if f in in_scan:
+                continue  # already error-flagged as scan-body sites
+            if f.simple.startswith(_BUILDER_PREFIXES) or \
+                    f.simple in _BUILDER_NAMES:
+                continue
+            self._per_call_pass(f)
+        return self._findings
+
+    # ------------------------------------------------------------- helpers
+
+    def _add(self, node: ast.AST, key: str, message: str,
+             severity: str = "error") -> None:
+        self._findings.append(Finding(
+            checker=self.id, path=self._module.relpath,
+            line=getattr(node, "lineno", 1), message=message, key=key,
+            severity=severity))
+
+    # -------------------------------------------------- jitted-callable map
+
+    def _jitted_bindings(self, module: Module, graph,
+                         funcs: Sequence[FuncInfo]) -> Dict[str, _StaticSpec]:
+        """callable path ('step', 'self._step', 'Cls.step') -> static spec,
+        for every binding this module can call."""
+        jitted: Dict[str, _StaticSpec] = {}
+
+        # builders whose return value is a jit construction
+        builder_spec: Dict[str, _StaticSpec] = {}
+        for f in funcs:
+            for node in walk_own_body(f.node):
+                if isinstance(node, ast.Return) and node.value is not None:
+                    ctor = _ctor_call(node.value)
+                    if ctor is not None:
+                        builder_spec[f.simple] = _static_spec(ctor)
+
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            spec: Optional[_StaticSpec] = None
+            if isinstance(node.value, ast.Call):
+                ctor = _ctor_call(node.value)
+                if ctor is not None:
+                    spec = _static_spec(ctor)
+                else:
+                    callee = (dotted_name(node.value.func) or "").split(".")[-1]
+                    spec = builder_spec.get(callee)
+            if spec is None:
+                continue
+            for t in node.targets:
+                path = dotted_name(t)
+                if path:
+                    jitted[path] = spec
+
+        for f in funcs:
+            for deco in getattr(f.node, "decorator_list", ()):
+                ctor = _ctor_call(deco)
+                if ctor is None:
+                    name = dotted_name(deco) or ""
+                    if name.split(".")[-1] in CTOR_WRAPPERS:
+                        jitted.setdefault(f.simple, _StaticSpec())
+                        jitted.setdefault(f"self.{f.simple}", _StaticSpec())
+                    continue
+                spec = _static_spec(ctor)
+                jitted[f.simple] = spec
+                jitted[f"self.{f.simple}"] = spec
+        return jitted
+
+    def _lookup_jitted(self, call: ast.Call) -> Optional[Tuple[str, _StaticSpec]]:
+        path = dotted_name(call.func)
+        if path is None:
+            return None
+        spec = self._jitted.get(path)
+        if spec is not None:
+            return path.split(".")[-1], spec
+        # cross-module hop: a plain name imported from the defining module
+        if "." not in path:
+            resolved = self._graph.resolve_function(self._module.relpath, path)
+            if resolved is not None:
+                rel, info = resolved
+                for deco in getattr(info.node, "decorator_list", ()):
+                    ctor = _ctor_call(deco)
+                    if ctor is not None:
+                        return path, _static_spec(ctor)
+                    name = dotted_name(deco) or ""
+                    if name.split(".")[-1] in CTOR_WRAPPERS:
+                        return path, _StaticSpec()
+        return None
+
+    # -------------------------------------------------- loop-context walk
+
+    def _walk_scope(self, scope_node: ast.AST, qual: str,
+                    in_scan: bool) -> None:
+        def visit(node: ast.AST, loops: List[Set[str]]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                return  # nested scopes get their own walk
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                visit(node.iter, loops)
+                body_loops = loops + [_name_set(node.target)]
+                for child in node.body + node.orelse:
+                    visit(child, body_loops)
+                return
+            if isinstance(node, ast.While):
+                visit(node.test, loops)
+                body_loops = loops + [set()]
+                for child in node.body + node.orelse:
+                    visit(child, body_loops)
+                return
+            if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                 ast.GeneratorExp)):
+                targets: Set[str] = set()
+                for gen in node.generators:
+                    targets |= _name_set(gen.target)
+                for child in ast.iter_child_nodes(node):
+                    visit(child, loops + [targets])
+                return
+            if isinstance(node, ast.Call):
+                self._check_call(node, qual, loops, in_scan)
+            for child in ast.iter_child_nodes(node):
+                visit(child, loops)
+
+        for child in ast.iter_child_nodes(scope_node):
+            visit(child, [])
+
+    def _check_call(self, call: ast.Call, qual: str,
+                    loops: List[Set[str]], in_scan: bool) -> None:
+        ctor = _ctor_call(call)
+        if ctor is not None and (call.args or call.keywords):
+            wrapped = _wrapped_name(ctor)
+            if in_scan:
+                self._flagged_ctors.add(call)
+                self._add(call, f"{qual}:scan-body-jit:{wrapped}",
+                          f"jit wrapper for '{wrapped}' constructed inside a "
+                          f"lax.scan/fori_loop/while_loop body ({qual}) — a "
+                          "fresh wrapper retraces on every use, and one "
+                          "retrace here recompiles the entire fused "
+                          "multi-round block")
+                return
+            if loops:
+                self._flagged_ctors.add(call)
+                self._add(call, f"{qual}:jit-in-loop:{wrapped}",
+                          f"jit wrapper for '{wrapped}' constructed inside a "
+                          f"loop in {qual} — every iteration starts with an "
+                          "empty compilation cache; hoist the jit to "
+                          "build-once scope")
+            return
+
+        looked = self._lookup_jitted(call)
+        if looked is None:
+            return
+        callee, spec = looked
+        loop_names: Set[str] = set()
+        for s in loops:
+            loop_names |= s
+
+        static_args: List[Tuple[str, ast.AST]] = []
+        for i in spec.argnums:
+            if i < len(call.args):
+                static_args.append((str(i), call.args[i]))
+        for kw in call.keywords:
+            if kw.arg in spec.argnames:
+                static_args.append((kw.arg, kw.value))
+
+        for label, expr in static_args:
+            if isinstance(expr, (ast.List, ast.Dict, ast.Set)):
+                self._add(expr, f"{qual}:unhashable-static:{callee}:{label}",
+                          f"unhashable {type(expr).__name__.lower()} literal "
+                          f"passed at static position {label} of jitted "
+                          f"'{callee}' — static args must hash; this raises "
+                          "at runtime on the first call")
+            elif loops and _contains_name(expr, loop_names):
+                self._add(expr, f"{qual}:static-loop-varying:{callee}:{label}",
+                          f"loop-varying value passed at static position "
+                          f"{label} of jitted '{callee}' in {qual} — every "
+                          "distinct static value compiles a new program; "
+                          "make the argument traced or hoist it out of the "
+                          "loop")
+
+        if loops:
+            static_exprs = {id(e) for _, e in static_args}
+            for arg in list(call.args) + [kw.value for kw in call.keywords]:
+                if id(arg) in static_exprs:
+                    continue
+                derived = _shape_derived(arg)
+                if derived is not None:
+                    self._add(arg, f"{qual}:shape-flow:{callee}",
+                              f"{derived} flows into a call of jitted "
+                              f"'{callee}' inside a loop in {qual} — "
+                              "geometry-derived Python values re-specialize "
+                              "the trace whenever the shape varies; pad to "
+                              "fixed buckets or pass device values",
+                              severity=SEVERITY_WARNING)
+                    break
+
+    # ----------------------------------------------------- per-call pass
+
+    def _per_call_pass(self, f: FuncInfo) -> None:
+        body = list(walk_own_body(f.node))
+        ctors: List[ast.Call] = []
+        for node in body:
+            if isinstance(node, ast.Call):
+                ctor = _ctor_call(node)
+                if ctor is not None and (node.args or node.keywords) \
+                        and node not in self._flagged_ctors:
+                    ctors.append(node)
+        if not ctors:
+            return
+        ctor_set = {id(c) for c in ctors}
+        consumed: Set[int] = set()
+        bound: Dict[str, ast.Call] = {}
+
+        for node in body:
+            if isinstance(node, ast.Assign) and id(node.value) in ctor_set:
+                consumed.add(id(node.value))
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        bound[t.id] = node.value
+                    # self.X / subscript targets: escapes to build-once
+                    # storage, not a per-call hazard
+            elif isinstance(node, (ast.Return, ast.Yield)) and \
+                    node.value is not None and id(node.value) in ctor_set:
+                consumed.add(id(node.value))  # builder-return pattern
+            elif isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Call) and id(node.func) in ctor_set:
+                    consumed.add(id(node.func))
+                    wrapped = _wrapped_name(node.func)
+                    self._add(node, f"{f.qualname}:per-call-jit:{wrapped}",
+                              f"jit wrapper for '{wrapped}' constructed and "
+                              f"invoked inline in {f.qualname} — every call "
+                              "of the enclosing function retraces and "
+                              "recompiles; build the jit once and reuse it")
+                for sub in list(node.args) + [kw.value for kw in node.keywords]:
+                    if id(sub) in ctor_set:
+                        consumed.add(id(sub))  # escapes as an argument
+
+        for name, ctor in bound.items():
+            invoked = escaped = False
+            for node in body:
+                if isinstance(node, ast.Call):
+                    if isinstance(node.func, ast.Name) and node.func.id == name:
+                        invoked = True
+                    if any(isinstance(sub, ast.Name) and sub.id == name
+                           for a in list(node.args) +
+                           [kw.value for kw in node.keywords]
+                           for sub in ast.walk(a)):
+                        escaped = True
+                elif isinstance(node, (ast.Return, ast.Yield)) and \
+                        node.value is not None and \
+                        _contains_name(node.value, {name}):
+                    escaped = True
+                elif isinstance(node, ast.Assign) and node.value is not ctor \
+                        and any(not isinstance(t, ast.Name) and
+                                _contains_name(t, {name}) or
+                                _contains_name(node.value, {name})
+                                for t in node.targets):
+                    escaped = True
+            if invoked:
+                wrapped = _wrapped_name(ctor)
+                self._add(ctor, f"{f.qualname}:per-call-jit:{wrapped}",
+                          f"jit wrapper for '{wrapped}' constructed per call "
+                          f"in {f.qualname} (bound to '{name}') — the "
+                          "compilation cache is thrown away when the "
+                          "function returns; build it once in a "
+                          "builder/__init__ and reuse it")
+            elif not escaped:
+                wrapped = _wrapped_name(ctor)
+                self._add(ctor, f"{f.qualname}:per-call-jit:{wrapped}",
+                          f"jit wrapper for '{wrapped}' constructed in "
+                          f"{f.qualname} and discarded without escaping — "
+                          "dead construction; hoist or remove it",
+                          severity=SEVERITY_WARNING)

@@ -69,6 +69,9 @@ class ShardingConsistencyChecker(Checker):
     description = ("PartitionSpec axis names not declared by any reachable "
                    "mesh constructor or the canonical parallel/mesh.py axes; "
                    "hand-rolled spec pytrees that bypass auto_partition_specs")
+    # per-file findings, but the canonical axis vocabulary is read from
+    # mesh.py — an axis rename there must invalidate every cached file
+    cache_extra_files = ("fedml_tpu/parallel/mesh.py",)
 
     def __init__(self, ctx):
         super().__init__(ctx)

@@ -1,0 +1,504 @@
+"""wire-protocol checker: send/handler/key conformance across backends.
+
+The four comm backends (loopback/grpc/mqtt_s3/trpc) move ``Message``
+frames between managers whose FSMs agree only by convention: a sender
+stamps ``MSG_ARG_KEY_*`` params, a receiving manager registers a handler
+per ``MSG_TYPE_*`` and ``get()``s the keys back out. Nothing ties the two
+sides together — a renamed key, a type nobody handles, or a raw string
+literal drifting from the constant it shadows ships silently and drops
+messages at runtime (the exact cross-backend divergence arxiv 2604.10859
+measures dynamically; this checker proves it statically, per commit).
+
+Built on the project graph (whole-package, ``whole_package_only``), the
+checker joins four record streams collected from every module:
+
+- **sends** — each ``Message(<type>, ...)`` construction, its type
+  resolved through constants/imports, plus every ``var.add_params(key,
+  ...)`` stamped on that construction in the same function;
+- **handlers** — ``register_message_receive_handler(TYPE, handler)``
+  registrations AND ``msg.get_type() == TYPE`` drain-side comparisons
+  (the device-day check-in queue idiom), each with the keys the handler
+  body ``get()``s — following the message object through same-class /
+  same-module helper calls (the async ``MODEL_VERSION`` staleness echo
+  is read two hops into the server FSM);
+- **global stamps** — ``add_params`` on a message that was *received*
+  (``Message.from_bytes`` rehydration, trace-plane helpers stamping a
+  caller's message): these enrich messages of every type;
+- **wire constants** — every ``MSG_TYPE_*``/``MSG_ARG_KEY_*`` literal
+  definition, for the duplicate-definition rule.
+
+Rules:
+
+- ``unhandled-send`` (error): a sent type with no registered handler and
+  no drain-side ``get_type()`` check anywhere in the package.
+- ``unstamped-key`` (error): a key a handler ``get()``s with no default,
+  not stamped by any sender of that handler's type(s), by a global
+  stamp, or auto-stamped by ``Message.__init__``
+  (msg_type/sender/receiver/operation). Types that are handled but never
+  sent in-package are skipped — there is no sender to validate against.
+- ``raw-literal`` (warning): a string/int literal in a type/key position
+  whose value shadows a named wire constant — use the constant.
+- ``dup-constant`` (warning): the same ``MSG_TYPE_*``/``MSG_ARG_KEY_*``
+  name bound to the same literal in two modules — alias one to the
+  other so the values cannot drift apart.
+
+Suppress with ``# graftcheck: disable=wire-protocol`` plus a rationale
+(e.g. a transport harness that drives sockets below the dispatch layer).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import SEVERITY_WARNING, Checker, Finding, Module, dotted_name
+from .project import (
+    FuncInfo,
+    ProjectGraph,
+    build_graph,
+    by_simple_name,
+    call_edge_name,
+    collect_functions,
+    walk_own_body,
+)
+
+# stamped by Message.__init__ on every construction
+AUTO_KEYS = {"msg_type", "sender", "receiver", "operation"}
+
+# constant-name shapes that form the wire vocabulary
+_WIRE_NAME_RE = re.compile(
+    r"^(MSG_TYPE_|MSG_ARG_KEY_|MSG_CLIENT_STATUS_|ARG_)|_KEY$")
+# subset subject to the duplicate-definition rule (the namespaces that are
+# supposed to have exactly one home)
+_DUP_NAME_RE = re.compile(r"^(MSG_TYPE_|MSG_ARG_KEY_)")
+
+_MAX_HOPS = 4  # message-object propagation depth through helper calls
+
+
+class _Send:
+    __slots__ = ("relpath", "line", "type_value", "type_name", "keys")
+
+    def __init__(self, relpath: str, line: int, type_value, type_name: str):
+        self.relpath = relpath
+        self.line = line
+        self.type_value = type_value
+        self.type_name = type_name
+        self.keys: Set[object] = set()
+
+
+class _Read:
+    __slots__ = ("relpath", "line", "key_value", "key_name", "required")
+
+    def __init__(self, relpath: str, line: int, key_value, key_name: str,
+                 required: bool):
+        self.relpath = relpath
+        self.line = line
+        self.key_value = key_value
+        self.key_name = key_name
+        self.required = required
+
+
+class _Handler:
+    """One (types, body) handling site: a registration or a drain-side
+    get_type() comparison, with the keys its body reads."""
+
+    __slots__ = ("relpath", "line", "type_values", "type_names", "reads")
+
+    def __init__(self, relpath: str, line: int):
+        self.relpath = relpath
+        self.line = line
+        self.type_values: List[object] = []
+        self.type_names: List[str] = []
+        self.reads: List[_Read] = []
+
+
+class WireProtocolChecker(Checker):
+    id = "wire-protocol"
+    description = ("Message send/handler conformance across comm backends: "
+                   "sent MSG_TYPE_* must be handled, handler-read "
+                   "MSG_ARG_KEY_* must be stamped by a sender of that type, "
+                   "raw literals must not shadow wire constants")
+    whole_package_only = True
+    cache_scope = "package"
+
+    def __init__(self, ctx):
+        super().__init__(ctx)
+        self._modules: List[Module] = []
+
+    def interested(self, relpath: str) -> bool:
+        return True
+
+    def visit_module(self, module: Module) -> Iterable[Finding]:
+        self._modules.append(module)
+        return ()
+
+    # ------------------------------------------------------------ finalize
+
+    def finalize(self) -> Iterable[Finding]:
+        if not self._modules:
+            return ()
+        graph = self.ctx.graph
+        if graph is None or any(m.relpath not in graph.modules
+                                for m in self._modules):
+            graph = build_graph(self._modules)
+        self._graph = graph
+
+        sends: List[_Send] = []
+        handlers: List[_Handler] = []
+        global_stamps: Set[object] = set()
+        raw_findings: List[Finding] = []
+
+        shadowed = self._wire_values(graph)
+
+        for module in self._modules:
+            self._scan_module(module, graph, sends, handlers,
+                              global_stamps, raw_findings, shadowed)
+
+        findings: List[Finding] = list(raw_findings)
+        findings.extend(self._dup_constant_findings(graph))
+
+        handled_values = {v for h in handlers for v in h.type_values}
+        for send in sends:
+            if send.type_value not in handled_values:
+                findings.append(Finding(
+                    checker=self.id, path=send.relpath, line=send.line,
+                    message=(f"message type {send.type_name} is sent here "
+                             "but no manager registers a handler for it and "
+                             "no drain checks get_type() against it — the "
+                             "receive side logs 'no handler' and drops it"),
+                    key=f"unhandled-send:{send.type_name}"))
+
+        sent_types = {s.type_value for s in sends}
+        stamps_by_type: Dict[object, Set[object]] = {}
+        for send in sends:
+            stamps_by_type.setdefault(send.type_value, set()).update(send.keys)
+
+        for h in handlers:
+            # only validate against types that are actually sent in-package;
+            # a handler for an unsent type has no sender to check
+            live = [t for t in h.type_values if t in sent_types]
+            if not live:
+                continue
+            stamped: Set[object] = set(global_stamps)
+            for t in live:
+                stamped |= stamps_by_type.get(t, set())
+            names = "/".join(
+                n for n, v in zip(h.type_names, h.type_values) if v in sent_types)
+            for read in h.reads:
+                if not read.required:
+                    continue
+                if read.key_value in stamped or read.key_value in AUTO_KEYS:
+                    continue
+                findings.append(Finding(
+                    checker=self.id, path=read.relpath, line=read.line,
+                    message=(f"handler for {names} reads key "
+                             f"{read.key_name} with no default, but no "
+                             "sender of that type stamps it — the read "
+                             "returns None at runtime"),
+                    key=f"unstamped-key:{names}:{read.key_name}"))
+        return findings
+
+    # ----------------------------------------------------- constants rules
+
+    def _wire_values(self, graph: ProjectGraph) -> Dict[object, str]:
+        """literal value -> canonical constant name, for the raw-literal
+        shadow rule."""
+        out: Dict[object, str] = {}
+        for rel in sorted(graph.modules):
+            for local, (value, _line) in graph.modules[rel].constants.items():
+                bare = local.split(".")[-1]
+                if _WIRE_NAME_RE.search(bare):
+                    out.setdefault(value, bare)
+        return out
+
+    def _dup_constant_findings(self, graph: ProjectGraph) -> List[Finding]:
+        sites: Dict[Tuple[str, object], List[Tuple[str, str, int]]] = {}
+        for rel in sorted(graph.modules):
+            for local, (value, line) in graph.modules[rel].constants.items():
+                bare = local.split(".")[-1]
+                if _DUP_NAME_RE.match(bare):
+                    sites.setdefault((bare, value), []).append((rel, local, line))
+        findings: List[Finding] = []
+        for (bare, value), defs in sorted(sites.items(),
+                                          key=lambda kv: kv[0][0]):
+            if len(defs) < 2:
+                continue
+            defs.sort()
+            canonical = defs[0]
+            for rel, local, line in defs[1:]:
+                findings.append(Finding(
+                    checker=self.id, path=rel, line=line,
+                    message=(f"wire constant {local} = {value!r} duplicates "
+                             f"{canonical[1]} in {canonical[0]} — import or "
+                             "alias the canonical definition so the values "
+                             "cannot drift apart"),
+                    key=f"dup-constant:{bare}",
+                    severity=SEVERITY_WARNING))
+        return findings
+
+    # ------------------------------------------------------- module scan
+
+    def _scan_module(self, module: Module, graph: ProjectGraph,
+                     sends: List[_Send], handlers: List[_Handler],
+                     global_stamps: Set[object],
+                     raw_findings: List[Finding],
+                     shadowed: Dict[object, str]) -> None:
+        rel = module.relpath
+        info = graph.modules.get(rel)
+        funcs = info.funcs if info is not None else collect_functions(module.tree)
+        by_simple = (info.by_simple if info is not None
+                     else by_simple_name(funcs))
+        self._by_simple = by_simple
+
+        def resolve(expr: ast.AST) -> Tuple[Optional[object], str, bool]:
+            """(value, display name, is-literal) for a type/key expression."""
+            if isinstance(expr, ast.Constant) and \
+                    isinstance(expr.value, (str, int)):
+                return expr.value, repr(expr.value), True
+            name = dotted_name(expr)
+            if name is None:
+                return None, "", False
+            site = graph.resolve_constant_site(rel, name)
+            if site is None:
+                return None, "", False
+            value, _def_rel, def_local = site
+            return value, def_local.split(".")[-1], False
+
+        def note_raw(expr: ast.AST, value: object, where: str) -> None:
+            canonical = shadowed.get(value)
+            if canonical is None:
+                return
+            raw_findings.append(Finding(
+                checker=self.id, path=rel,
+                line=getattr(expr, "lineno", 1),
+                message=(f"raw literal {value!r} in a {where} position "
+                         f"shadows the wire constant {canonical} — use the "
+                         "constant so renames cannot strand this site"),
+                key=f"raw-literal:{where}:{value!r}",
+                severity=SEVERITY_WARNING))
+
+        # ---- per-function: sends + receiver-var tracking + drain checks
+        scopes: List[Tuple[str, ast.AST, Optional[FuncInfo]]] = [
+            ("<module>", module.tree, None)]
+        for f in funcs:
+            scopes.append((f.qualname, f.node, f))
+
+        for qual, node, finfo in scopes:
+            self._scan_scope(module, qual, node, finfo, resolve, note_raw,
+                             sends, handlers, global_stamps)
+
+        # ---- registrations (may appear anywhere, incl. nested in scopes)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if not (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "register_message_receive_handler"):
+                continue
+            if not node.args:
+                continue
+            tval, tname, tlit = resolve(node.args[0])
+            if tlit:
+                note_raw(node.args[0], tval, "handler-registration")
+            if tval is None:
+                continue
+            handler = _Handler(rel, node.lineno)
+            handler.type_values.append(tval)
+            handler.type_names.append(tname or repr(tval))
+            if len(node.args) > 1:
+                handler.reads = self._handler_body_reads(
+                    module, node.args[1], resolve, note_raw)
+            handlers.append(handler)
+
+    def _scan_scope(self, module: Module, qual: str, node: ast.AST,
+                    finfo: Optional[FuncInfo], resolve, note_raw,
+                    sends: List[_Send], handlers: List[_Handler],
+                    global_stamps: Set[object]) -> None:
+        rel = module.relpath
+        if finfo is not None:
+            body = list(walk_own_body(node))
+        else:
+            # module scope: only statements outside any def
+            body = []
+            stack = list(ast.iter_child_nodes(node))
+            while stack:
+                n = stack.pop()
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                body.append(n)
+                stack.extend(ast.iter_child_nodes(n))
+
+        constructed: Dict[str, _Send] = {}   # local var -> send record
+
+        for n in body:
+            if isinstance(n, ast.Call):
+                callee = dotted_name(n.func) or ""
+                last = callee.split(".")[-1]
+                if last == "Message" and (n.args or n.keywords):
+                    type_expr = None
+                    if n.args:
+                        type_expr = n.args[0]
+                    for kw in n.keywords:
+                        if kw.arg == "type":
+                            type_expr = kw.value
+                    if type_expr is None:
+                        continue
+                    tval, tname, tlit = resolve(type_expr)
+                    if tlit:
+                        note_raw(type_expr, tval, "message-type")
+                    if tval is None:
+                        continue
+                    send = _Send(rel, n.lineno, tval, tname or repr(tval))
+                    sends.append(send)
+                    self._bind_send(body, n, send, constructed)
+
+        # add_params stamping
+        for n in body:
+            if not (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "add_params" and n.args):
+                continue
+            kval, kname, klit = resolve(n.args[0])
+            if klit:
+                note_raw(n.args[0], kval, "add-params-key")
+            if kval is None:
+                continue
+            owner = dotted_name(n.func.value)
+            if owner is not None and owner in constructed:
+                constructed[owner].keys.add(kval)
+            else:
+                # stamping a message that was received or passed in — it
+                # enriches frames of any type (trace-plane idiom)
+                global_stamps.add(kval)
+
+        # drain-side get_type() comparisons: handling evidence, with the
+        # enclosing scope as the handler body
+        for n in body:
+            if not isinstance(n, ast.Compare):
+                continue
+            left = n.left
+            if not (isinstance(left, ast.Call)
+                    and isinstance(left.func, ast.Attribute)
+                    and left.func.attr == "get_type"):
+                continue
+            msg_var = dotted_name(left.func.value)
+            handler = _Handler(rel, n.lineno)
+            for comp in n.comparators:
+                elems = comp.elts if isinstance(comp, (ast.Tuple, ast.List)) \
+                    else [comp]
+                for e in elems:
+                    tval, tname, tlit = resolve(e)
+                    if tlit:
+                        note_raw(e, tval, "get-type-comparison")
+                    if tval is not None:
+                        handler.type_values.append(tval)
+                        handler.type_names.append(tname or repr(tval))
+            if handler.type_values and finfo is not None and msg_var:
+                handler.reads = self._follow_reads(
+                    module, finfo, {msg_var}, resolve, note_raw, _MAX_HOPS)
+                handlers.append(handler)
+            elif handler.type_values:
+                handlers.append(handler)
+
+    def _bind_send(self, body: Sequence[ast.AST], ctor: ast.Call,
+                   send: _Send, constructed: Dict[str, _Send]) -> None:
+        for n in body:
+            if isinstance(n, ast.Assign) and n.value is ctor:
+                for t in n.targets:
+                    path = dotted_name(t)
+                    if path:
+                        constructed[path] = send
+
+    # ------------------------------------------------------ handler reads
+
+    def _handler_body_reads(self, module: Module, handler_expr: ast.AST,
+                            resolve, note_raw) -> List[_Read]:
+        """Keys read by a registered handler: self.method, plain name, or
+        inline lambda."""
+        if isinstance(handler_expr, ast.Lambda):
+            params = {a.arg for a in handler_expr.args.args}
+            return self._reads_in(module, ast.walk(handler_expr.body),
+                                  params, resolve, note_raw)
+        name = call_edge_name(handler_expr) or dotted_name(handler_expr)
+        if name is None:
+            return []
+        name = name.split(".")[-1]
+        for cand in self._by_simple.get(name, ()):
+            msg_param = self._first_msg_param(cand)
+            if msg_param is None:
+                return []
+            return self._follow_reads(module, cand, {msg_param},
+                                      resolve, note_raw, _MAX_HOPS)
+        return []
+
+    def _first_msg_param(self, finfo: FuncInfo) -> Optional[str]:
+        args = [a.arg for a in finfo.node.args.args]
+        if args and args[0] == "self":
+            args = args[1:]
+        return args[0] if args else None
+
+    def _follow_reads(self, module: Module, finfo: FuncInfo,
+                      msg_vars: Set[str], resolve, note_raw,
+                      hops: int, _seen: Optional[Set[Tuple[str, frozenset]]] = None
+                      ) -> List[_Read]:
+        """.get(key) reads on the message vars in this function, following
+        the message object into same-class/same-module helpers."""
+        if _seen is None:
+            _seen = set()
+        mark = (finfo.qualname, frozenset(msg_vars))
+        if mark in _seen or hops < 0:
+            return []
+        _seen.add(mark)
+
+        body = list(walk_own_body(finfo.node))
+        reads = self._reads_in(module, body, msg_vars, resolve, note_raw)
+
+        for n in body:
+            if not isinstance(n, ast.Call):
+                continue
+            callee = call_edge_name(n.func)
+            if callee is None:
+                continue
+            passed_positions = [i for i, a in enumerate(n.args)
+                                if isinstance(a, ast.Name) and a.id in msg_vars]
+            passed_kw = [kw.arg for kw in n.keywords
+                         if isinstance(kw.value, ast.Name)
+                         and kw.value.id in msg_vars and kw.arg]
+            if not passed_positions and not passed_kw:
+                continue
+            for cand in self._by_simple.get(callee, ()):
+                if cand.cls is not None and finfo.cls is not None \
+                        and cand.cls != finfo.cls:
+                    continue
+                params = [a.arg for a in cand.node.args.args]
+                if params and params[0] == "self":
+                    params = params[1:]
+                nested_vars: Set[str] = set()
+                for i in passed_positions:
+                    if i < len(params):
+                        nested_vars.add(params[i])
+                nested_vars.update(k for k in passed_kw if k in params)
+                if nested_vars:
+                    reads.extend(self._follow_reads(
+                        module, cand, nested_vars, resolve, note_raw,
+                        hops - 1, _seen))
+        return reads
+
+    def _reads_in(self, module: Module, nodes: Iterable[ast.AST],
+                  msg_vars: Set[str], resolve, note_raw) -> List[_Read]:
+        reads: List[_Read] = []
+        for n in nodes:
+            if not (isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "get" and n.args):
+                continue
+            owner = dotted_name(n.func.value)
+            if owner not in msg_vars:
+                continue
+            kval, kname, klit = resolve(n.args[0])
+            if klit:
+                note_raw(n.args[0], kval, "get-key")
+            if kval is None:
+                continue
+            required = len(n.args) == 1 and not n.keywords
+            reads.append(_Read(module.relpath, n.lineno, kval,
+                               kname or repr(kval), required))
+        return reads

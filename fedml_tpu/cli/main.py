@@ -208,11 +208,13 @@ def dispatch(package, edge_ids, run_id, broker_dir, store_dir, timeout):
              help="Run the graftcheck static-analysis suite over fedml_tpu/ "
                   "(jit-purity, determinism, lock-order, config-drift, "
                   "no-print, donation-safety, sharding-consistency, "
-                  "host-sync, collective-deadlock, thread-hazard). Flags are "
+                  "host-sync, collective-deadlock, thread-hazard, "
+                  "retrace-hazard, wire-protocol, resource-leak). Flags are "
                   "forwarded to the checker driver: --checker ID "
                   "(repeatable), --json, --format {text,json,sarif}, "
                   "--changed-only [REF], --baseline PATH, --no-baseline, "
-                  "--write-baseline, --root DIR. Exits 1 on non-baselined "
+                  "--write-baseline, --root DIR, --stats, --cache PATH, "
+                  "--no-cache. Exits 1 on non-baselined "
                   "findings. See docs/static_analysis.md.",
              context_settings={"ignore_unknown_options": True})
 @click.argument("graftcheck_args", nargs=-1, type=click.UNPROCESSED)

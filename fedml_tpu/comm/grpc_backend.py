@@ -69,7 +69,10 @@ class GrpcTls:
 
     def __init__(self, ca_path: str, cert_path: str, key_path: str,
                  override_authority: Optional[str] = None):
-        read = lambda p: open(p, "rb").read()  # noqa: E731
+        def read(p: str) -> bytes:
+            with open(p, "rb") as f:
+                return f.read()
+
         self.ca = read(ca_path)
         self.cert = read(cert_path)
         self.key = read(key_path)

@@ -39,6 +39,7 @@ class MetricsSink:
             maxlen=max_records)
         self.max_records = max_records
         self.dropped_records = 0
+        # held for the sink's lifetime; released in close()
         self._fh = open(path, "a") if path else None
 
     def emit(self, record: Dict[str, Any]) -> None:

@@ -50,15 +50,13 @@ from ..comm.message import Message
 from ..comm.resilience import FaultPlan, NetworkPartition
 from ..core import telemetry
 from ..core.tenancy import CheckinQueue, DeficitRoundRobinScheduler
-from ..cross_silo.loadgen import DiurnalCurve
+from ..cross_silo.loadgen import MSG_TYPE_CHECKIN, DiurnalCurve
 from ..simulation.async_engine import VirtualEventHeap
 from ..simulation.client_store import ClientStateArena
 from ..simulation.federation import CommitLedger
 from ..simulation.hierarchical import contiguous_group_split, fold_partials
 from ..utils.checkpoint import trim_version_log
 from .registry import CHECKED_IN, DeviceRegistry
-
-MSG_TYPE_CHECKIN = "device_checkin"
 
 DEVICE_DAY_DEFAULTS = dict(
     device_registry_size=100_000,

@@ -2,8 +2,12 @@
 
 Parity: reference ``cross_silo/horizontal/message_define.py`` (same numbering:
 CONNECTION_READY=0, S2C INIT=1 / SYNC=2 / CHECK_STATUS=6, C2S MODEL=3 /
-STATS=4 / STATUS=5).
+STATS=4 / STATUS=5). Payload-key names alias the canonical
+:class:`~fedml_tpu.comm.message.Message` constants so the two namespaces
+cannot drift apart (wire-protocol checker enforces this).
 """
+
+from ..comm.message import Message
 
 
 class MyMessage:
@@ -20,16 +24,16 @@ class MyMessage:
     MSG_TYPE_C2S_SEND_STATS_TO_SERVER = 4
     MSG_TYPE_C2S_CLIENT_STATUS = 5
 
-    MSG_ARG_KEY_TYPE = "msg_type"
-    MSG_ARG_KEY_SENDER = "sender"
-    MSG_ARG_KEY_RECEIVER = "receiver"
+    MSG_ARG_KEY_TYPE = Message.MSG_ARG_KEY_TYPE
+    MSG_ARG_KEY_SENDER = Message.MSG_ARG_KEY_SENDER
+    MSG_ARG_KEY_RECEIVER = Message.MSG_ARG_KEY_RECEIVER
 
-    MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
-    MSG_ARG_KEY_MODEL_PARAMS = "model_params"
-    MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
-    MSG_ARG_KEY_CLIENT_STATUS = "client_status"
+    MSG_ARG_KEY_NUM_SAMPLES = Message.MSG_ARG_KEY_NUM_SAMPLES
+    MSG_ARG_KEY_MODEL_PARAMS = Message.MSG_ARG_KEY_MODEL_PARAMS
+    MSG_ARG_KEY_CLIENT_INDEX = Message.MSG_ARG_KEY_CLIENT_INDEX
+    MSG_ARG_KEY_CLIENT_STATUS = Message.MSG_ARG_KEY_CLIENT_STATUS
     MSG_ARG_KEY_CLIENT_OS = "client_os"
-    MSG_ARG_KEY_ROUND_INDEX = "round_idx"
+    MSG_ARG_KEY_ROUND_INDEX = Message.MSG_ARG_KEY_ROUND_INDEX
     # buffered-async plane (ours): committed model version carried on S2C
     # init/sync and echoed back on the upload — the server derives each
     # update's staleness from the echo. Absent entirely in synchronous runs.

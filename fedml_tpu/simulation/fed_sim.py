@@ -613,6 +613,10 @@ class FedSimulator:
                     "watchdog rollback cannot snapshot the on-disk spill "
                     "tier — drop client_state_spill_dir or raise "
                     "client_state_capacity")
+            # the simulated population is fixed (client_num_in_total) and
+            # every client may be resampled, so spill rows stay live for
+            # the whole run — no departure event exists to reclaim on
+            # graftcheck: disable=resource-leak
             self._arena = ClientStateArena(
                 self._client_state_proto, capacity,
                 spill_dir=cfg.client_state_spill_dir,

@@ -1,0 +1,44 @@
+"""Fixture: every resource-leak shape the checker must flag."""
+import json
+import socket
+import threading
+
+import grpc
+
+from fedml_tpu.simulation.client_store import ClientStateArena
+
+
+def thread_never_joined(work):
+    t = threading.Thread(target=work)
+    t.start()
+    return "done"  # t outlives the function, neither daemon nor joined
+
+
+def inline_thread(work):
+    threading.Thread(target=work).start()  # no handle to join at all
+
+
+def unclosed_file(path):
+    f = open(path)
+    data = f.read()
+    return len(data)  # fd leaks on every call
+
+
+def inline_open(path):
+    data = open(path).read()
+    return json.loads(data)
+
+
+def unclosed_socket(host, port):
+    s = socket.socket()
+    s.connect((host, port))
+    s.sendall(b"ping")
+
+
+def unclosed_channel(target):
+    ch = grpc.insecure_channel(target)
+    ch.unary_unary("/svc/Method")
+
+
+def spill_without_reclaim(proto, tmpdir):
+    return ClientStateArena(proto, 64, spill_dir=tmpdir)

@@ -1,0 +1,57 @@
+"""Fixture: leak-free twins of every resource_leak_bad shape."""
+import json
+import socket
+import threading
+
+import grpc
+
+from fedml_tpu.simulation.client_store import ClientStateArena
+
+
+def thread_joined(work):
+    t = threading.Thread(target=work)
+    t.start()
+    t.join()
+
+
+def daemon_thread(work):
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+
+
+class Pool:
+    def __init__(self, work):
+        # escapes to self: the pool's shutdown owns the join
+        self._t = threading.Thread(target=work)
+        self._t.start()
+
+    def handed_off(self, work, threads):
+        threads.append(threading.Thread(target=work))
+
+
+def with_file(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def closed_socket(host, port):
+    s = socket.socket()
+    try:
+        s.connect((host, port))
+    finally:
+        s.close()
+
+
+def with_channel(target):
+    with grpc.insecure_channel(target) as ch:
+        ch.unary_unary("/svc/Method")
+
+
+def returned_handle(path):
+    return open(path)  # caller's lifecycle, not ours
+
+
+def spill_with_reclaim(proto, tmpdir, departed):
+    arena = ClientStateArena(proto, 64, spill_dir=tmpdir)
+    arena.discard(departed)
+    return arena

@@ -1,0 +1,56 @@
+"""Fixture: every retrace-hazard shape the checker must flag."""
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+
+def step(params, batch):
+    return params
+
+
+def jit_in_loop(params, batches):
+    for batch in batches:
+        f = jax.jit(step)  # fresh wrapper per iteration: empty trace cache
+        params = f(params, batch)
+    return params
+
+
+def per_call_jit(params, batch):
+    # constructed, invoked, and discarded on every call
+    return jax.jit(step)(params, batch)
+
+
+def discarded_jit(params):
+    g = jax.jit(step)  # bound but never invoked, escaped, or returned
+    return params
+
+
+compiled = jax.jit(step, static_argnums=(1,))
+
+
+def loop_varying_static(params, batches):
+    for i, batch in enumerate(batches):
+        params = compiled(params, i)  # static arg varies with the loop
+    return params
+
+
+def unhashable_static(params, batch):
+    return compiled(params, [1, 2, 3])  # list literal can never hash
+
+
+plain = jax.jit(step)
+
+
+def shape_flow(params, batches):
+    for batch in batches:
+        params = plain(params, len(batch))  # len() respecializes per shape
+    return params
+
+
+def scan_block(params, cohorts):
+    def body(carry, cohort):
+        h = jax.jit(step)  # retrace here recompiles the whole fused block
+        return h(carry, cohort), None
+
+    out, _ = jax.lax.scan(body, params, cohorts)
+    return out

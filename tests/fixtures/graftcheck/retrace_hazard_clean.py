@@ -1,0 +1,53 @@
+"""Fixture: retrace-safe twins of every retrace_hazard_bad shape."""
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+
+def step(params, batch):
+    return params
+
+
+compiled = jax.jit(step)  # constructed once at module scope
+
+
+def loop_reuses_wrapper(params, batches):
+    for batch in batches:
+        params = compiled(params, batch)
+    return params
+
+
+class Engine:
+    def __init__(self):
+        # builder pattern: wrapper outlives the call that made it
+        self._step = self._build_step()
+
+    def _build_step(self):
+        return jax.jit(step)
+
+    def run(self, params, batches):
+        for batch in batches:
+            params = self._step(params, batch)
+        return params
+
+
+mode_step = jax.jit(step, static_argnums=(1,))
+
+
+def loop_invariant_static(params, batches, mode):
+    for batch in batches:
+        params = mode_step(params, mode)  # static arg fixed across the loop
+    return params
+
+
+def escaped_wrapper(params):
+    f = jax.jit(step)
+    return f  # handed to the caller — their lifecycle now
+
+
+def scan_block(params, cohorts):
+    def body(carry, cohort):
+        return compiled(carry, cohort), None
+
+    out, _ = jax.lax.scan(body, params, cohorts)
+    return out

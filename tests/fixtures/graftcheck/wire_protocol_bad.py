@@ -1,0 +1,56 @@
+"""Fixture: wire-protocol violations — unhandled send, unstamped handler
+read, raw literal shadowing a constant, duplicated constant (paired with
+wire_protocol_clean.py via the project graph when scanned together)."""
+
+
+class Message:
+    MSG_ARG_KEY_TYPE = "msg_type"
+    MSG_TYPE_UPLOAD = "upload"
+    MSG_ARG_KEY_MODEL_PARAMS = "model_params"
+    MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
+
+    def __init__(self, type=None, sender_id=0, receiver_id=0):
+        self.params = {Message.MSG_ARG_KEY_TYPE: type}
+
+    def add_params(self, key, value):
+        self.params[key] = value
+
+    def get(self, key, default=None):
+        return self.params.get(key, default)
+
+    def get_type(self):
+        return self.params.get(Message.MSG_ARG_KEY_TYPE)
+
+
+MSG_TYPE_ORPHANED = "orphaned"
+# duplicates the value defined in wire_protocol_clean.py under the same name
+MSG_TYPE_SHARED = "shared_event"
+
+
+class BadClient:
+    def send_orphaned(self, comm):
+        # sent, but no handler anywhere registers for it
+        msg = Message(type=MSG_TYPE_ORPHANED, sender_id=1, receiver_id=0)
+        comm.send_message(msg)
+
+    def send_upload(self, comm):
+        msg = Message(type=Message.MSG_TYPE_UPLOAD, sender_id=1, receiver_id=0)
+        msg.add_params(Message.MSG_ARG_KEY_MODEL_PARAMS, {})
+        # raw literal shadowing Message.MSG_ARG_KEY_NUM_SAMPLES
+        msg.add_params("num_samples", 10)
+        comm.send_message(msg)
+
+
+class BadServer:
+    def register(self):
+        self.register_message_receive_handler(
+            Message.MSG_TYPE_UPLOAD, self.handle_upload)
+
+    def register_message_receive_handler(self, msg_type, handler):
+        pass
+
+    def handle_upload(self, msg):
+        params = msg.get(Message.MSG_ARG_KEY_MODEL_PARAMS)
+        # no sender of MSG_TYPE_UPLOAD ever stamps this key
+        staleness = msg.get("model_version")
+        return params, staleness

@@ -1,0 +1,56 @@
+"""Fixture: a conformant wire protocol — every sent type handled, every
+handler-read key stamped by a sender of that type, constants everywhere."""
+
+
+class Message:
+    MSG_ARG_KEY_TYPE = "msg_type"
+    MSG_TYPE_SYNC = "sync"
+    MSG_ARG_KEY_MODEL_PARAMS = "model_params"
+    MSG_ARG_KEY_ROUND_INDEX = "round_idx"
+
+    def __init__(self, type=None, sender_id=0, receiver_id=0):
+        self.params = {Message.MSG_ARG_KEY_TYPE: type}
+
+    def add_params(self, key, value):
+        self.params[key] = value
+
+    def get(self, key, default=None):
+        return self.params.get(key, default)
+
+    def get_type(self):
+        return self.params.get(Message.MSG_ARG_KEY_TYPE)
+
+
+MSG_TYPE_SHARED = "shared_event"
+
+
+class GoodServer:
+    def send_sync(self, comm):
+        msg = Message(type=Message.MSG_TYPE_SYNC, sender_id=0, receiver_id=1)
+        msg.add_params(Message.MSG_ARG_KEY_MODEL_PARAMS, {})
+        msg.add_params(Message.MSG_ARG_KEY_ROUND_INDEX, 3)
+        comm.send_message(msg)
+
+    def send_shared(self, comm):
+        comm.send_message(Message(type=MSG_TYPE_SHARED))
+
+
+class GoodClient:
+    def register(self):
+        self.register_message_receive_handler(
+            Message.MSG_TYPE_SYNC, self.handle_sync)
+        self.register_message_receive_handler(
+            MSG_TYPE_SHARED, self.handle_shared)
+
+    def register_message_receive_handler(self, msg_type, handler):
+        pass
+
+    def handle_sync(self, msg):
+        params = msg.get(Message.MSG_ARG_KEY_MODEL_PARAMS)
+        round_idx = msg.get(Message.MSG_ARG_KEY_ROUND_INDEX)
+        # a defaulted read never requires a stamp
+        maybe = msg.get("optional_hint", None)
+        return params, round_idx, maybe
+
+    def handle_shared(self, msg):
+        return msg.get_type()

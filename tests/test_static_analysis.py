@@ -28,8 +28,11 @@ from fedml_tpu.analysis.host_sync import HostSyncChecker
 from fedml_tpu.analysis.jit_purity import JitPurityChecker
 from fedml_tpu.analysis.lock_order import LockOrderChecker
 from fedml_tpu.analysis.no_print import NoPrintChecker
+from fedml_tpu.analysis.resource_leak import ResourceLeakChecker
+from fedml_tpu.analysis.retrace_hazard import RetraceHazardChecker
 from fedml_tpu.analysis.sharding_consistency import ShardingConsistencyChecker
 from fedml_tpu.analysis.thread_hazard import ThreadHazardChecker
+from fedml_tpu.analysis.wire_protocol import WireProtocolChecker
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(REPO_ROOT, "tests", "fixtures", "graftcheck")
@@ -709,4 +712,198 @@ def test_checker_registry_is_complete():
     assert sorted(gc.checker_registry()) == [
         "collective-deadlock", "config-drift", "determinism",
         "donation-safety", "host-sync", "jit-purity", "lock-order",
-        "no-print", "sharding-consistency", "thread-hazard"]
+        "no-print", "resource-leak", "retrace-hazard",
+        "sharding-consistency", "thread-hazard", "wire-protocol"]
+
+
+# -------------------------------------------------------- retrace-hazard
+
+def test_retrace_hazard_fires_on_bad_fixture():
+    findings = _run_on_fixture(RetraceHazardChecker, "retrace_hazard_bad.py")
+    keys = {f.key for f in findings}
+    assert "jit_in_loop:jit-in-loop:step" in keys
+    assert "per_call_jit:per-call-jit:step" in keys
+    assert "loop_varying_static:static-loop-varying:compiled:1" in keys
+    assert "unhashable_static:unhashable-static:compiled:1" in keys
+    assert "shape_flow:shape-flow:plain" in keys
+    # a retrace inside a lax.scan block body recompiles the whole fused
+    # dispatch — the PR 15 scope gets its own key
+    assert "scan_block.body:scan-body-jit:step" in keys
+    # bound-but-never-invoked wrapper is a warning, not an error
+    discarded = [f for f in findings
+                 if f.key == "discarded_jit:per-call-jit:step"]
+    assert discarded and discarded[0].severity == "warning"
+
+
+def test_retrace_hazard_silent_on_clean_fixture():
+    assert _run_on_fixture(RetraceHazardChecker,
+                           "retrace_hazard_clean.py") == []
+
+
+# --------------------------------------------------------- wire-protocol
+
+def _run_on_fixture_set(checker_cls, filenames):
+    """Whole-package checker over several fixture files sharing one
+    project graph (the shape run_checkers provides)."""
+    from fedml_tpu.analysis.project import build_graph
+
+    mods = [gc.load_module(os.path.join(FIXTURES, fn), FIXTURES)
+            for fn in filenames]
+    ctx = gc.Context(repo_root=FIXTURES, package_dir=FIXTURES)
+    ctx.graph = build_graph(mods)
+    checker = checker_cls(ctx)
+    findings = []
+    for mod in mods:
+        if checker.interested(mod.relpath):
+            findings.extend(checker.visit_module(mod))
+    findings.extend(checker.finalize())
+    return findings
+
+
+def test_wire_protocol_fires_on_bad_fixture():
+    findings = _run_on_fixture(WireProtocolChecker, "wire_protocol_bad.py")
+    keys = {f.key for f in findings}
+    assert "unhandled-send:MSG_TYPE_ORPHANED" in keys
+    assert "unstamped-key:MSG_TYPE_UPLOAD:'model_version'" in keys
+    assert any(k.startswith("raw-literal:") and "'num_samples'" in k
+               for k in keys)
+
+
+def test_wire_protocol_silent_on_clean_fixture():
+    assert _run_on_fixture(WireProtocolChecker,
+                           "wire_protocol_clean.py") == []
+
+
+def test_wire_protocol_flags_duplicated_constant_across_modules():
+    # both fixtures define MSG_TYPE_SHARED = "shared_event"; the checker
+    # flags every copy except the sorted-first canonical one
+    findings = _run_on_fixture_set(
+        WireProtocolChecker,
+        ["wire_protocol_bad.py", "wire_protocol_clean.py"])
+    dups = [f for f in findings if f.key == "dup-constant:MSG_TYPE_SHARED"]
+    assert len(dups) == 1
+    assert dups[0].path.endswith("wire_protocol_clean.py")
+    assert dups[0].severity == "warning"
+
+
+# --------------------------------------------------------- resource-leak
+
+def test_resource_leak_fires_on_bad_fixture():
+    findings = _run_on_fixture(ResourceLeakChecker, "resource_leak_bad.py")
+    keys = {f.key for f in findings}
+    assert "thread_never_joined:thread-no-join:t" in keys
+    assert "inline_thread:thread-no-join:<inline>" in keys
+    assert "unclosed_file:unclosed:file:f" in keys
+    assert "inline_open:unclosed:file:<inline>" in keys
+    assert "unclosed_socket:unclosed:socket:s" in keys
+    assert "unclosed_channel:unclosed:grpc-channel:ch" in keys
+    assert "spill-no-reclaim" in keys
+
+
+def test_resource_leak_silent_on_clean_fixture():
+    assert _run_on_fixture(ResourceLeakChecker,
+                           "resource_leak_clean.py") == []
+
+
+# ------------------------------------------------------ incremental cache
+
+def test_cache_cold_and_warm_runs_are_byte_identical(tmp_path, capsys):
+    cache = str(tmp_path / "cache.json")
+    rc_cold = gc.main(["--json", "--cache", cache])
+    cold = capsys.readouterr().out
+    assert os.path.exists(cache)
+    rc_warm = gc.main(["--json", "--cache", cache])
+    warm = capsys.readouterr().out
+    assert rc_cold == rc_warm
+    assert cold == warm, "warm cache run must reproduce the cold run exactly"
+
+
+def test_cache_warm_run_is_fast(tmp_path, capsys):
+    import time
+
+    cache = str(tmp_path / "cache.json")
+    gc.main(["--json", "--cache", cache])  # cold: populate
+    capsys.readouterr()
+    t0 = time.perf_counter()
+    gc.main(["--json", "--cache", cache])
+    assert time.perf_counter() - t0 < 10.0, "warm path must skip parsing"
+    capsys.readouterr()
+
+
+def test_cache_invalidates_on_file_change(tmp_path, capsys):
+    # a package copy with one bad file: fixing the file must flip the
+    # cached verdict (content-hash invalidation, not mtime)
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    bad = pkg / "mod.py"
+    bad.write_text("def f():\n    print('hi')\n")
+    from fedml_tpu.analysis.cache import run_checkers_cached
+
+    cache = str(tmp_path / "cache.json")
+    registry = gc.checker_registry()
+    classes = [registry["no-print"]]
+    first = run_checkers_cached(classes, str(pkg), str(tmp_path), cache)
+    assert len(first) == 1 and first[0].checker == "no-print"
+    bad.write_text("def f():\n    return 'hi'\n")
+    second = run_checkers_cached(classes, str(pkg), str(tmp_path), cache)
+    assert second == []
+
+
+def test_stats_report_timing_and_hit_rate(tmp_path, capsys):
+    cache = str(tmp_path / "cache.json")
+    gc.main(["--stats", "--json", "--cache", cache])
+    capsys.readouterr()
+    gc.main(["--stats", "--json", "--cache", cache])
+    err = capsys.readouterr().err
+    assert "graftcheck stats:" in err
+    assert "cache hit rate 100.0%" in err
+    assert "jit-purity" in err
+
+
+# ----------------------------------------------- changed-only improvements
+
+def _git(repo, *args):
+    subprocess.run(["git", *args], cwd=repo, check=True,
+                   capture_output=True, text=True)
+
+
+def test_changed_files_follows_renames(tmp_path):
+    repo = str(tmp_path)
+    _git(repo, "init", "-q")
+    _git(repo, "config", "user.email", "t@t")
+    _git(repo, "config", "user.name", "t")
+    (tmp_path / "old_name.py").write_text("X = 1\n")
+    _git(repo, "add", "old_name.py")
+    _git(repo, "commit", "-qm", "seed")
+    _git(repo, "mv", "old_name.py", "new_name.py")
+    changed = gc.changed_files(repo, "HEAD")
+    # the rename must surface the NEW path, not the dead old one
+    assert any(p.endswith("new_name.py") for p in changed)
+    assert not any(p.endswith("old_name.py") for p in changed)
+
+
+def test_changed_files_skips_deletions(tmp_path):
+    repo = str(tmp_path)
+    _git(repo, "init", "-q")
+    _git(repo, "config", "user.email", "t@t")
+    _git(repo, "config", "user.name", "t")
+    (tmp_path / "doomed.py").write_text("X = 1\n")
+    _git(repo, "add", "doomed.py")
+    _git(repo, "commit", "-qm", "seed")
+    _git(repo, "rm", "-q", "doomed.py")
+    assert gc.changed_files(repo, "HEAD") == []
+
+
+def test_expand_with_dependents_pulls_in_importers(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "base.py").write_text("def helper():\n    return 1\n")
+    (pkg / "user.py").write_text(
+        "from pkg.base import helper\n\ndef g():\n    return helper()\n")
+    (pkg / "loner.py").write_text("def h():\n    return 2\n")
+    expanded = gc.expand_with_dependents(
+        [str(pkg / "base.py")], str(pkg), str(tmp_path))
+    names = {os.path.basename(p) for p in expanded}
+    # editing base invalidates its importer's findings, not the loner's
+    assert names == {"base.py", "user.py"}
